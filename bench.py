@@ -1,0 +1,81 @@
+"""Benchmark: BERT-base-equivalent causal-LM training throughput on 1 chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: samples/sec/chip on a BERT-base-sized (110M-param-class) transformer
+training step (fwd+bwd+AdamW), seq 512, bf16 activations — BASELINE.json
+config-3 family. vs_baseline is measured MFU vs the 50% north-star target
+(reference publishes no absolute numbers; BASELINE.md).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.framework import functional as func_mod
+
+    paddle.seed(0)
+    on_tpu = jax.devices()[0].platform == 'tpu'
+    seq = 512
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=seq,
+                        dropout=0.0)
+        batch = 16
+        steps = 20
+    else:  # CPU smoke fallback keeps the harness runnable anywhere
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=128, dropout=0.0)
+        seq = 128
+        batch = 4
+        steps = 3
+
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    def loss_fn(logits, labels):
+        return model.loss(logits, labels)
+
+    step = func_mod.TrainStep(model, loss_fn, opt)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
+
+    # warmup/compile
+    step(ids, labels)
+    step(ids, labels)
+
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    _ = loss.numpy()
+    dt = time.time() - t0
+
+    samples_per_sec = batch * steps / dt
+    n_params = model.num_params()
+    flops_per_step = 6.0 * n_params * batch * seq
+    achieved = flops_per_step * steps / dt
+    # v5e peak bf16 ~197 TFLOP/s/chip; CPU value meaningless but reported
+    peak = 197e12 if on_tpu else 1e12
+    mfu = achieved / peak
+
+    print(json.dumps({
+        'metric': 'bert_base_lm_train_samples_per_sec_per_chip',
+        'value': round(samples_per_sec, 3),
+        'unit': 'samples/sec/chip',
+        'vs_baseline': round(mfu / 0.50, 4),
+    }))
+
+
+if __name__ == '__main__':
+    main()

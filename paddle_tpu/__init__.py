@@ -1,0 +1,111 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Import as `import paddle_tpu as paddle` — the public surface mirrors
+python/paddle/__init__.py of the reference (~v2.1).
+"""
+
+__version__ = '0.1.0'
+
+# framework core
+from .framework.core import Tensor, Parameter, to_tensor  # noqa: F401
+from .framework.core import no_grad_guard as no_grad  # noqa: F401
+from .framework.core import enable_grad_guard as enable_grad  # noqa: F401
+from .framework.core import is_grad_enabled, set_grad_enabled  # noqa: F401
+from .framework.dtype import set_default_dtype, get_default_dtype  # noqa: F401
+from .framework.device import (set_device, get_device, device_count,  # noqa: F401
+                               is_compiled_with_cuda, is_compiled_with_xpu,
+                               is_compiled_with_npu)
+from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# dtype singletons (paddle.float32 etc.)
+float16 = 'float16'
+bfloat16 = 'bfloat16'
+float32 = 'float32'
+float64 = 'float64'
+int8 = 'int8'
+int16 = 'int16'
+int32 = 'int32'
+int64 = 'int64'
+uint8 = 'uint8'
+bool = 'bool'
+complex64 = 'complex64'
+complex128 = 'complex128'
+
+# the wide tensor function surface
+from .tensor import *  # noqa: F401,F403
+from .tensor import einsum  # noqa: F401
+from .tensor.logic import is_tensor  # noqa: F401
+
+# subpackages
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import distribution  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
+from . import profiler  # noqa: F401
+from . import sysconfig  # noqa: F401
+
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .framework.io_save import save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary, flops  # noqa: F401
+from .batch import batch  # noqa: F401
+from .autograd import grad  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+
+# paddle.disable_static / enable_static shims: we are always "dygraph" at the
+# API level; static mode is jit-compilation under the hood (see jit/static).
+_static_mode = [False]
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static(place=None):
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def get_flags(flags):
+    from .framework import flags as F
+    return F.get_flags(flags)
+
+
+def set_flags(flags):
+    from .framework import flags as F
+    F.set_flags(flags)
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+    np.set_printoptions(**{k: v for k, v in kwargs.items()
+                           if k in ('precision', 'threshold', 'edgeitems',
+                                    'linewidth')})
+
+
+class version:
+    full_version = __version__
+    major, minor, patch = 0, 1, 0
+    rc = 0
+    istaged = True
+    commit = 'tpu-native'
+
+    @staticmethod
+    def show():
+        print('paddle_tpu', version.full_version)

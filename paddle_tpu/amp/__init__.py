@@ -1,0 +1,11 @@
+"""AMP (reference: python/paddle/amp/ + imperative/amp_auto_cast.cc).
+
+TPU-native: bf16 is the native mixed-precision dtype; auto_cast casts
+matmul/conv inputs to the target dtype (the reference's allow-list
+mechanism), and GradScaler keeps the fp16 loss-scaling contract (a no-op
+state machine for bf16, fully functional for fp16).
+"""
+from .auto_cast import auto_cast, amp_guard, white_list, black_list  # noqa: F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ['auto_cast', 'GradScaler']

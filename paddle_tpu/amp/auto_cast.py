@@ -1,0 +1,78 @@
+"""auto_cast context (reference: python/paddle/amp/auto_cast.py,
+imperative/amp_auto_cast.cc allow/block lists)."""
+import contextlib
+
+from ..framework import dtype as dtype_mod
+
+# reference amp lists (imperative/amp_auto_cast.cc:28-73)
+WHITE_LIST = {'conv2d', 'matmul', 'matmul_v2', 'mul', 'linear', 'conv1d',
+              'conv3d', 'einsum', 'bmm', 'mm'}
+BLACK_LIST = {'exp', 'square', 'log', 'mean', 'sum', 'cos_sim',
+              'softmax_with_cross_entropy', 'cross_entropy',
+              'layer_norm', 'batch_norm', 'softmax', 'log_softmax'}
+
+_STATE = {'enabled': False, 'dtype': 'float16', 'level': 'O1',
+          'custom_white': set(), 'custom_black': set()}
+
+
+def _install_hook():
+    from ..framework import core
+    core._amp_cast_hook[0] = _hook
+
+
+def _hook(name, arrays):
+    if not _STATE['enabled']:
+        return arrays
+    return amp_cast_inputs(name, arrays)
+
+
+def white_list():
+    return (WHITE_LIST | _STATE['custom_white']) - _STATE['custom_black']
+
+
+def black_list():
+    return (BLACK_LIST | _STATE['custom_black']) - _STATE['custom_white']
+
+
+def amp_state():
+    return _STATE
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level='O1', dtype='float16'):
+    prev = dict(_STATE)
+    _STATE['enabled'] = enable
+    _STATE['dtype'] = dtype_mod.convert_dtype(dtype)
+    _STATE['level'] = level
+    _STATE['custom_white'] = set(custom_white_list or ())
+    _STATE['custom_black'] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        _STATE.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def amp_cast_inputs(op_name, arrays):
+    """Called by the op runner when amp is on: cast per the lists."""
+    import jax.numpy as jnp
+    if not _STATE['enabled']:
+        return arrays
+    target = dtype_mod.to_jax_dtype(_STATE['dtype'])
+    if _STATE['level'] == 'O2':
+        cast_it = op_name not in black_list()
+    else:
+        cast_it = op_name in white_list()
+    if not cast_it:
+        # black list ops compute in fp32
+        return [a.astype(jnp.float32)
+                if a.dtype in (jnp.float16, jnp.bfloat16) else a
+                for a in arrays]
+    return [a.astype(target) if jnp.issubdtype(a.dtype, jnp.floating) else a
+            for a in arrays]
+
+
+_install_hook()

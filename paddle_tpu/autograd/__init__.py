@@ -1,0 +1,112 @@
+"""Autograd public API (reference: python/paddle/autograd/).
+
+paddle.grad maps to the tape (PartialGradEngine parity,
+imperative/partial_grad_engine.cc); PyLayer maps to a recorded custom-VJP op.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (Tensor, GradNode, backward_engine, no_grad_guard,
+                              enable_grad_guard, run_op, wrap_out, is_grad_enabled,
+                              set_grad_enabled)
+
+no_grad = no_grad_guard
+enable_grad = enable_grad_guard
+
+__all__ = ['backward', 'grad', 'no_grad', 'enable_grad', 'PyLayer',
+           'PyLayerContext', 'is_grad_enabled', 'set_grad_enabled']
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    backward_engine(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad: grads of outputs w.r.t. inputs without touching .grad."""
+    if create_graph:
+        # double-backward needs backward ops recorded on the tape, which the
+        # per-op jax.vjp design does not retain; use incubate.autograd.vjp /
+        # jax.grad composition for higher-order derivatives.
+        raise NotImplementedError(
+            "create_graph=True is not supported by the eager tape; "
+            "compose jax-level transforms via "
+            "paddle_tpu.incubate.autograd.vjp/jvp for higher-order grads")
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # stash and clear .grad, run backward, harvest, restore
+    saved = [(t, t._grad) for t in ins]
+    for t in ins:
+        t._grad = None
+    retain = True if retain_graph is None else retain_graph
+    backward_engine(list(outs), grad_tensors=grad_outputs, retain_graph=retain)
+    results = []
+    for t in ins:
+        g = t._grad
+        if g is None and not allow_unused:
+            g = Tensor(jnp.zeros(t.shape, t._data.dtype))
+        results.append(g)
+    for t, old in saved:
+        t._grad = old
+    return results
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.container = None
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom op with user-defined forward/backward (reference:
+    python/paddle/autograd/py_layer.py). The backward runs as the node's vjp."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        with no_grad_guard():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+
+        needs = is_grad_enabled() and any(not t.stop_gradient for t in tensor_args)
+        if not needs:
+            return out
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if isinstance(cots, tuple) else [cots]
+            cot_tensors = [Tensor(c) for c in cot_list]
+            with no_grad_guard():
+                gin = cls.backward(ctx, *cot_tensors)
+            gins = list(gin) if isinstance(gin, (tuple, list)) else [gin]
+            return [g._data if isinstance(g, Tensor) else g for g in gins]
+
+        node = GradNode('py_layer:%s' % cls.__name__, vjp_fn, tensor_args,
+                        [(tuple(t.shape), t._data.dtype) for t in outs])
+        import weakref
+        for i, t in enumerate(outs):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._node_out_idx = i
+            node.out_refs.append(weakref.ref(t))
+        return out if multi else outs[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

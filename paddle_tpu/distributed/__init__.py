@@ -1,0 +1,16 @@
+"""paddle.distributed parity surface (reference: python/paddle/distributed/)."""
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                  ParallelEnv, is_initialized)
+from .parallel import DataParallel  # noqa: F401
+from .collective import (ReduceOp, new_group, all_reduce, all_gather,  # noqa: F401
+                         broadcast, reduce, scatter, alltoall, send, recv,
+                         barrier, wait, split, get_group)
+from .topology import (HybridCommunicateGroup, Group,  # noqa: F401
+                       get_hybrid_communicate_group, default_mesh)
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import strategy  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+QueueDataset = None  # PS-mode dataset; see distributed/ps

@@ -1,0 +1,78 @@
+"""Distributed (sharded, async) checkpointing via orbax (SURVEY.md §5.4:
+one sharded-checkpoint layer replaces io.py save ops + pickle paths + PS
+table save).
+"""
+import os
+
+import numpy as np
+import jax
+
+__all__ = ['save_checkpoint', 'load_checkpoint', 'AsyncCheckpointer']
+
+
+def _to_arrays(state_dict):
+    from ..framework.core import Tensor
+    out = {}
+    for k, v in state_dict.items():
+        if isinstance(v, Tensor):
+            out[k] = v._data
+        elif isinstance(v, dict):
+            out[k] = _to_arrays(v)
+        else:
+            out[k] = v
+    return out
+
+
+class AsyncCheckpointer:
+    """Async sharded checkpoints (gang-scheduled ICI jobs need non-blocking
+    saves — SURVEY.md §5.3 TPU equivalent)."""
+
+    def __init__(self):
+        try:
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+            self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        except Exception:
+            self._ocp = None
+            self._ckpt = None
+
+    def save(self, path, state_dict, force=True):
+        state = _to_arrays(state_dict)
+        path = os.path.abspath(path)
+        if self._ckpt is not None:
+            self._ckpt.save(path, state, force=force)
+        else:
+            from ..framework.io_save import save as _save
+            _save(state, path + '.fallback.pdparams')
+
+    def restore(self, path):
+        path = os.path.abspath(path)
+        if self._ckpt is not None:
+            return self._ckpt.restore(path)
+        from ..framework.io_save import load as _load
+        return _load(path + '.fallback.pdparams')
+
+    def wait_until_finished(self):
+        if self._ckpt is not None:
+            self._ckpt.wait_until_finished()
+
+
+_CKPT = None
+
+
+def _checkpointer():
+    global _CKPT
+    if _CKPT is None:
+        _CKPT = AsyncCheckpointer()
+    return _CKPT
+
+
+def save_checkpoint(state_dict, path, asynchronous=True):
+    ck = _checkpointer()
+    ck.save(path, state_dict)
+    if not asynchronous:
+        ck.wait_until_finished()
+
+
+def load_checkpoint(path):
+    return _checkpointer().restore(path)

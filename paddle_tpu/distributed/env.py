@@ -1,0 +1,84 @@
+"""Process/env bootstrap (reference: fleet launch env PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS; platform/gen_comm_id_helper.cc TCP bootstrap).
+
+TPU-native: jax.distributed.initialize replaces gen_nccl_id + NCCLCommContext
+entirely (SURVEY.md §5.8). Env-name parity is kept so reference launch
+scripts work unchanged.
+"""
+import os
+
+import jax
+
+_STATE = {'initialized': False}
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get('PADDLE_TRAINER_ID', 0))
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:
+        eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+        return len(eps.split(',')) if eps else 1
+
+
+def is_initialized():
+    return _STATE['initialized']
+
+
+def init_parallel_env():
+    """reference: distributed/parallel.py:58 init_parallel_env. Multi-host:
+    reads PADDLE_TRAINER_* env (or jax-native vars) and calls
+    jax.distributed.initialize; single-host it is a no-op (ICI mesh over
+    local devices needs no process group)."""
+    if _STATE['initialized']:
+        return
+    n = int(os.environ.get('PADDLE_TRAINERS_NUM', '1'))
+    eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '')
+    rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))
+    if n > 1 and eps:
+        coordinator = eps.split(',')[0]
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=n, process_id=rank)
+    _STATE['initialized'] = True
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get('FLAGS_selected_tpus', '0').split(',')[0])
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get('PADDLE_CURRENT_ENDPOINT', '127.0.0.1:6170')
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get('PADDLE_TRAINER_ENDPOINTS', '').split(',')
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return self.rank

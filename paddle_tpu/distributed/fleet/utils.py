@@ -1,0 +1,80 @@
+"""fleet.utils (reference: fleet/utils/: recompute, fs, hybrid_parallel_util)."""
+import os
+import shutil
+
+__all__ = ['recompute', 'LocalFS', 'HDFSClient']
+
+
+def recompute(function, *args, **kwargs):
+    """Activation recomputation (reference: fleet/utils/recompute.py:63
+    RecomputeFunction). TPU-native: jax.checkpoint(remat) — XLA rematerializes
+    in backward, RNG handled by jax's per-trace key plumbing."""
+    import jax
+    from ...framework.core import Tensor, run_op
+    preserve = kwargs.pop('preserve_rng_state', True)
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    def fn(*arrays):
+        it = iter(arrays)
+        call_args = [Tensor(next(it), stop_gradient=False)
+                     if isinstance(a, Tensor) else a for a in args]
+        out = function(*call_args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    remat_fn = jax.checkpoint(fn)
+    return run_op('recompute', remat_fn, *tensor_args)
+
+
+class LocalFS:
+    """reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for name in os.listdir(path):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+    def touch(self, path, exist_ok=True):
+        open(path, 'a').close()
+
+
+class HDFSClient(LocalFS):
+    """HDFS via shell pipes in the reference (framework/io/fs.cc); this env
+    has no HDFS — gcsfuse/NFS-mounted paths go through the LocalFS API."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        pass

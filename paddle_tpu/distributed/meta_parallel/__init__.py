@@ -1,0 +1,9 @@
+"""Hybrid-parallel layers (reference: python/paddle/distributed/fleet/
+meta_parallel/)."""
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from .random_ctrl import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .sync_bn import SyncBatchNorm  # noqa: F401
+from .parallel_base import (PipelineParallel, TensorParallel,  # noqa: F401
+                            ShardingParallel)

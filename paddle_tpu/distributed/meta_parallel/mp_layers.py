@@ -1,0 +1,118 @@
+"""Tensor-parallel layers (reference: fleet/meta_parallel/parallel_layers/
+mp_layers.py:30,97,170,249 — VocabParallelEmbedding / ColumnParallelLinear /
+RowParallelLinear / ParallelCrossEntropy).
+
+TPU-native: a TP layer is an ordinary layer whose params carry 'mp'
+PartitionSpec placements; XLA's SPMD partitioner inserts the all-gather /
+reduce-scatter the reference implements via _c_identity/_mp_allreduce ops.
+`sharding_constraint` pins activation layouts where inference would pick the
+wrong one (the analog of the reference's explicit c_* calls).
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...framework.core import Tensor, run_op
+from ...tensor._helpers import ensure_tensor
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+
+
+def sharding_constraint(x, spec):
+    """with_sharding_constraint that is a no-op outside jit."""
+    t = ensure_tensor(x)
+    if not isinstance(t._data, jax.core.Tracer):
+        return t
+
+    def fn(a):
+        try:
+            return jax.lax.with_sharding_constraint(a, P(*spec))
+        except Exception:
+            return a
+    return run_op('sharding_constraint', fn, t)
+
+
+class VocabParallelEmbedding(nn.Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.placement = ('mp', None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.placement = (None, 'mp')
+        self.weight.is_distributed = True
+        self.gather_output = gather_output
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter(shape=[out_features],
+                                              is_bias=True)
+            self.bias.placement = ('mp',)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = sharding_constraint(
+                out, [None] * (out.ndim - 1) + [None])
+        else:
+            out = sharding_constraint(
+                out, [None] * (out.ndim - 1) + ['mp'])
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.placement = ('mp', None)
+        self.weight.is_distributed = True
+        self.input_is_parallel = input_is_parallel
+        if has_bias:
+            self.bias = self.create_parameter(shape=[out_features],
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = sharding_constraint(x, [None] * (ensure_tensor(x).ndim - 1) +
+                                    ['mp'])
+        out = F.linear(x, self.weight, self.bias)
+        # partial sums reduce automatically (XLA inserts psum over 'mp')
+        out = sharding_constraint(out, [None] * (out.ndim - 1) + [None])
+        return out
+
+
+class ParallelCrossEntropy(nn.Layer):
+    """Vocab-parallel softmax CE (reference: mp_layers.py:249 backed by
+    c_softmax_with_cross_entropy_op.cu). Under SPMD the plain CE lowers to
+    the same pattern when logits are sharded on vocab."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction='none',
+                               ignore_index=self.ignore_index)

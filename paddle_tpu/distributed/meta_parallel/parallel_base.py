@@ -1,0 +1,59 @@
+"""MetaParallel wrappers (reference: fleet/meta_parallel/pipeline_parallel.py
+PipelineParallel:32, tensor_parallel.py, sharding_parallel.py).
+
+These wrap a model per the hybrid config; the heavy lifting (shardings,
+schedules) is delegated to distributed/strategy.py and
+distributed/pipeline.py — under SPMD the wrapper's job is bookkeeping, not
+communication.
+"""
+from ..parallel import DataParallel
+
+
+class _MetaParallelBase:
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__['_layers'], name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    pass
+
+
+class ShardingParallel(_MetaParallelBase):
+    pass
+
+
+class PipelineParallel(_MetaParallelBase):
+    """train_batch parity (pipeline_parallel.py:109): runs the scan-based
+    1F1B/GPipe schedule from distributed/pipeline.py."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        self._engine = None
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ..pipeline import PipelineEngine
+        if self._engine is None:
+            self._engine = PipelineEngine(self._layers, optimizer,
+                                          self._hcg)
+        inputs, labels = data
+        loss = self._engine.step(inputs, labels)
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss

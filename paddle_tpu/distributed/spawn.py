@@ -1,0 +1,34 @@
+"""paddle.distributed.spawn (reference: distributed/spawn.py:333).
+
+On TPU, one process drives all local chips (single-controller SPMD), so
+nprocs defaults to 1 process and spawn degenerates to calling func; true
+multi-host spawn goes through `python -m paddle_tpu.distributed.launch`.
+"""
+import multiprocessing as mp
+import os
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    if nprocs in (-1, 0, 1, None):
+        func(*args)
+        return None
+    ctx = mp.get_context('spawn')
+    procs = []
+    for rank in range(nprocs):
+        env = {'PADDLE_TRAINER_ID': str(rank),
+               'PADDLE_TRAINERS_NUM': str(nprocs)}
+        p = ctx.Process(target=_wrap, args=(func, args, env), daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError('spawned process failed: %s' % p.exitcode)
+    return procs
+
+
+def _wrap(func, args, env):
+    os.environ.update(env)
+    func(*args)

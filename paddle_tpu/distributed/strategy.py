@@ -1,0 +1,141 @@
+"""Strategy compiler: DistributedStrategy -> shardings for TrainStep.
+
+This replaces the reference's fleet meta-optimizer program-rewrite pipeline
+(fleet/meta_optimizers/*): instead of inserting c_allreduce/broadcast ops
+into a ProgramDesc, each strategy contributes PartitionSpecs for params /
+optimizer slots / batch, and XLA's SPMD partitioner inserts the collectives
+(SURVEY.md §7.1 mapping table).
+
+  data_parallel      -> batch P('dp'), params replicated  => psum on grads
+  sharding (ZeRO1-3) -> opt slots / grads / params sharded on 'sharding'
+  tensor_parallel    -> per-param placements from layer hints (mp_layers)
+  sequence_parallel  -> activations sharded on 'sp' (long-context)
+"""
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework import functional as func_mod
+
+__all__ = ['build_shardings', 'shard_params_for_zero3']
+
+
+def _param_spec(placement, ndim, strategy, name=''):
+    """PartitionSpec for a param: TP placement hint (tuple aligned to shape)
+    + optional ZeRO-3 sharding of the largest remaining axis."""
+    dims = [None] * ndim
+    if placement:
+        for i, ax in enumerate(placement):
+            if ax is not None and i < ndim:
+                dims[i] = ax
+    if strategy.get('zero_stage', 0) >= 3 and ndim >= 1:
+        for i in range(ndim):
+            if dims[i] is None:
+                dims[i] = 'sharding'
+                break
+    return P(*dims)
+
+
+def build_shardings(model, optimizer, mesh, strategy=None):
+    """Returns kwargs for TrainStep: in_shardings/out_shardings/batch.
+
+    strategy keys: zero_stage (0/1/2/3), tensor_parallel (bool),
+    sequence_parallel (bool).
+    """
+    strategy = strategy or {}
+    params = func_mod.extract_params(model)
+    buffers = func_mod.extract_buffers(model)
+    pmap = dict(model.named_parameters())
+
+    def ns(spec):
+        return NamedSharding(mesh, spec)
+
+    replicated = ns(P())
+    param_shardings = {}
+    for name, arr in params.items():
+        placement = getattr(pmap[name], 'placement', None)
+        has_mp = 'mp' in mesh.axis_names and mesh.shape.get('mp', 1) > 1
+        if placement and not has_mp:
+            placement = None
+        spec = _param_spec(placement, arr.ndim, strategy, name)
+        # avoid sharding axes not divisible
+        dims = []
+        for i, ax in enumerate(spec):
+            if ax is not None and arr.shape[i] % mesh.shape.get(ax, 1) != 0:
+                dims.append(None)
+            else:
+                dims.append(ax)
+        param_shardings[name] = ns(P(*dims))
+
+    buffer_shardings = {name: replicated for name in buffers}
+
+    zero = strategy.get('zero_stage', 0)
+
+    def slot_sharding_for(name, arr):
+        if zero >= 1:
+            # shard optimizer state over the sharding axis on dim0 if divisible
+            if arr.ndim >= 1 and arr.shape[0] % max(
+                    mesh.shape.get('sharding', 1), 1) == 0 \
+                    and mesh.shape.get('sharding', 1) > 1:
+                return ns(P('sharding'))
+        return param_shardings[name]
+
+    # opt_state pytree: {'slots': {name: {slot: arr}}, 'step': scalar}
+    pmap_t = {n: p for n, p in model.named_parameters() if not p.stop_gradient}
+    slots_shardings = {}
+    for name, p in pmap_t.items():
+        slot = optimizer._get_slots(p)
+        slots_shardings[name] = {k: slot_sharding_for(name, v)
+                                 for k, v in slot.items()}
+    opt_shardings = {'slots': slots_shardings, 'step': replicated}
+
+    batch_axes = ['dp']
+    if 'sharding' in mesh.axis_names and mesh.shape.get('sharding', 1) > 1:
+        # ZeRO composes with dp over the batch: flatten both axes onto batch
+        batch_axes = [('dp', 'sharding')]
+    batch_spec = P(*batch_axes)
+    batch_sharding = ns(batch_spec)
+    scalar = replicated
+
+    # pure_step signature: (params, buffers, opt_state, batch, lr, key)
+    in_shardings = (param_shardings, buffer_shardings, opt_shardings,
+                    ((batch_sharding,), (batch_sharding,)), scalar, scalar)
+    out_shardings = (param_shardings, buffer_shardings, opt_shardings, scalar)
+    return {
+        'mesh': mesh,
+        'in_shardings': None,   # let jit infer from device_put of inputs
+        'out_shardings': out_shardings,
+        'batch_sharding': batch_sharding,
+        'param_shardings': param_shardings,
+    }
+
+
+def place_params(model, param_shardings):
+    """device_put every param/buffer onto its sharding (pre-step layout)."""
+    pmap = dict(model.named_parameters())
+    for name, sh in param_shardings.items():
+        p = pmap[name]
+        p._data = jax.device_put(p._data, sh)
+
+
+def place_opt_slots(model, optimizer, opt_shardings):
+    """Create+place optimizer slots per their shardings. Must run AFTER
+    place_params so zeros_like starts from the sharded param, and the
+    explicit device_put pins the slot layout the out_shardings promise
+    (donation requires in/out layouts to agree)."""
+    pmap = dict(model.named_parameters())
+    for name, slot_shs in opt_shardings['slots'].items():
+        p = pmap[name]
+        slots = optimizer._get_slots(p)
+        for k, sh in slot_shs.items():
+            slots[k] = jax.device_put(slots[k], sh)
+
+
+def shard_params_for_zero3(model, mesh):
+    place_params(model, build_shardings(
+        model, _NullOpt(), mesh, {'zero_stage': 3})['param_shardings'])
+
+
+class _NullOpt:
+    def _get_slots(self, p):
+        return {}

@@ -1,0 +1,160 @@
+"""Process/device topology (reference: fleet/base/topology.py:36
+CommunicateTopology + :117 HybridCommunicateGroup, 4-D [data, pipe, sharding,
+model] mesh).
+
+TPU-native: the topology IS a jax.sharding.Mesh. Axes (outer->inner):
+  dp (data), pp (pipeline), sharding (ZeRO), mp (tensor), sp (sequence).
+sp is beyond-reference (SURVEY.md §5.7 requires it). Axis order puts mp/sp
+innermost so tensor/sequence collectives ride the fastest ICI links.
+"""
+import collections
+import os
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_AXES = ('dp', 'pp', 'sharding', 'mp', 'sp')
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=('data', 'pipe', 'sharding', 'model'),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = collections.namedtuple('Coordinate',
+                                                 self._parallel_names)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        idx = [args[name] for name in self._parallel_names]
+        return int(np.ravel_multi_index(idx, self._dims))
+
+    def get_coord(self, rank):
+        return self.coordinate(*np.unravel_index(rank, self._dims))
+
+
+class HybridCommunicateGroup:
+    """Builds the global device mesh. Parity surface: get_data_parallel_rank
+    etc. (topology.py:123-136); the jax Mesh is exposed for the strategy
+    compiler."""
+
+    def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
+                 sharding_degree=1, sp_degree=1, devices=None):
+        devices = devices if devices is not None else jax.devices()
+        n = len(devices)
+        degrees = {'dp': dp_degree, 'pp': pp_degree,
+                   'sharding': sharding_degree, 'mp': mp_degree,
+                   'sp': sp_degree}
+        specified = int(np.prod([max(1, d) for d in degrees.values()]))
+        if dp_degree in (0, -1, None):
+            degrees['dp'] = n // (specified // max(1, dp_degree or 1)) \
+                if specified else n
+            rest = int(np.prod([max(1, degrees[a]) for a in
+                                ('pp', 'sharding', 'mp', 'sp')]))
+            degrees['dp'] = max(1, n // rest)
+        total = int(np.prod([max(1, degrees[a]) for a in _AXES]))
+        if total != n:
+            raise ValueError(
+                "product of parallel degrees %s != device count %d"
+                % (degrees, n))
+        self._degrees = degrees
+        shape = tuple(max(1, degrees[a]) for a in _AXES)
+        mesh_devices = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(mesh_devices, _AXES)
+        self.nranks = n
+        self.global_rank = 0
+
+    # -- per-axis parity accessors (reference names) ------------------------
+    def get_data_parallel_world_size(self):
+        return self._degrees['dp']
+
+    def get_model_parallel_world_size(self):
+        return self._degrees['mp']
+
+    def get_pipe_parallel_world_size(self):
+        return self._degrees['pp']
+
+    def get_sharding_parallel_world_size(self):
+        return self._degrees['sharding']
+
+    def get_sequence_parallel_world_size(self):
+        return self._degrees['sp']
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def topology(self):
+        return CommunicateTopology(
+            ('data', 'pipe', 'sharding', 'model'),
+            (self._degrees['dp'], self._degrees['pp'],
+             self._degrees['sharding'], self._degrees['mp']))
+
+    # group objects for collective API parity
+    def get_data_parallel_group(self):
+        return Group('dp', self._degrees['dp'])
+
+    def get_model_parallel_group(self):
+        return Group('mp', self._degrees['mp'])
+
+    def get_pipe_parallel_group(self):
+        return Group('pp', self._degrees['pp'])
+
+    def get_sharding_parallel_group(self):
+        return Group('sharding', self._degrees['sharding'])
+
+    def get_check_parallel_group(self):
+        return Group(None, self.nranks)
+
+
+class Group:
+    """Communicator handle: on TPU a group IS a mesh axis name (replaces
+    ring_id -> NCCLComm registry, platform/collective_helper.h:68)."""
+
+    def __init__(self, axis_name, nranks, ranks=None, gid=0):
+        self.axis_name = axis_name
+        self.nranks = nranks
+        self.ranks = ranks if ranks is not None else list(range(nranks))
+        self.id = gid
+        self.rank = 0
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return 'Group(axis=%s, nranks=%d)' % (self.axis_name, self.nranks)
+
+
+_GLOBAL_HCG = [None]
+
+
+def set_hybrid_communicate_group(hcg):
+    _GLOBAL_HCG[0] = hcg
+
+
+def get_hybrid_communicate_group():
+    return _GLOBAL_HCG[0]
+
+
+def default_mesh(axis='dp', devices=None):
+    """Single-axis mesh over all devices (pure-DP default)."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))

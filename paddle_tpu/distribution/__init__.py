@@ -1,0 +1,216 @@
+"""Probability distributions (reference: python/paddle/distribution.py)."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, wrap_out, run_op
+from ..framework import random as rng
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ['Distribution', 'Normal', 'Uniform', 'Categorical', 'Beta',
+           'Dirichlet', 'Exponential', 'Bernoulli', 'Multinomial', 'kl_divergence']
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..tensor.math import exp
+        return exp(self.log_prob(value))
+
+
+def _arr(x):
+    return ensure_tensor(x)._data if not isinstance(x, (int, float)) \
+        else jnp.asarray(float(x))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)
+        eps = jax.random.normal(rng.next_key(), shp)
+        return wrap_out(self.loc + self.scale * eps)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        return wrap_out(0.5 + 0.5 * math.log(2 * math.pi) +
+                        jnp.log(self.scale) * jnp.ones_like(self.loc))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+
+        def fn(x):
+            var = self.scale ** 2
+            return -((x - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) \
+                - 0.5 * math.log(2 * math.pi)
+        return run_op('normal_log_prob', fn, v)
+
+    def kl_divergence(self, other):
+        var_a = self.scale ** 2
+        var_b = other.scale ** 2
+        return wrap_out(jnp.log(other.scale / self.scale) +
+                        (var_a + (self.loc - other.loc) ** 2) / (2 * var_b) - 0.5)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                  self.high.shape)
+        u = jax.random.uniform(rng.next_key(), shp)
+        return wrap_out(self.low + (self.high - self.low) * u)
+
+    def entropy(self):
+        return wrap_out(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)
+
+        def fn(x):
+            inside = (x >= self.low) & (x < self.high)
+            return jnp.where(inside, -jnp.log(self.high - self.low), -np.inf)
+        return run_op('uniform_log_prob', fn, v)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _arr(logits)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(rng.next_key(), self.logits,
+                                     shape=tuple(shape) + self.logits.shape[:-1])
+        return wrap_out(out.astype(jnp.int64))
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits, -1)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return wrap_out(-jnp.sum(p * logp, -1))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)._data.astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return wrap_out(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def probs(self, value):
+        v = ensure_tensor(value)._data.astype(jnp.int32)
+        p = jax.nn.softmax(self.logits, -1)
+        return wrap_out(jnp.take_along_axis(p, v[..., None], -1)[..., 0])
+
+    def kl_divergence(self, other):
+        p = jax.nn.softmax(self.logits, -1)
+        return wrap_out(jnp.sum(p * (jax.nn.log_softmax(self.logits, -1) -
+                                     jax.nn.log_softmax(other.logits, -1)), -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                  self.beta.shape)
+        return wrap_out(jax.random.beta(rng.next_key(), self.alpha, self.beta,
+                                        shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = ensure_tensor(value)._data
+        return wrap_out((self.alpha - 1) * jnp.log(v) +
+                        (self.beta - 1) * jnp.log1p(-v) -
+                        betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return wrap_out(betaln(a, b) - (a - 1) * digamma(a) -
+                        (b - 1) * digamma(b) +
+                        (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+
+    def sample(self, shape=()):
+        return wrap_out(jax.random.dirichlet(rng.next_key(),
+                                             self.concentration,
+                                             tuple(shape)))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = ensure_tensor(value)._data
+        a = self.concentration
+        return wrap_out(jnp.sum((a - 1) * jnp.log(v), -1) +
+                        gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.rate.shape
+        return wrap_out(jax.random.exponential(rng.next_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)._data
+        return wrap_out(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return wrap_out(1.0 - jnp.log(self.rate))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.p = _arr(probs)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.p.shape
+        return wrap_out(jax.random.bernoulli(
+            rng.next_key(), self.p, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = ensure_tensor(value)._data
+        return wrap_out(v * jnp.log(self.p) + (1 - v) * jnp.log1p(-self.p))
+
+    def entropy(self):
+        p = self.p
+        return wrap_out(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.n = int(total_count)
+        self.p = _arr(probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.p, 1e-30))
+        draws = jax.random.categorical(
+            rng.next_key(), logits,
+            shape=tuple(shape) + (self.n,) + self.p.shape[:-1])
+        k = self.p.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return wrap_out(jnp.sum(onehot, axis=len(shape)))
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

@@ -1,0 +1,14 @@
+"""Framework core: Tensor, autograd tape, dtype/device/random/flags."""
+from .core import (Tensor, Parameter, run_op, to_tensor, no_grad_guard,
+                   is_grad_enabled, set_grad_enabled, wrap_out, as_jax)
+from .dtype import (convert_dtype, to_jax_dtype, set_default_dtype,
+                    get_default_dtype)
+from .device import set_device, get_device, device_count
+from .random import seed, get_rng_state, set_rng_state, default_generator
+
+# legacy namespace parity: paddle.fluid.core-ish accessors
+in_dygraph_mode = lambda: True
+
+
+def _non_static_mode():
+    return True

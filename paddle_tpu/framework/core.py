@@ -1,0 +1,524 @@
+"""Tensor facade + eager autograd tape.
+
+This is the TPU-native replacement for the reference's dygraph stack:
+  - VarBase / VariableWrapper  (paddle/fluid/imperative/layer.h:66)
+  - Tracer::TraceOp            (paddle/fluid/imperative/tracer.cc:144)
+  - BasicEngine backward       (paddle/fluid/imperative/basic_engine.cc:305)
+  - GradientAccumulator        (paddle/fluid/imperative/gradient_accumulator.h)
+
+Design: a `Tensor` wraps a jax.Array (or a jax tracer when inside a jit
+trace). Eager ops run through `run_op`, which — when gradients are required —
+obtains the op's VJP via `jax.vjp` and records a `GradNode` on the tape.
+`Tensor.backward()` walks the node graph in reverse topological order,
+accumulating cotangents, exactly like BasicEngine's dep-counted queue but
+functional underneath: every node's backward is a pure jax function, so the
+whole thing jits and fuses when wrapped (see framework/functional.py).
+
+There is deliberately NO per-op kernel registry / ExecutionContext: XLA is the
+kernel library, dispatch is jnp/lax. The "op table" the reference needs for
+its registry (op name -> impl) lives in tensor/* as plain python functions.
+"""
+import weakref
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+
+__all__ = [
+    'Tensor', 'Parameter', 'run_op', 'no_grad_guard', 'is_grad_enabled',
+    'set_grad_enabled', 'to_tensor', 'as_jax', 'wrap_out',
+]
+
+# ---------------------------------------------------------------------------
+# global tracer state
+# ---------------------------------------------------------------------------
+
+class _TracerState:
+    __slots__ = ('has_grad', 'inside_functional')
+
+    def __init__(self):
+        self.has_grad = True
+        self.inside_functional = False
+
+
+_tracer = _TracerState()
+
+
+def is_grad_enabled():
+    return _tracer.has_grad
+
+
+def set_grad_enabled(flag):
+    _tracer.has_grad = bool(flag)
+
+
+class no_grad_guard:
+    """Context manager / decorator disabling the tape (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = _tracer.has_grad
+        _tracer.has_grad = False
+        return self
+
+    def __exit__(self, *exc):
+        _tracer.has_grad = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with no_grad_guard():
+                return fn(*a, **kw)
+        return wrapper
+
+
+class enable_grad_guard:
+    def __enter__(self):
+        self._prev = _tracer.has_grad
+        _tracer.has_grad = True
+        return self
+
+    def __exit__(self, *exc):
+        _tracer.has_grad = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# autograd tape
+# ---------------------------------------------------------------------------
+
+class GradNode:
+    """One recorded op: holds the vjp closure + input edges.
+
+    Mirrors the reference's GradOpNode (imperative/op_base.h) but the
+    "grad kernel" is jax.vjp's closure instead of a registered grad op.
+    """
+    __slots__ = ('name', 'vjp_fn', 'inputs', 'out_avals', 'out_refs', '__weakref__')
+
+    def __init__(self, name, vjp_fn, inputs, out_avals):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs            # list[Tensor] (positional primals)
+        self.out_avals = out_avals      # list[(shape, jnp dtype)]
+        self.out_refs = []              # weakrefs to output tensors
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+
+
+def _topo_order(root_node):
+    """Post-order DFS over GradNodes (iterative; graphs can be deep)."""
+    order, visited = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            n = t._grad_node
+            if n is not None and id(n) not in visited:
+                stack.append((n, False))
+    return order  # leaves first, root last
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def backward_engine(tensors, grad_tensors=None, retain_graph=False):
+    """Reverse-mode sweep from `tensors` (paddle.autograd.backward parity)."""
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # node -> list of pending output cotangents
+    pending = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node = t._grad_node
+        if node is None:
+            # leaf with stop_gradient=False: backward() just seeds .grad
+            if not t.stop_gradient:
+                seed = g._data if isinstance(g, Tensor) else (
+                    jnp.ones(t.shape, t._data.dtype) if g is None else jnp.asarray(g))
+                t._accumulate_grad(seed)
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "tensor has shape %s" % (t.shape,))
+            seed = jnp.ones(t.shape, t._data.dtype)
+        else:
+            seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        cots = pending.setdefault(id(node), [None] * len(node.out_avals))
+        cots[t._node_out_idx] = _accumulate(cots[t._node_out_idx], seed)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    # union topological order over all roots
+    order, seen = [], set()
+    for r in roots:
+        for n in _topo_order(r):
+            if id(n) not in seen:
+                seen.add(id(n))
+                order.append(n)
+    node_set = seen
+
+    for node in reversed(order):
+        cots = pending.pop(id(node), None)
+        if cots is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "trying to backward through the graph a second time (op %r): "
+                "the saved intermediate results were freed. Pass "
+                "retain_graph=True to the first backward call." % node.name)
+        full = []
+        for i, (shape, dt) in enumerate(node.out_avals):
+            c = cots[i]
+            full.append(jnp.zeros(shape, dt) if c is None else c)
+        in_grads = node.vjp_fn(tuple(full) if len(full) > 1 else full[0])
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or t.stop_gradient:
+                continue
+            producer = t._grad_node
+            if producer is not None and id(producer) in node_set:
+                # non-leaf: hooks transform the flowing gradient (paddle
+                # register_hook semantics) before it propagates further
+                if t._hooks:
+                    gt = Tensor(g)
+                    for h in list(t._hooks.values()):
+                        out = h(gt)
+                        if out is not None:
+                            gt = out if isinstance(out, Tensor) else Tensor(out)
+                    g = gt._data
+                pc = pending.setdefault(id(producer), [None] * len(producer.out_avals))
+                pc[t._node_out_idx] = _accumulate(pc[t._node_out_idx], g)
+            else:
+                t._accumulate_grad(g)
+        if not retain_graph:
+            node.release()
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+def as_jax(x, dtype=None):
+    """Unwrap Tensor / convert python scalar or ndarray to a jax value."""
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (jnp.ndarray, jax.Array)) or hasattr(x, 'aval'):
+        return x
+    return jnp.asarray(x, dtype=dtype_mod.to_jax_dtype(dtype) if dtype else None)
+
+
+class Tensor:
+    """Eager tensor: jax.Array + grad metadata.
+
+    API parity target: paddle.Tensor (python/paddle/fluid/dygraph/
+    varbase_patch_methods.py + math_op_patch.py). Methods for the wide tensor
+    API are attached by paddle_tpu.tensor at import time (monkey-patch, same
+    mechanism the reference uses).
+    """
+    __slots__ = ('_data', 'stop_gradient', '_grad', '_grad_node',
+                 '_node_out_idx', 'persistable', 'name', '_hooks', '__weakref__')
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        self._data = as_jax(data, dtype)
+        if dtype is not None:
+            jd = dtype_mod.to_jax_dtype(dtype)
+            if self._data.dtype != jd:
+                self._data = self._data.astype(jd)
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._node_out_idx = 0
+        self.persistable = False
+        self.name = name or ''
+        self._hooks = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return dtype_mod.convert_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        devs = getattr(self._data, 'devices', None)
+        if devs is None:
+            return 'traced'
+        ds = devs() if callable(devs) else devs
+        d = next(iter(ds))
+        return "%s:%d" % (d.platform, d.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # -- grad ---------------------------------------------------------------
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    def _accumulate_grad(self, g_array):
+        if self._grad is None:
+            self._grad = Tensor(g_array, stop_gradient=True)
+        else:
+            self._grad = Tensor(self._grad._data + g_array, stop_gradient=True)
+        if self._hooks:
+            for h in list(self._hooks.values()):
+                out = h(self._grad)
+                if out is not None:
+                    self._grad = out if isinstance(out, Tensor) else Tensor(out)
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward_engine([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    _hook_counter = [0]
+
+    def register_hook(self, hook):
+        if self._hooks is None:
+            self._hooks = {}
+        Tensor._hook_counter[0] += 1
+        hid = Tensor._hook_counter[0]
+        self._hooks[hid] = hook
+
+        class _Removable:
+            def __init__(self, d, k):
+                self._d, self._k = d, k
+
+            def remove(self):
+                self._d.pop(self._k, None)
+        return _Removable(self._hooks, hid)
+
+    # -- value access -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def set_value(self, value):
+        """In-place value swap (keeps grad metadata); optimizer update path."""
+        arr = as_jax(value)
+        self._data = arr.astype(self._data.dtype) if arr.dtype != self._data.dtype else arr
+
+    def _copy_from(self, other):
+        self._data = other._data if isinstance(other, Tensor) else as_jax(other)
+
+    def clone(self):
+        from ..tensor.manipulation import _identity_op
+        return _identity_op(self)
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices('cpu')[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **kw):
+        return self
+
+    def to(self, *args, **kwargs):
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ('cpu', 'tpu', 'gpu'):
+                pass
+            else:
+                try:
+                    t = t.astype(a)
+                except TypeError:
+                    pass
+        return t
+
+    @property
+    def block(self):  # legacy static-graph attr; harmless stub
+        return None
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _is_initialized(self):
+        return True
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_flag = ", stop_gradient=%s" % self.stop_gradient
+        return "Tensor(shape=%s, dtype=%s%s,\n       %s)" % (
+            self.shape, self.dtype, grad_flag, np.asarray(self._data))
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __float__(self):
+        return float(self.item())
+
+    def __index__(self):
+        return int(self.item())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, *a, **kw):
+        return self._data.__dlpack__(*a, **kw)
+
+    # math dunders / tensor methods are patched in by paddle_tpu.tensor
+
+
+class Parameter(Tensor):
+    """Trainable tensor (paddle.fluid.framework.Parameter parity)."""
+    __slots__ = ('trainable', 'optimize_attr', 'regularizer', 'need_clip',
+                 'is_distributed', 'placement')
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {'learning_rate': 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+        # distributed placement: PartitionSpec-style tuple aligned to self.shape,
+        # consumed by the train-step compiler (distributed/spec.py)
+        self.placement = None
+
+    def __repr__(self):
+        return "Parameter(shape=%s, dtype=%s, trainable=%s,\n       %s)" % (
+            self.shape, self.dtype, self.trainable, np.asarray(self._data))
+
+
+# ---------------------------------------------------------------------------
+# the op runner (Tracer::TraceOp equivalent)
+# ---------------------------------------------------------------------------
+
+def wrap_out(arr, requires_grad=False):
+    return Tensor(arr, stop_gradient=not requires_grad)
+
+
+# set by paddle_tpu.amp at import: fn(op_name, [arrays]) -> [arrays]
+_amp_cast_hook = [None]
+
+# when set to a dict, run_op records every Parameter flowing through it —
+# used by jit.to_static to discover closed-over params of plain functions
+_param_recorder = [None]
+
+
+def run_op(name, fn, *inputs, n_outputs=None):
+    """Run op `fn` over Tensor `inputs`; record VJP on the tape when needed.
+
+    fn: pure function over jax arrays (attrs closed over), returning one
+    array or a tuple of arrays (ALL outputs must be differentiable-dtype if
+    any input requires grad — mixed-output ops must pre-split, see module doc).
+    """
+    tensors = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]
+    if _param_recorder[0] is not None:
+        for t in tensors:
+            if isinstance(t, Parameter):
+                _param_recorder[0][id(t)] = t
+    arrays = [t._data for t in tensors]
+    if _amp_cast_hook[0] is not None:
+        arrays = _amp_cast_hook[0](name, arrays)
+    needs_grad = _tracer.has_grad and any(not t.stop_gradient for t in tensors)
+
+    if not needs_grad:
+        out = fn(*arrays)
+        if isinstance(out, tuple):
+            return tuple(wrap_out(o) for o in out)
+        return wrap_out(out)
+
+    out, vjp_fn = jax.vjp(fn, *arrays)
+    multi = isinstance(out, tuple)
+    outs = out if multi else (out,)
+    node = GradNode(name, vjp_fn, tensors, [(o.shape, o.dtype) for o in outs])
+    wrapped = []
+    for i, o in enumerate(outs):
+        t = wrap_out(o, requires_grad=True)
+        t._grad_node = node
+        t._node_out_idx = i
+        node.out_refs.append(weakref.ref(t))
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

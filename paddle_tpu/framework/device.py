@@ -1,0 +1,75 @@
+"""Device management: paddle.set_device / get_device parity over jax devices.
+
+Reference parity: paddle/fluid/platform/place.h (Place variants) and
+python/paddle/device/__init__.py. TPU-native: a "place" is a jax.Device; the
+default device is jax's default; 'tpu:3' selects jax.devices('tpu')[3].
+"""
+import jax
+
+_STATE = {'device': None}  # None means jax default
+
+
+def _backend_of(name):
+    name = name.lower()
+    if name in ('gpu', 'cuda'):
+        return 'gpu'
+    if name in ('cpu',):
+        return 'cpu'
+    if name in ('tpu', 'xpu', 'npu', 'xla'):
+        # reference XPU/NPU places map to the accelerator backend here
+        return 'tpu'
+    raise ValueError("unknown device %r" % name)
+
+
+def set_device(device):
+    """Select the current device, e.g. 'tpu', 'cpu', 'tpu:0'."""
+    if isinstance(device, jax.Device):
+        _STATE['device'] = device
+        return device
+    name, _, idx = str(device).partition(':')
+    backend = _backend_of(name)
+    try:
+        devs = jax.devices(backend)
+    except RuntimeError:
+        # graceful fallback (e.g. asking for tpu on a cpu-only host)
+        devs = jax.devices()
+    dev = devs[int(idx)] if idx else devs[0]
+    _STATE['device'] = dev
+    return dev
+
+
+def get_device():
+    dev = _STATE['device']
+    if dev is None:
+        dev = jax.devices()[0]
+    plat = dev.platform
+    if plat == 'TPU':
+        plat = 'tpu'
+    return "%s:%d" % (plat, dev.id)
+
+
+def current_jax_device():
+    return _STATE['device']
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def device_count(backend=None):
+    try:
+        return len(jax.devices(backend) if backend else jax.devices())
+    except RuntimeError:
+        return 0

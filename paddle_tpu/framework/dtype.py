@@ -1,0 +1,92 @@
+"""Dtype registry: paddle-style dtype names over jax/numpy dtypes.
+
+Reference parity: paddle/fluid/framework/framework.proto:106 (VarType) and
+python/paddle/fluid/data_feeder.py convert_dtype. TPU-native notes:
+ - bfloat16 is the preferred half dtype (MXU-native); float16 is supported
+   but second-class.
+ - 'int64'/'float64' are ACCEPTED everywhere but stored as int32/float32
+   unless jax x64 mode is enabled: TPUs have no fast 64-bit path, and the
+   32-bit default is what the reference effectively uses on accelerators
+   too (indices cast to int32 in kernels).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+# canonical name -> jnp dtype
+_NAME2DTYPE = {
+    'bool': jnp.bool_,
+    'uint8': jnp.uint8,
+    'int8': jnp.int8,
+    'int16': jnp.int16,
+    'int32': jnp.int32,
+    'int64': jnp.int64,
+    'float16': jnp.float16,
+    'bfloat16': jnp.bfloat16,
+    'float32': jnp.float32,
+    'float64': jnp.float64,
+    'complex64': jnp.complex64,
+    'complex128': jnp.complex128,
+}
+
+_ALIASES = {
+    'float': 'float32', 'double': 'float64', 'half': 'float16',
+    'int': 'int32', 'long': 'int64', 'bf16': 'bfloat16', 'fp16': 'float16',
+    'fp32': 'float32', 'fp64': 'float64',
+}
+
+FLOAT_DTYPES = ('float16', 'bfloat16', 'float32', 'float64')
+INT_DTYPES = ('uint8', 'int8', 'int16', 'int32', 'int64')
+COMPLEX_DTYPES = ('complex64', 'complex128')
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype) to canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _NAME2DTYPE:
+            return name
+        raise TypeError("unsupported dtype: %r" % (dtype,))
+    # jnp dtypes / numpy dtypes / python types
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, '__name__', str(dtype))
+    name = _ALIASES.get(name, name)
+    if name in _NAME2DTYPE:
+        return name
+    raise TypeError("unsupported dtype: %r" % (dtype,))
+
+
+def to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    return _NAME2DTYPE[convert_dtype(dtype)]
+
+
+def is_floating(dtype):
+    return convert_dtype(dtype) in FLOAT_DTYPES
+
+
+def is_integer(dtype):
+    return convert_dtype(dtype) in INT_DTYPES
+
+
+def is_complex(dtype):
+    return convert_dtype(dtype) in COMPLEX_DTYPES
+
+
+_DEFAULT_DTYPE = ['float32']
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity."""
+    name = convert_dtype(d)
+    if name not in FLOAT_DTYPES:
+        raise TypeError("default dtype must be floating, got %s" % name)
+    _DEFAULT_DTYPE[0] = name
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]

@@ -1,0 +1,91 @@
+"""Typed runtime flag system (reference: paddle/fluid/platform/flags.cc ~60
+gflags + pybind/global_value_getter_setter.cc).
+
+One registry: env-var override (FLAGS_*) at import, paddle.set_flags at
+runtime. TPU-relevant flags map onto XLA/jax config where meaningful; the
+rest are accepted for API compat and readable back.
+"""
+import os
+
+_REGISTRY = {}
+
+
+class _Flag:
+    __slots__ = ('name', 'value', 'typ', 'help', 'on_set')
+
+    def __init__(self, name, default, typ, help='', on_set=None):
+        self.name = name
+        self.value = default
+        self.typ = typ
+        self.help = help
+        self.on_set = on_set
+
+
+def define_flag(name, default, help='', on_set=None):
+    typ = type(default)
+    f = _Flag(name, default, typ, help, on_set)
+    env = os.environ.get('FLAGS_' + name)
+    if env is not None:
+        f.value = _parse(env, typ)
+    _REGISTRY[name] = f
+    return f
+
+
+def _parse(s, typ):
+    if typ is bool:
+        return s.lower() in ('1', 'true', 'yes')
+    return typ(s)
+
+
+def set_flags(flags):
+    for k, v in flags.items():
+        name = k[6:] if k.startswith('FLAGS_') else k
+        if name not in _REGISTRY:
+            define_flag(name, v)
+        else:
+            f = _REGISTRY[name]
+            f.value = _parse(v, f.typ) if isinstance(v, str) and f.typ is not str else v
+            if f.on_set:
+                f.on_set(f.value)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith('FLAGS_') else k
+        if name in _REGISTRY:
+            out[k] = _REGISTRY[name].value
+    return out
+
+
+def flag_value(name, default=None):
+    f = _REGISTRY.get(name)
+    return f.value if f is not None else default
+
+
+def _set_debug_nans(v):
+    import jax
+    jax.config.update('jax_debug_nans', bool(v))
+
+
+# reference flag parity (subset that means something on TPU)
+define_flag('check_nan_inf', False,
+            'scan op outputs for nan/inf (platform/flags.cc:44)',
+            on_set=_set_debug_nans)
+define_flag('fraction_of_gpu_memory_to_use', 0.92,
+            'accepted for compat; XLA BFC handles TPU HBM')
+define_flag('allocator_strategy', 'auto_growth', 'compat only')
+define_flag('cudnn_deterministic', True, 'XLA on TPU is deterministic')
+define_flag('benchmark', False, 'sync-per-op timing mode')
+define_flag('paddle_num_threads', 1, 'host threads hint')
+define_flag('use_pinned_memory', True, 'compat only')
+define_flag('eager_delete_tensor_gb', 0.0, 'compat only (XLA manages)')
+define_flag('max_inplace_grad_add', 0, 'compat only')
+define_flag('cudnn_exhaustive_search', False, 'XLA autotuning is implicit')
+define_flag('sort_sum_gradient', False, 'compat only')
+define_flag('tpu_matmul_precision', 'default',
+            'jax default_matmul_precision for MXU',
+            on_set=lambda v: __import__('jax').config.update(
+                'jax_default_matmul_precision', v if v != 'default' else None))

@@ -1,0 +1,271 @@
+"""Functionalization bridge: mutable Layers <-> pure jax functions.
+
+This is the linchpin of the TPU design (SURVEY.md §7.4 hard-part #1): the
+paddle-style API is stateful (Layers own Parameters, optimizers update
+in-place, BN mutates running stats), but XLA wants pure functions over
+pytrees. `functional_call` temporarily binds tracer arrays into the live
+Parameter/buffer objects, runs the layer's ordinary forward, then harvests
+mutated buffer values as explicit outputs — so ONE code path serves eager
+and compiled execution (the reference needed two: dygraph + ProgramDesc).
+
+`TrainStep` composes model + loss + optimizer into a single jitted
+(params, opt_state, batch, rng) -> (params, opt_state, loss) function with
+donated buffers — the XLA-native replacement for the reference's
+executor-driven training loop, and the unit over which distributed
+strategies apply shardings (distributed/strategy.py).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core import Tensor, Parameter, no_grad_guard
+from . import random as rng_mod
+
+__all__ = ['extract_params', 'extract_buffers', 'functional_call', 'TrainStep']
+
+
+def extract_params(layer, trainable_only=False):
+    """OrderedDict name -> jax array of the layer's parameters."""
+    out = {}
+    for name, p in layer.named_parameters():
+        if trainable_only and p.stop_gradient:
+            continue
+        out[name] = p._data
+    return out
+
+
+def extract_buffers(layer):
+    out = {}
+    for name, b in layer.named_buffers():
+        if b is not None:
+            out[name] = b._data
+    return out
+
+
+def _bind(layer, params, buffers):
+    """Swap arrays into live tensors; returns restore list."""
+    saved = []
+    pmap = dict(layer.named_parameters())
+    bmap = dict(layer.named_buffers())
+    for name, arr in params.items():
+        t = pmap[name]
+        saved.append((t, t._data))
+        t._data = arr
+    for name, arr in (buffers or {}).items():
+        t = bmap.get(name)
+        if t is None:
+            continue
+        saved.append((t, t._data))
+        t._data = arr
+    return saved, bmap
+
+
+def functional_call(layer, params, buffers, args=(), kwargs=None,
+                    training=None):
+    """Run layer.forward with `params`/`buffers` arrays bound in.
+
+    Returns (outputs_as_arrays, new_buffers_dict). Safe under jit tracing:
+    any buffer mutated by forward (e.g. BN running stats) comes back as a
+    traced output instead of leaking a tracer into the live object.
+    """
+    kwargs = kwargs or {}
+    prev_mode = layer.training
+    if training is not None:
+        layer.training = training
+        for l in layer.sublayers(include_self=True):
+            l.training = training
+    saved, bmap = _bind(layer, params, buffers)
+    try:
+        targs = [Tensor(a, stop_gradient=False) if isinstance(
+            a, (jnp.ndarray, jax.Array)) or hasattr(a, 'aval') else a
+            for a in args]
+        out = layer(*targs, **kwargs)
+        new_buffers = {name: t._data for name, t in bmap.items()
+                       if t is not None}
+
+        def unwrap(o):
+            if isinstance(o, Tensor):
+                return o._data
+            if isinstance(o, (list, tuple)):
+                return type(o)(unwrap(x) for x in o)
+            if isinstance(o, dict):
+                return {k: unwrap(v) for k, v in o.items()}
+            return o
+        return unwrap(out), new_buffers
+    finally:
+        for t, arr in saved:
+            t._data = arr
+        if training is not None:
+            layer.training = prev_mode
+            for l in layer.sublayers(include_self=True):
+                l.training = prev_mode
+
+
+def write_back_params(layer, params):
+    pmap = dict(layer.named_parameters())
+    for name, arr in params.items():
+        pmap[name]._data = arr
+
+
+def write_back_buffers(layer, buffers):
+    bmap = dict(layer.named_buffers())
+    for name, arr in buffers.items():
+        if name in bmap and bmap[name] is not None:
+            bmap[name]._data = arr
+
+
+class TrainStep:
+    """Compiled training step: forward + backward + optimizer update fused
+    into one XLA program.
+
+    loss_fn(model_out..., *labels) -> scalar Tensor, built from paddle ops.
+    Shardings (distributed strategies) are injected via `shard_fn`, a
+    callback mapping (param_name, array) -> jax.sharding spec; see
+    distributed/strategy.py.
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True,
+                 in_shardings=None, out_shardings=None, mesh=None,
+                 batch_sharding=None, grad_sync=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._jitted = None
+        self._mesh = mesh
+        self._in_shardings = in_shardings
+        self._out_shardings = out_shardings
+        self._batch_sharding = batch_sharding
+        self._grad_sync = grad_sync
+        self._donate = donate
+        self._param_names = list(extract_params(model).keys())
+        self._trainable = {name: not p.stop_gradient
+                           for name, p in model.named_parameters()}
+
+    # -- optimizer state pytree --------------------------------------------
+    def _opt_state(self):
+        opt = self.optimizer
+        pmap = dict(self.model.named_parameters())
+        slots = {}
+        for name in self._param_names:
+            if self._trainable[name]:
+                slots[name] = dict(opt._get_slots(pmap[name]))
+        return {'slots': slots, 'step': jnp.asarray(opt._step_count, jnp.int32)}
+
+    def _write_opt_state(self, state):
+        opt = self.optimizer
+        pmap = dict(self.model.named_parameters())
+        for name, s in state['slots'].items():
+            opt._slots[id(pmap[name])] = dict(s)
+        opt._step_count = int(state['step'])
+
+    # -- the pure step ------------------------------------------------------
+    def _build(self, sample_batch):
+        model, opt, loss_fn = self.model, self.optimizer, self.loss_fn
+        trainable = self._trainable
+        grad_sync = self._grad_sync
+        pmeta = dict(model.named_parameters())  # metadata: need_clip, lr, reg
+
+        def pure_step(params, buffers, opt_state, batch, lr, key):
+            inputs, labels = batch
+
+            def compute_loss(train_params):
+                all_params = dict(params)
+                all_params.update(train_params)
+                gen = rng_mod.default_generator()
+                saved_key = gen._key
+                gen._key = key
+                try:
+                    out, new_buf = functional_call(model, all_params, buffers,
+                                                   args=inputs, training=True)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    t_outs = [Tensor(o, stop_gradient=False) for o in outs]
+                    t_labels = [Tensor(l) for l in labels]
+                    loss_t = loss_fn(*t_outs, *t_labels)
+                finally:
+                    gen._key = saved_key
+                return loss_t._data, new_buf
+
+            train_params = {k: v for k, v in params.items() if trainable[k]}
+            (loss, new_buffers), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(train_params)
+            if grad_sync is not None:
+                grads = grad_sync(grads)
+
+            # mirror Optimizer.step()'s full semantics in pure form:
+            # grad clip -> (coupled) weight decay / regularizer ->
+            # per-param lr -> update rule -> decoupled decay (AdamW)
+            if opt._grad_clip is not None:
+                names = list(grads.keys())
+                pg = [(pmeta[n], Tensor(grads[n])) for n in names]
+                clipped = opt._grad_clip(pg)
+                grads = {n: (g._data if isinstance(g, Tensor) else g)
+                         for n, (_, g) in zip(names, clipped)}
+            coeff = opt._decay_coeff()
+            decoupled = opt._apply_decoupled_decay()
+            decay_fun = getattr(opt, '_apply_decay_param_fun', None)
+
+            t = opt_state['step'] + 1
+            new_slots = {}
+            new_params = dict(params)
+            for name, g in grads.items():
+                p = params[name]
+                g = g.astype(p.dtype)
+                meta = pmeta[name]
+                if coeff and not decoupled:
+                    g = g + coeff * p
+                if meta.regularizer is not None:
+                    g = meta.regularizer._append(g, p)
+                plr = lr * meta.optimize_attr.get('learning_rate', 1.0)
+                if coeff and decoupled and \
+                        (decay_fun is None or decay_fun(meta.name)):
+                    p = p * (1.0 - plr * coeff)
+                new_p, slots = opt._apply(p, g, opt_state['slots'][name],
+                                          plr, t)
+                new_params[name] = new_p
+                new_slots[name] = slots
+            return new_params, new_buffers, \
+                {'slots': new_slots, 'step': t}, loss
+
+        jit_kwargs = {}
+        if self._donate:
+            jit_kwargs['donate_argnums'] = (0, 2)
+        if self._in_shardings is not None:
+            jit_kwargs['in_shardings'] = self._in_shardings
+        if self._out_shardings is not None:
+            jit_kwargs['out_shardings'] = self._out_shardings
+        return jax.jit(pure_step, **jit_kwargs)
+
+    def __call__(self, inputs, labels):
+        """One step; returns the loss as a Tensor."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = (inputs,)
+        if not isinstance(labels, (list, tuple)):
+            labels = (labels,)
+        in_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                          for a in inputs)
+        lab_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                           for a in labels)
+        if self._batch_sharding is not None:
+            in_arrays = tuple(jax.device_put(a, self._batch_sharding)
+                              for a in in_arrays)
+            lab_arrays = tuple(jax.device_put(a, self._batch_sharding)
+                               for a in lab_arrays)
+        if self._jitted is None:
+            self._jitted = self._build((in_arrays, lab_arrays))
+        params = extract_params(self.model)
+        buffers = extract_buffers(self.model)
+        opt_state = self._opt_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = rng_mod.next_key()
+        new_params, new_buffers, new_opt_state, loss = self._jitted(
+            params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)
+        write_back_params(self.model, new_params)
+        write_back_buffers(self.model, new_buffers)
+        self._write_opt_state(new_opt_state)
+        if isinstance(self.optimizer._lr, object) and hasattr(
+                self.optimizer._lr, 'step') and not isinstance(
+                self.optimizer._lr, (int, float)):
+            pass  # LR scheduler stepping left to the user loop (paddle parity)
+        return Tensor(loss)

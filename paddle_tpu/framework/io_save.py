@@ -1,0 +1,68 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py).
+
+Format: pickled nested structure with numpy leaves (reference-compatible
+shape); Tensors serialize as numpy arrays and load back as Tensors.
+Large-scale sharded checkpointing lives in distributed/checkpoint.py (orbax).
+"""
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor, Parameter
+
+__all__ = ['save', 'load']
+
+_PROTOCOL = 4
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data), type(obj).__name__,
+                              obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    def __init__(self, array, kind, name, stop_gradient):
+        self.array = array
+        self.kind = kind
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        if obj.kind == 'Parameter':
+            p = Parameter(obj.array, name=obj.name)
+            return p
+        return Tensor(obj.array, stop_gradient=obj.stop_gradient,
+                      name=obj.name)
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'wb') as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get('return_numpy', False)
+    with open(path, 'rb') as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy)

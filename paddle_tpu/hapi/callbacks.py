@@ -1,0 +1,236 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py)."""
+import os
+import time
+
+import numpy as np
+
+__all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
+           'EarlyStopping', 'VisualDL', 'config_callbacks']
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = callbacks or []
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        if name.startswith('on_'):
+            def call(*args, **kwargs):
+                for c in self.callbacks:
+                    getattr(c, name)(*args, **kwargs)
+            return call
+        raise AttributeError(name)
+
+
+class Callback:
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get('steps', None)
+        self._t0 = time.time()
+        if self.verbose:
+            print('Epoch %d/%d' % (epoch + 1, self.params.get('epochs', 1)))
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            msgs = []
+            for k, v in (logs or {}).items():
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    v = np.asarray(v).reshape(-1)
+                    msgs.append('%s: %.4f' % (k, float(v[0])))
+                elif isinstance(v, (int, float)):
+                    msgs.append('%s: %.4f' % (k, v))
+            dt = time.time() - self._t0
+            print('step %s/%s - %s - %.0fms/step' % (
+                step + 1, self.steps or '?', ' - '.join(msgs),
+                1000 * dt / (step + 1)))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            print('Epoch %d done, %.1fs' % (epoch + 1, time.time() - self._t0))
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, 'final'))
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, '_optimizer', None)
+        from ..optimizer.lr import LRScheduler as Sched
+        if opt and isinstance(opt._lr, Sched):
+            return opt._lr
+        return None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor='loss', mode='auto', patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.stopped_epoch = 0
+        if mode == 'max' or (mode == 'auto' and 'acc' in monitor):
+            self.monitor_op = np.greater
+            self.min_delta *= 1
+        else:
+            self.monitor_op = np.less
+            self.min_delta *= -1
+        self.best = None
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.asarray(current).reshape(-1)[0])
+        if self.best is None or self.monitor_op(current - self.min_delta,
+                                                self.best):
+            self.best = current
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """CSV/TSV metric writer (visualdl itself is not in this image; the
+    file format is tensorboard-text compatible)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._f = None
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(os.path.join(self.log_dir, 'metrics.tsv'), 'a')
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                v = float(np.asarray(v).reshape(-1)[0])
+            if isinstance(v, (int, float)):
+                self._f.write('%d\t%s\t%.6f\n' % (self._step, k, v))
+
+    def on_train_end(self, logs=None):
+        if self._f:
+            self._f.close()
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode='train'):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    params = {'batch_size': batch_size, 'epochs': epochs, 'steps': steps,
+              'verbose': verbose, 'metrics': metrics or []}
+    cbk_list.set_params(params)
+    return cbk_list

@@ -1,0 +1,277 @@
+"""hapi Model (reference: python/paddle/hapi/model.py:878 Model, fit :1523).
+
+One adapter, not two: the reference needs StaticGraphAdapter + DynamicGraph
+Adapter; here train_batch always runs through the jitted TrainStep
+(framework/functional.py), which IS the static path — eager fallback only
+when the model structure defeats functionalization.
+"""
+import os
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad_guard
+from ..framework import functional as func_mod
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+__all__ = ['Model']
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._loss = None
+        self._optimizer = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+        self.mode = 'train'
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        self._train_step = None
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            loss_fn = self._loss
+            if not callable(loss_fn):
+                raise ValueError("call prepare(loss=...) first")
+            self._train_step = func_mod.TrainStep(self.network, loss_fn,
+                                                  self._optimizer)
+        return self._train_step
+
+    # -- batch-level API ----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        try:
+            step = self._ensure_train_step()
+            loss = step(inputs, labels)
+        except Exception:
+            # eager fallback: run unfused (still correct)
+            loss = self._eager_train_batch(inputs, labels)
+        metrics = self._update_metrics(inputs, labels)
+        return [loss.numpy()] if not metrics else ([loss.numpy()], metrics)
+
+    def _eager_train_batch(self, inputs, labels):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        outs = self.network(*ins)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        loss = self._loss(*outs, *labs)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss
+
+    @no_grad_guard()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        outs = self.network(*ins)
+        outs_l = outs if isinstance(outs, (list, tuple)) else [outs]
+        loss = self._loss(*outs_l, *labs) if self._loss else None
+        metrics = []
+        for m in self._metrics:
+            res = m.compute(*outs_l, *labs)
+            m.update(res if not isinstance(res, (list, tuple)) else res[0],
+                     *labs)
+            metrics.append(m.accumulate())
+        out = [loss.numpy()] if loss is not None else []
+        return (out, metrics) if metrics else out
+
+    @no_grad_guard()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outs = self.network(*ins)
+        outs_l = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [o.numpy() for o in outs_l]
+
+    def _update_metrics(self, inputs, labels):
+        if not self._metrics:
+            return []
+        with no_grad_guard():
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            labs = labels if isinstance(labels, (list, tuple)) else [labels]
+            outs = self.network(*ins)
+            outs_l = outs if isinstance(outs, (list, tuple)) else [outs]
+            accum = []
+            for m in self._metrics:
+                res = m.compute(*outs_l, *labs)
+                m.update(res if not isinstance(res, (list, tuple)) else res[0])
+                accum.append(m.accumulate())
+        return accum
+
+    # -- loop API -----------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=[m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        self.stop_training = False
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, labs = self._split_batch(batch)
+                res = self.train_batch(ins, labs)
+                logs = self._pack_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          num_workers=num_workers)
+                logs.update({'eval_' + k: v for k, v in eval_logs.items()})
+                cbks.on_eval_end(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        metrics = []
+        for batch in loader:
+            ins, labs = self._split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            if isinstance(res, tuple):
+                loss_list, metrics = res
+            else:
+                loss_list = res
+            if loss_list:
+                losses.append(np.asarray(loss_list[0]).reshape(-1)[0])
+        logs = {}
+        if losses:
+            logs['loss'] = float(np.mean(losses))
+        for m, v in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for n, val in zip(names, vals):
+                logs[n] = val
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size,
+                            num_workers=num_workers) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, predict=True)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch, predict=False):
+        if isinstance(batch, (list, tuple)):
+            if predict:
+                # drop trailing labels the dataset may carry: feed only as
+                # many inputs as forward accepts
+                import inspect
+                try:
+                    sig = inspect.signature(self.network.forward)
+                    n_in = len([p for p in sig.parameters.values()
+                                if p.kind in (p.POSITIONAL_ONLY,
+                                              p.POSITIONAL_OR_KEYWORD)
+                                and p.default is p.empty])
+                    return list(batch[:max(n_in, 1)]), None
+                except (TypeError, ValueError):
+                    return list(batch), None
+            if len(batch) >= 2:
+                n_lab = len(self._labels) if self._labels else 1
+                return list(batch[:-n_lab]), list(batch[-n_lab:])
+            return list(batch), None
+        return [batch], None
+
+    def _pack_logs(self, res):
+        logs = {}
+        if isinstance(res, tuple):
+            loss_list, metrics = res
+        else:
+            loss_list, metrics = res, []
+        if loss_list:
+            logs['loss'] = np.asarray(loss_list[0]).reshape(-1)
+        for m, v in zip(self._metrics, metrics):
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for n, val in zip(names, vals):
+                logs[n] = val
+        return logs
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_save import save as _save
+        if training:
+            _save(self.network.state_dict(), path + '.pdparams')
+            if self._optimizer:
+                _save(self._optimizer.state_dict(), path + '.pdopt')
+        else:
+            from .. import jit
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_save import load as _load
+        state = _load(path + '.pdparams')
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer and \
+                os.path.exists(path + '.pdopt'):
+            self._optimizer.set_state_dict(_load(path + '.pdopt'))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from . import summary as summary_fn
+        return summary_fn(self.network, input_size, dtypes=dtype)

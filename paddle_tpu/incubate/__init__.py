@@ -1,0 +1,50 @@
+"""paddle.incubate parity: experimental features."""
+from ..distributed.fleet.utils import recompute  # noqa: F401
+
+
+class nn:
+    """incubate.nn namespace: fused layers map to the XLA-fused defaults —
+    the framework's layers are already the fused implementations on TPU."""
+    from ..nn.layer.transformer import (TransformerEncoderLayer as  # noqa: F401
+                                        FusedTransformerEncoderLayer,
+                                        MultiHeadAttention as
+                                        FusedMultiHeadAttention)
+
+
+class autograd:
+    @staticmethod
+    def vjp(func, xs, v=None):
+        import jax
+        from ..framework.core import Tensor
+        arrays = [x._data for x in (xs if isinstance(xs, (list, tuple))
+                                    else [xs])]
+
+        def fn(*a):
+            t = [Tensor(x, stop_gradient=False) for x in a]
+            out = func(*t)
+            return out._data if isinstance(out, Tensor) else out
+        out, vjp_fn = jax.vjp(fn, *arrays)
+        if v is None:
+            import jax.numpy as jnp
+            v_arr = jnp.ones_like(out)
+        else:
+            v_arr = v._data
+        grads = vjp_fn(v_arr)
+        return Tensor(out), [Tensor(g) for g in grads]
+
+    @staticmethod
+    def jvp(func, xs, v=None):
+        import jax
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        arrays = [x._data for x in (xs if isinstance(xs, (list, tuple))
+                                    else [xs])]
+
+        def fn(*a):
+            t = [Tensor(x, stop_gradient=False) for x in a]
+            out = func(*t)
+            return out._data if isinstance(out, Tensor) else out
+        tangents = [v._data if v is not None else jnp.ones_like(a)
+                    for a in arrays]
+        out, jvp_val = jax.jvp(fn, tuple(arrays), tuple(tangents))
+        return Tensor(out), Tensor(jvp_val)

@@ -1,0 +1,21 @@
+"""Data pipeline (reference: python/paddle/io/ + fluid/reader.py:146 +
+dataloader/dataloader_iter.py).
+
+TPU-native notes: batches are assembled as host numpy arrays (device transfer
+happens at jit boundary, overlapped by XLA's async dispatch); multi-process
+workers use the stdlib multiprocessing queue path (the reference's
+mmap/shared-mem IPC is a CUDA-pinned-memory optimization that does not apply
+to TPU hosts); DistributedBatchSampler shards by process for multi-host.
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, ChainDataset, Subset, random_split,
+                      ConcatDataset)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
+           'ChainDataset', 'Subset', 'random_split', 'Sampler',
+           'SequenceSampler', 'RandomSampler', 'WeightedRandomSampler',
+           'BatchSampler', 'DistributedBatchSampler', 'DataLoader']

@@ -1,0 +1,169 @@
+"""DataLoader (reference: fluid/reader.py:146 DataLoader,
+dataloader/dataloader_iter.py single/multi-process iterators,
+dataloader/worker.py).
+
+Single-process path: inline collate. Multi-worker path: multiprocessing pool
+with an index queue and a thread that reorders results — same scheme as the
+reference's _DataLoaderIterMultiProcess, minus CUDA-pinned shared memory
+(not needed for TPU hosts).
+"""
+import itertools
+import queue
+import threading
+import multiprocessing as mp
+
+import numpy as np
+
+from ..framework.core import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ['DataLoader', 'default_collate_fn']
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([b._data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _np_collate(batch):
+    """Worker-side collate to numpy (picklable across processes)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [_np_collate(list(items)) for items in transposed]
+    if isinstance(sample, dict):
+        return {k: _np_collate([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 worker_init_fn):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_id, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            data = collate_fn(samples)
+            data_queue.put((batch_id, data, None))
+        except Exception as e:  # propagate worker errors to the main proc
+            data_queue.put((batch_id, None, repr(e)))
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, list):
+        return [_to_tensor_tree(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    return obj
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, use_shared_memory=True,
+                 prefetch_factor=2, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.worker_init_fn = worker_init_fn
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_multi()
+
+    def _iter_iterable(self):
+        collate = self.collate_fn or default_collate_fn
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield collate(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield collate(batch)
+
+    def _iter_single(self):
+        collate = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            yield collate([self.dataset[i] for i in indices])
+
+    def _iter_multi(self):
+        collate = self.collate_fn or _np_collate
+        user_collate = self.collate_fn is not None
+        ctx = mp.get_context('fork')
+        index_queues, workers = [], []
+        data_queue = ctx.Queue()
+        for wid in range(self.num_workers):
+            iq = ctx.Queue()
+            w = ctx.Process(target=_worker_loop,
+                            args=(self.dataset, iq, data_queue, collate, wid,
+                                  self.worker_init_fn), daemon=True)
+            w.start()
+            index_queues.append(iq)
+            workers.append(w)
+
+        try:
+            all_batches = list(enumerate(self.batch_sampler))
+            for bid, indices in all_batches:
+                index_queues[bid % self.num_workers].put((bid, indices))
+            buffered = {}
+            for next_yield in range(len(all_batches)):
+                while next_yield not in buffered:
+                    bid, data, err = data_queue.get()
+                    buffered[bid] = (data, err)
+                data, err = buffered.pop(next_yield)
+                if err is not None:
+                    raise RuntimeError("DataLoader worker failed: %s" % err)
+                yield data if user_collate else _to_tensor_tree(data)
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=1)
+                if w.is_alive():
+                    w.terminate()

@@ -1,0 +1,310 @@
+"""paddle.jit: to_static + save/load (reference: python/paddle/fluid/dygraph/
+jit.py:161 declarative, dygraph_to_static/program_translator.py:759).
+
+TPU-native: no AST transpiler — jax.jit traces python control flow directly
+(loops unroll; data-dependent branches need lax helpers, same contract as the
+reference's control-flow ops). A "ConcreteProgram" is a cached jitted
+callable keyed by input signature. jit.save exports StableHLO + weights;
+jit.load returns a TranslatedLayer running the compiled artifact.
+"""
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, no_grad_guard
+from ..framework import functional as func_mod
+from ..static.input_spec import InputSpec
+
+__all__ = ['to_static', 'save', 'load', 'TranslatedLayer', 'not_to_static',
+           'ignore_module']
+
+
+class StaticFunction:
+    """Wraps a function/method: first call traces+compiles, later calls hit
+    the jit cache (ConcreteProgram.from_func_spec parity)."""
+
+    def __init__(self, fn, input_spec=None, layer=None):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._layer = layer
+        self._jitted = {}
+        functools.update_wrapper(self, fn)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return StaticFunction(self._fn.__get__(instance, owner),
+                              self._input_spec, layer=instance)
+
+    @property
+    def _bound_layer(self):
+        if self._layer is not None:
+            return self._layer
+        return getattr(self._fn, '__self__', None)
+
+    def _sig(self, arrays, training):
+        return tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (training,)
+
+    def __call__(self, *args, **kwargs):
+        layer = self._bound_layer
+        in_arrays = []
+        struct = []
+        for a in args:
+            if isinstance(a, Tensor):
+                in_arrays.append(a._data)
+                struct.append(None)
+            else:
+                struct.append(a)
+        in_arrays = tuple(in_arrays)
+
+        if layer is None:
+            # plain function: closed-over Parameters discovered on the first
+            # (eager, recorded) call, then lifted to jit inputs so grads flow
+            from ..framework import core as core_mod
+            key = self._sig(in_arrays, True)
+            if key not in self._jitted:
+                recorder = {}
+                core_mod._param_recorder[0] = recorder
+                try:
+                    first_out = self._fn(*args, **kwargs)
+                finally:
+                    core_mod._param_recorder[0] = None
+                captured = [t for t in recorder.values()]
+                fn = self._fn
+
+                def pure(*arrays):
+                    n_cap = len(captured)
+                    saved = [(t, t._data) for t in captured]
+                    try:
+                        for t, arr in zip(captured, arrays[:n_cap]):
+                            t._data = arr
+                        it = iter(arrays[n_cap:])
+                        call_args = [Tensor(next(it), stop_gradient=False)
+                                     if s is None else s for s in struct]
+                        out = fn(*call_args, **kwargs)
+                    finally:
+                        for t, arr in saved:
+                            t._data = arr
+                    if isinstance(out, Tensor):
+                        return out._data
+                    if isinstance(out, (list, tuple)):
+                        return tuple(o._data if isinstance(o, Tensor) else o
+                                     for o in out)
+                    return out
+                self._jitted[key] = (jax.jit(pure), captured)
+                return first_out
+            jitted, captured = self._jitted[key]
+            tensor_args = [a if isinstance(a, Tensor) else Tensor(a)
+                           for a, s in zip(args, struct) if s is None]
+            return run_op('to_static_fn', jitted, *captured, *tensor_args)
+
+        # bound method on a Layer: functionalize params/buffers
+        training = layer.training
+        key = self._sig(in_arrays, training)
+        if key not in self._jitted:
+            model = layer
+            method_fn = self._fn
+
+            def pure(params, buffers, *arrays):
+                def fwd(*ts):
+                    it = iter(ts)
+                    call_args = [next(it) if s is None else s for s in struct]
+                    return method_fn(*call_args, **kwargs)
+                saved, bmap = func_mod._bind(model, params, buffers)
+                try:
+                    t_args = [Tensor(a, stop_gradient=False) for a in arrays]
+                    out = fwd(*t_args)
+                    new_buf = {n: t._data for n, t in bmap.items()
+                               if t is not None}
+                finally:
+                    for t, arr in saved:
+                        t._data = arr
+                if isinstance(out, (list, tuple)):
+                    return tuple(o._data if isinstance(o, Tensor) else o
+                                 for o in out), new_buf
+                return (out._data if isinstance(out, Tensor) else out), new_buf
+            self._jitted[key] = jax.jit(pure)
+
+        params = func_mod.extract_params(layer)
+        buffers = func_mod.extract_buffers(layer)
+        jitted = self._jitted[key]
+
+        # route through the tape as one op over (params..., inputs...) so
+        # loss.backward() differentiates through the compiled program
+        names = list(params.keys())
+        pmap = dict(layer.named_parameters())
+        param_tensors = [pmap[n] for n in names]
+        tensor_args = [a for a, s in zip(args, struct) if s is None]
+        new_buf_box = {}
+
+        def op_fn(*arrays):
+            p = dict(zip(names, arrays[:len(names)]))
+            out, new_buf = jitted(p, buffers, *arrays[len(names):])
+            new_buf_box.update(new_buf)
+            return out
+
+        out = run_op('to_static', op_fn, *param_tensors, *tensor_args)
+        concrete = {k: v for k, v in new_buf_box.items()
+                    if not isinstance(v, jax.core.Tracer)}
+        if concrete:
+            func_mod.write_back_buffers(layer, concrete)
+        return out
+
+    @property
+    def concrete_program(self):
+        return self
+
+    def rollback(self):
+        return self._fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec, layer=fn)
+            return fn
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# save / load (reference: jit.py:515 jit.save -> pdmodel+pdiparams;
+# dygraph/io.py TranslatedLayer)
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **configs):
+    """Export: weights + buffers + StableHLO of the eval-mode forward."""
+    from ..nn.layer.layers import Layer
+    os.makedirs(os.path.dirname(path) or '.', exist_ok=True)
+
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    params = func_mod.extract_params(layer)
+    buffers = func_mod.extract_buffers(layer)
+
+    state = {'params': {k: np.asarray(v) for k, v in params.items()},
+             'buffers': {k: np.asarray(v) for k, v in buffers.items()}}
+    with open(path + '.pdiparams', 'wb') as f:
+        pickle.dump(state, f, protocol=4)
+
+    meta = {'input_spec': None, 'stablehlo': None}
+    if input_spec:
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        meta['input_spec'] = [(tuple(s.shape), s.dtype) for s in specs]
+        was_training = layer.training
+        layer.eval()
+        try:
+            def pure(params, buffers, *arrays):
+                out, _ = func_mod.functional_call(layer, params, buffers,
+                                                  args=arrays, training=False)
+                return out
+            shaped = [jax.ShapeDtypeStruct(
+                tuple(d if d and d > 0 else 1 for d in s.shape),
+                np.dtype(s.dtype)) for s in specs]
+            lowered = jax.jit(pure).lower(
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in params.items()},
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                 for k, v in buffers.items()}, *shaped)
+            meta['stablehlo'] = lowered.as_text()
+        finally:
+            if was_training:
+                layer.train()
+
+    # architecture payload: pickled layer (class-importable contract, same
+    # as paddle.save of a whole Layer)
+    try:
+        arch = pickle.dumps(_strip_for_pickle(layer), protocol=4)
+    except Exception:
+        arch = None
+    with open(path + '.pdmodel', 'wb') as f:
+        pickle.dump({'meta': meta, 'arch': arch}, f, protocol=4)
+
+
+def _strip_for_pickle(layer):
+    import copy
+    layer2 = copy.deepcopy(layer)
+    for l in layer2.sublayers(include_self=True):
+        l._forward_pre_hooks.clear()
+        l._forward_post_hooks.clear()
+        for d in (l._parameters, l._buffers):
+            for k, t in list(d.items()):
+                if t is not None:
+                    arr = np.asarray(t._data)
+                    t._data = arr  # numpy is picklable; rewrapped on load
+                    t._grad = None
+                    t._grad_node = None
+    return layer2
+
+
+class TranslatedLayer:
+    """Runs a loaded program (reference: dygraph/io.py:1082)."""
+
+    def __init__(self, layer, params, buffers):
+        self._layer = layer
+        if layer is not None:
+            pmap = dict(layer.named_parameters())
+            for k, v in params.items():
+                if k in pmap:
+                    pmap[k]._data = jnp.asarray(v)
+            bmap = dict(layer.named_buffers())
+            for k, v in buffers.items():
+                if k in bmap and bmap[k] is not None:
+                    bmap[k]._data = jnp.asarray(v)
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def eval(self):
+        self._layer.eval()
+        return self
+
+    def train(self):
+        self._layer.train()
+        return self
+
+    def parameters(self, *a, **k):
+        return self._layer.parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layer.state_dict(*a, **k)
+
+    def forward(self, *args, **kwargs):
+        return self.__call__(*args, **kwargs)
+
+
+def load(path, **configs):
+    with open(path + '.pdiparams', 'rb') as f:
+        state = pickle.load(f)
+    with open(path + '.pdmodel', 'rb') as f:
+        model_payload = pickle.load(f)
+    layer = None
+    if model_payload.get('arch') is not None:
+        layer = pickle.loads(model_payload['arch'])
+        for l in layer.sublayers(include_self=True):
+            for d in (l._parameters, l._buffers):
+                for k, t in list(d.items()):
+                    if t is not None and isinstance(t._data, np.ndarray):
+                        t._data = jnp.asarray(t._data)
+    return TranslatedLayer(layer, state['params'], state['buffers'])

@@ -1,0 +1,95 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py).
+
+Clip objects transform (param, grad) lists; optimizers apply them before the
+update, matching ClipGradByGlobalNorm et al. semantics.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ['ClipGradByValue', 'ClipGradByNorm', 'ClipGradByGlobalNorm',
+           'clip_grad_norm_', 'clip_grad_value_']
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._data)))
+            scale = jnp.where(norm > self.clip_norm, self.clip_norm / norm, 1.0)
+            out.append((p, Tensor(g._data * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name='default_group'):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        grads = [g._data for p, g in params_grads
+                 if g is not None and getattr(p, 'need_clip', True)]
+        if not grads:
+            return params_grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                   for g in grads))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, 'need_clip', True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * scale).astype(g._data.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float('inf'):
+        total = jnp.max(jnp.asarray([jnp.max(jnp.abs(p.grad._data))
+                                     for p in params]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p.grad._data),
+                                                norm_type)) for p in params),
+                          1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad = Tensor(p.grad._data * scale)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._data, -clip_value, clip_value))

@@ -1,0 +1,12 @@
+"""paddle.nn.functional parity surface."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .sparse_attention import sparse_attention  # noqa: F401
+from . import activation, common, conv, loss, norm, pooling  # noqa: F401
+
+# attention lives in its own module (pallas-backed flash attention)
+from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401

@@ -1,0 +1,192 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor, unary_op
+
+__all__ = [
+    'relu', 'relu6', 'relu_', 'elu', 'selu', 'celu', 'gelu', 'leaky_relu',
+    'prelu', 'rrelu', 'sigmoid', 'hardsigmoid', 'hardswish', 'hardtanh',
+    'hardshrink', 'softshrink', 'tanhshrink', 'softsign', 'softplus',
+    'swish', 'silu', 'mish', 'tanh', 'tanh_', 'thresholded_relu',
+    'log_sigmoid', 'maxout', 'softmax', 'log_softmax', 'gumbel_softmax',
+    'glu',
+]
+
+relu = unary_op('relu', jax.nn.relu)
+relu6 = unary_op('relu6', jax.nn.relu6)
+sigmoid = unary_op('sigmoid', jax.nn.sigmoid)
+tanh = unary_op('tanh', jnp.tanh)
+softsign = unary_op('softsign', jax.nn.soft_sign)
+silu = unary_op('silu', jax.nn.silu)
+log_sigmoid = unary_op('log_sigmoid', jax.nn.log_sigmoid)
+mish = unary_op('mish', lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+tanhshrink = unary_op('tanhshrink', lambda x: x - jnp.tanh(x))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._node_out_idx, x.stop_gradient = out._node_out_idx, out.stop_gradient
+    return x
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._data, x._grad_node = out._data, out._grad_node
+    x._node_out_idx, x.stop_gradient = out._node_out_idx, out.stop_gradient
+    return x
+
+
+def elu(x, alpha=1.0, name=None):
+    return run_op('elu', lambda a: jax.nn.elu(a, alpha=alpha), ensure_tensor(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return run_op('selu',
+                  lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                  ensure_tensor(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return run_op('celu', lambda a: jax.nn.celu(a, alpha=alpha), ensure_tensor(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return run_op('gelu', lambda a: jax.nn.gelu(a, approximate=approximate),
+                  ensure_tensor(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return run_op('leaky_relu',
+                  lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+                  ensure_tensor(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = ensure_tensor(x), ensure_tensor(weight)
+
+    def fn(a, ww):
+        if ww.size > 1:
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            ww = ww.reshape(shape)
+        return jnp.where(a > 0, a, ww * a)
+    return run_op('prelu', fn, x, w)
+
+
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from ...framework import random as rng
+        k = rng.next_key()
+
+        def fn(a):
+            r = jax.random.uniform(k, a.shape, a.dtype, lower, upper)
+            return jnp.where(a > 0, a, r * a)
+        return run_op('rrelu', fn, x)
+    mid = (lower + upper) / 2.0
+    return run_op('rrelu', lambda a: jnp.where(a > 0, a, mid * a), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return run_op('hardsigmoid',
+                  lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), ensure_tensor(x))
+
+
+def hardswish(x, name=None):
+    return run_op('hardswish',
+                  lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, ensure_tensor(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return run_op('hardtanh', lambda a: jnp.clip(a, min, max), ensure_tensor(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return run_op('hardshrink',
+                  lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+                  ensure_tensor(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return run_op(
+        'softshrink',
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        ensure_tensor(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return run_op(
+        'softplus',
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta),
+        ensure_tensor(x))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return run_op('thresholded_relu',
+                  lambda a: jnp.where(a > threshold, a, 0.0), ensure_tensor(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = a.shape[ax]
+        shp = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(shp), axis=ax + 1)
+    return run_op('maxout', fn, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ...framework.dtype import to_jax_dtype
+
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return run_op('softmax', fn, x)
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ...framework.dtype import to_jax_dtype
+
+    def fn(a):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return run_op('log_softmax', fn, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    from ...framework import random as rng
+    k = rng.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            onehot = jax.nn.one_hot(idx, y.shape[axis], axis=axis, dtype=y.dtype)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return run_op('gumbel_softmax', fn, x)
+
+
+def glu(x, axis=-1, name=None):
+    return run_op('glu', lambda a: jax.nn.glu(a, axis=axis), ensure_tensor(x))

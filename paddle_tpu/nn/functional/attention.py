@@ -1,0 +1,72 @@
+"""Attention functionals.
+
+The jnp reference path always works (and XLA fuses it well); the Pallas flash
+kernel (ops/flash_attention.py) kicks in on TPU for long sequences where HBM
+traffic of the naive path dominates. Reference parity:
+paddle incubate sparse_attention / nn.MultiHeadAttention core.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+    # q,k,v: [B, N, H, D] paddle layout
+    qt = jnp.swapaxes(q, 1, 2)  # B,H,N,D
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    s = jnp.einsum('bhqd,bhkd->bhqk', qt, kt) * scale
+    if causal:
+        n, m = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((n, m), bool))
+        s = jnp.where(cm, s, -1e30)
+    if mask is not None:
+        s = s + mask
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    o = jnp.einsum('bhqk,bhkd->bhqd', p, vt)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs [batch, seq, heads, head_dim] (paddle layout)."""
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    use_flash = False
+    try:
+        from ...ops import flash_attention as fa
+        if q._data.ndim == 4 and q.shape[1] >= 512 and q.shape[-1] <= 256:
+            use_flash = fa.is_available()
+    except Exception:
+        use_flash = False
+
+    mask_arr = ensure_tensor(attn_mask)._data if attn_mask is not None else None
+
+    if use_flash and mask_arr is None and dropout_p == 0.0:
+        from ...ops import flash_attention as fa
+
+        def fn(qq, kk, vv):
+            return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
+                                           scale=scale)
+        return run_op('flash_attention', fn, q, k, v)
+
+    def fn(qq, kk, vv):
+        return _sdpa_ref(qq, kk, vv, mask_arr, dropout_p, is_causal, scale)
+    return run_op('sdpa', fn, q, k, v)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal)
+    if return_softmax:
+        return out, None
+    return out, None

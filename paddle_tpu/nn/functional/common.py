@@ -1,0 +1,350 @@
+"""Common functionals: linear, dropout, pad, interpolate, embedding, one_hot…
+(reference: python/paddle/nn/functional/common.py + input.py)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op, wrap_out
+from ...framework import random as rng
+from ...tensor._helpers import ensure_tensor, shape_arg
+
+__all__ = ['linear', 'dropout', 'dropout2d', 'dropout3d', 'alpha_dropout',
+           'pad', 'zeropad2d', 'interpolate', 'upsample', 'one_hot',
+           'embedding', 'unfold', 'fold', 'cosine_similarity', 'pixel_shuffle',
+           'pixel_unshuffle', 'channel_shuffle', 'label_smooth',
+           'class_center_sample', 'bilinear']
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b.  W layout: [in, out] (paddle convention). The matmul is
+    the MXU hot path; bias fuses in XLA."""
+    x, w = ensure_tensor(x), ensure_tensor(weight)
+    if bias is not None:
+        return run_op('linear', lambda a, ww, b: jnp.matmul(a, ww) + b,
+                      x, w, ensure_tensor(bias))
+    return run_op('linear', lambda a, ww: jnp.matmul(a, ww), x, w)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0:
+        if mode == "downscale_in_infer" and not training:
+            return run_op('dropout', lambda a: a * (1.0 - p), x)
+        return x
+    if p == 1:
+        return run_op('dropout', lambda a: a * 0.0, x)
+    key = rng.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return run_op('dropout', fn, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format='NCHW', name=None):
+    ax = [0, 1] if data_format == 'NCHW' else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format='NCDHW', name=None):
+    ax = [0, 1] if data_format == 'NCDHW' else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0:
+        return x
+    key = rng.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+    return run_op('alpha_dropout', fn, x)
+
+
+def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle NCHW semantics: pad only spatial dims, given reversed
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith('NC'):
+            spatial_dims = list(range(2, 2 + n_spatial))
+        else:
+            spatial_dims = list(range(1, 1 + n_spatial))
+        for i, d in enumerate(spatial_dims):
+            pairs[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {'constant': 'constant', 'reflect': 'reflect',
+             'replicate': 'edge', 'circular': 'wrap'}[mode]
+
+    def fn(a):
+        if jmode == 'constant':
+            return jnp.pad(a, pairs, mode='constant', constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+    return run_op('pad', fn, x)
+
+
+def zeropad2d(x, padding, data_format='NCHW', name=None):
+    return pad(x, padding, mode='constant', value=0.0, data_format=data_format)
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jax.nn.one_hot(x._data, num_classes, dtype=jnp.float32))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup = gather; on TPU sparse=False always (XLA gathers are
+    dense-friendly). padding_idx rows produce zero gradients via masking."""
+    idx = ensure_tensor(x)._data
+    w = ensure_tensor(weight)
+
+    def fn(ww):
+        out = jnp.take(ww, idx, axis=0)
+        if padding_idx is not None:
+            mask = (idx != padding_idx)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+    return run_op('embedding', fn, w)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k, s = _pair(kernel_sizes), _pair(strides)
+    d = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        p = [p, p, p, p]
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[2]), (p[1], p[3])])
+        hh = (a_p.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ww = (a_p.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                sl = a_p[:, :, i * d[0]: i * d[0] + hh * s[0]: s[0],
+                         j * d[1]: j * d[1] + ww * s[1]: s[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # N, C, k*k, L...
+        return out.reshape(n, c * k[0] * k[1], hh * ww)
+    return run_op('unfold', fn, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = ensure_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    o, k, s = _pair(output_sizes), _pair(kernel_sizes), _pair(strides)
+    d = _pair(dilations)
+    p = paddings
+    if isinstance(p, int):
+        p = [p, p, p, p]
+    if len(p) == 2:
+        p = [p[0], p[1], p[0], p[1]]
+
+    def fn(a):
+        n, ckk, l = a.shape
+        c = ckk // (k[0] * k[1])
+        hp, wp = o[0] + p[0] + p[2], o[1] + p[1] + p[3]
+        hh = (hp - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ww = (wp - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], hh, ww)
+        out = jnp.zeros((n, c, hp, wp), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i * d[0]: i * d[0] + hh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ww * s[1]: s[1]].add(
+                    a_r[:, :, i, j])
+        return out[:, :, p[0]:hp - p[2], p[1]:wp - p[3]]
+    return run_op('fold', fn, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * \
+            jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+    return run_op('cosine_similarity', fn, x1, x2)
+
+
+def pixel_shuffle(x, upscale_factor, data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == 'NCHW':
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (r * r), r, r, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, r, r, c // (r * r))
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h * r, w * r, c // (r * r))
+    return run_op('pixel_shuffle', fn, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == 'NCHW':
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return run_op('pixel_unshuffle', fn, x)
+
+
+def channel_shuffle(x, groups, data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if data_format == 'NCHW':
+            n, c, h, w = a.shape
+            out = a.reshape(n, groups, c // groups, h, w)
+            out = jnp.swapaxes(out, 1, 2)
+            return out.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, groups, c // groups)
+        out = jnp.swapaxes(out, 3, 4)
+        return out.reshape(n, h, w, c)
+    return run_op('channel_shuffle', fn, x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+
+    def fn(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else prior_dist
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return run_op('label_smooth', fn, label)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    label_np = ensure_tensor(label).numpy()
+    pos = np.unique(label_np)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = neg[:num_samples - len(pos)]
+        sampled = np.concatenate([pos, extra])
+    remap = {c: i for i, c in enumerate(sampled)}
+    remapped = np.asarray([remap.get(int(v), 0) for v in label_np.reshape(-1)],
+                          dtype=np.int64).reshape(label_np.shape)
+    return (wrap_out(jnp.asarray(remapped)),
+            wrap_out(jnp.asarray(sampled, dtype=jnp.int64)))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, w = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def fn(a, b, ww, *mb):
+        out = jnp.einsum('bi,oij,bj->bo', a, ww, b)
+        if mb:
+            out = out + mb[0]
+        return out
+    if bias is not None:
+        return run_op('bilinear', fn, x1, x2, w, ensure_tensor(bias))
+    return run_op('bilinear', fn, x1, x2, w)
+
+
+def interpolate(x, size=None, scale_factor=None, mode='nearest',
+                align_corners=False, align_mode=0, data_format='NCHW',
+                name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
+    nd = x.ndim - 2
+    spatial = list(range(1, 1 + nd)) if channel_last else list(range(2, 2 + nd))
+    in_sizes = [x.shape[d] for d in spatial]
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_sizes = [int(s.item() if isinstance(s, Tensor) else s) for s in
+                     (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_sizes = [int(i * s) for i, s in zip(in_sizes, scale_factor)]
+        else:
+            out_sizes = [int(i * scale_factor) for i in in_sizes]
+
+    method = {'nearest': 'nearest', 'bilinear': 'linear', 'linear': 'linear',
+              'trilinear': 'linear', 'bicubic': 'cubic', 'area': 'linear'}[mode]
+
+    def fn(a):
+        new_shape = list(a.shape)
+        for d, s in zip(spatial, out_sizes):
+            new_shape[d] = s
+        if method == 'nearest' or not align_corners:
+            return jax.image.resize(a, tuple(new_shape), method=method)
+        # align_corners: gather with explicit index mapping
+        out = a
+        for d, s in zip(spatial, out_sizes):
+            in_s = out.shape[d]
+            if s == in_s:
+                continue
+            pos = jnp.linspace(0.0, in_s - 1.0, s)
+            i0 = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_s - 1)
+            i1 = jnp.clip(i0 + 1, 0, in_s - 1)
+            frac = (pos - i0).astype(a.dtype)
+            shape_b = [1] * out.ndim
+            shape_b[d] = s
+            frac = frac.reshape(shape_b)
+            lo = jnp.take(out, i0, axis=d)
+            hi = jnp.take(out, i1, axis=d)
+            if method == 'nearest':
+                out = jnp.where(frac < 0.5, lo, hi)
+            else:
+                out = lo * (1 - frac) + hi * frac
+        return out
+    return run_op('interpolate', fn, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode='nearest',
+             align_corners=False, align_mode=0, data_format='NCHW', name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)

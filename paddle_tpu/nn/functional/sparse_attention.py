@@ -1,0 +1,34 @@
+"""Block-sparse attention (reference: paddle.nn.functional.sparse_attention,
+operators/sparse_attention_op). Reference semantics with CSR block layout;
+computed densely with masking (XLA-friendly) — a Pallas block-skip kernel is
+the upgrade path."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    q = ensure_tensor(query)
+    k = ensure_tensor(key)
+    v = ensure_tensor(value)
+    offs = ensure_tensor(sparse_csr_offset)._data
+    cols = ensure_tensor(sparse_csr_columns)._data
+
+    def fn(qq, kk, vv):
+        scale = 1.0 / math.sqrt(qq.shape[-1])
+        s = jnp.einsum('bhqd,bhkd->bhqk', qq, kk) * scale
+        B, H, N, M = s.shape
+        # build dense mask from CSR: row i attends cols[offs[i]:offs[i+1]]
+        row_ids = jnp.repeat(jnp.arange(N), jnp.diff(offs[0, 0]),
+                             total_repeat_length=cols.shape[-1])
+        mask = jnp.zeros((N, M), bool).at[row_ids, cols[0, 0]].set(True)
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(mask, p, 0.0)
+        return jnp.einsum('bhqk,bhkd->bhqd', p, vv)
+    return run_op('sparse_attention', fn, q, k, v)

@@ -1,0 +1,184 @@
+"""Weight initializers (reference: python/paddle/fluid/initializer.py,
+python/paddle/nn/initializer/).
+
+An initializer is a callable shape,dtype -> jax array; Layers call
+`create_parameter` with one. Draws keys from the global Generator so
+`paddle.seed` reproduces the reference's determinism contract.
+"""
+import math
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as rng
+from ..framework.dtype import to_jax_dtype
+
+__all__ = [
+    'Initializer', 'Constant', 'Normal', 'TruncatedNormal', 'Uniform',
+    'XavierNormal', 'XavierUniform', 'KaimingNormal', 'KaimingUniform',
+    'Assign', 'Orthogonal', 'Dirac', 'calculate_gain',
+]
+
+
+def calculate_gain(nonlinearity, param=None):
+    table = {'sigmoid': 1.0, 'linear': 1.0, 'conv1d': 1.0, 'conv2d': 1.0,
+             'conv3d': 1.0, 'tanh': 5.0 / 3, 'relu': math.sqrt(2.0),
+             'leaky_relu': math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+             'selu': 3.0 / 4}
+    return table[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype='float32'):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype='float32'):
+        return jnp.full(tuple(shape), self.value, to_jax_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype='float32'):
+        return self.mean + self.std * jax.random.normal(
+            rng.next_key(), tuple(shape), to_jax_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype='float32'):
+        return self.mean + self.std * jax.random.truncated_normal(
+            rng.next_key(), -2.0, 2.0, tuple(shape), to_jax_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype='float32'):
+        return jax.random.uniform(rng.next_key(), tuple(shape),
+                                  to_jax_dtype(dtype), self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype='float32'):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(rng.next_key(), tuple(shape),
+                                       to_jax_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype='float32'):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(rng.next_key(), tuple(shape),
+                                  to_jax_dtype(dtype), -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype='float32'):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return std * jax.random.normal(rng.next_key(), tuple(shape),
+                                       to_jax_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity='relu'):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype='float32'):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(rng.next_key(), tuple(shape),
+                                  to_jax_dtype(dtype), -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype='float32'):
+        from ..framework.core import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        arr = jnp.asarray(v, to_jax_dtype(dtype)).reshape(tuple(shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype='float32'):
+        return self.gain * jax.nn.initializers.orthogonal()(
+            rng.next_key(), tuple(shape), to_jax_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype='float32'):
+        arr = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        per = oc // self.groups
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(per, ic)):
+                idx = (g * per + i, i) + tuple(centers)
+                arr[idx] = 1.0
+        return jnp.asarray(arr, to_jax_dtype(dtype))
+
+
+# paddle.nn.initializer compat aliases
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+TruncatedNormalInitializer = TruncatedNormal
+NumpyArrayInitializer = Assign

@@ -1,0 +1,24 @@
+"""Distance layers (reference: python/paddle/nn/layer/distance.py)."""
+import jax.numpy as jnp
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor
+from .layers import Layer
+
+__all__ = ['PairwiseDistance']
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        p, eps, keep = self.p, self.epsilon, self.keepdim
+
+        def fn(a, b):
+            d = jnp.abs(a - b) + eps
+            return jnp.power(jnp.sum(jnp.power(d, p), axis=-1, keepdims=keep),
+                             1.0 / p)
+        return run_op('pairwise_distance', fn, ensure_tensor(x),
+                      ensure_tensor(y))

@@ -1,0 +1,272 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py).
+
+SyncBatchNorm: on TPU, batch stats inside a pjit'd step are computed over the
+global (sharded) batch automatically when the reduction spans the dp axis —
+see distributed/meta_parallel/sync_batch_norm for the shard_map variant.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ['BatchNorm', 'BatchNorm1D', 'BatchNorm2D', 'BatchNorm3D',
+           'LayerNorm', 'GroupNorm', 'InstanceNorm1D', 'InstanceNorm2D',
+           'InstanceNorm3D', 'LocalResponseNorm', 'SpectralNorm', 'RMSNorm',
+           'SyncBatchNorm']
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW',
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            shape=[num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(shape=[num_features], attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer('_mean', Tensor(jnp.zeros([num_features])))
+        self.register_buffer('_variance', Tensor(jnp.ones([num_features])))
+
+    def forward(self, input):
+        return F.batch_norm(
+            input, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return 'num_features=%d, momentum=%s, epsilon=%s' % (
+            self._num_features, self._momentum, self._epsilon)
+
+
+class BatchNorm(_BatchNormBase):
+    """Legacy paddle.nn.BatchNorm (fluid dygraph BatchNorm) signature."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-05,
+                 param_attr=None, bias_attr=None, dtype='float32',
+                 data_layout='NCHW', in_place=False, moving_mean_name=None,
+                 moving_variance_name=None, do_model_average_for_mean_and_var=True,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats if use_global_stats else None)
+        self._act = act
+
+    def forward(self, input):
+        out = super().forward(input)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCL',
+                 use_global_stats=None, name=None):
+        fmt = 'NLC' if data_format == 'NLC' else 'NCHW'
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, fmt, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCDHW',
+                 use_global_stats=None, name=None):
+        fmt = 'NDHWC' if data_format == 'NDHWC' else 'NCHW'
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, fmt, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN. Inside pjit the batch axis is global, so plain BN
+    stats are already synced; kept as a distinct class for API parity
+    (reference: python/paddle/nn/layer/norm.py SyncBatchNorm +
+    sync_batch_norm_op.cu)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, cls):
+            out = cls(layer._num_features, layer._momentum, layer._epsilon,
+                      None, None, layer._data_format)
+            out.weight.set_value(layer.weight._data)
+            out.bias.set_value(layer.bias._data)
+            out._mean.set_value(layer._mean._data)
+            out._variance.set_value(layer._variance._data)
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                shape=self._normalized_shape, attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=self._normalized_shape, attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return 'normalized_shape=%s, epsilon=%s' % (self._normalized_shape,
+                                                    self._epsilon)
+
+
+class RMSNorm(Layer):
+    """RMS norm (beyond-reference; standard for modern LLMs)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None,
+                 name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            shape=self._normalized_shape, attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        from ...framework.core import run_op
+        from ...tensor._helpers import ensure_tensor
+        eps = self._epsilon
+        nd = len(self._normalized_shape)
+
+        def fn(a, w):
+            axes = tuple(range(a.ndim - nd, a.ndim))
+            var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=axes,
+                           keepdims=True)
+            out = a * jax.lax.rsqrt(var + eps).astype(a.dtype)
+            return out * w
+        return run_op('rms_norm', fn, ensure_tensor(x), self.weight)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05,
+                 weight_attr=None, bias_attr=None, data_format='NCHW',
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_channels], attr=weight_attr,
+            default_initializer=I.Constant(1.0)) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            shape=[num_channels], attr=bias_attr,
+            is_bias=True) if bias_attr is not False else None
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon,
+                            self.weight, self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format='NCHW',
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        if weight_attr is not False:
+            self.scale = self.create_parameter(
+                shape=[num_features], attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter(shape=[num_features],
+                                              attr=bias_attr, is_bias=True)
+        else:
+            self.scale, self.bias = None, None
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=0.0001, beta=0.75, k=1.0,
+                 data_format='NCHW', name=None):
+        super().__init__()
+        self.args = (size, alpha, beta, k, data_format)
+
+    def forward(self, input):
+        return F.local_response_norm(input, *self.args)
+
+
+class SpectralNorm(Layer):
+    """Spectral norm via power iteration (reference: spectral_norm_op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 dtype='float32'):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._epsilon = epsilon
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter(
+            shape=[h], default_initializer=I.Normal(0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            shape=[w], default_initializer=I.Normal(0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...framework.core import run_op
+        from ...tensor._helpers import ensure_tensor
+        dim, iters, eps = self._dim, self._power_iters, self._epsilon
+        u0, v0 = self.weight_u._data, self.weight_v._data
+
+        def fn(w):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            u, v = u0, v0
+            for _ in range(iters):
+                v = wm.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = wm @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ wm @ v
+            return w / sigma
+        out = run_op('spectral_norm', fn, ensure_tensor(weight))
+        return out

@@ -1,0 +1,336 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py; cuDNN rnn_op.h).
+
+TPU-native: the time loop is a lax.scan inside one recorded op, so the whole
+sequence compiles to a single fused XLA while-loop instead of per-step ops.
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, run_op
+from ...tensor._helpers import ensure_tensor
+from .. import initializer as I
+from .layers import Layer
+from .container import LayerList
+
+__all__ = ['RNNCellBase', 'SimpleRNNCell', 'LSTMCell', 'GRUCell', 'RNN',
+           'BiRNN', 'SimpleRNN', 'LSTM', 'GRU']
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype='float32',
+                           init_value=0.0, batch_dim_idx=0):
+        batch = ensure_tensor(batch_ref).shape[batch_dim_idx]
+        state_shape = (batch, self.hidden_size)
+        return Tensor(jnp.full(state_shape, init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation='tanh',
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == 'tanh' else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = run_op('simple_rnn_cell', fn, ensure_tensor(inputs),
+                   ensure_tensor(states), self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = ensure_tensor(inputs).shape[0]
+            z = Tensor(jnp.zeros((b, self.hidden_size)))
+            states = (z, z)
+        h0, c0 = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h, c = run_op('lstm_cell', fn, ensure_tensor(inputs), ensure_tensor(h0),
+                      ensure_tensor(c0), self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+        h = run_op('gru_cell', fn, ensure_tensor(inputs), ensure_tensor(states),
+                   self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Runs a cell over time (single recorded scan op)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return _run_rnn(self.cell, inputs, initial_states, self.is_reverse,
+                        self.time_major)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        fw_st, bw_st = (None, None) if initial_states is None else initial_states
+        out_f, st_f = _run_rnn(self.cell_fw, inputs, fw_st, False,
+                               self.time_major)
+        out_b, st_b = _run_rnn(self.cell_bw, inputs, bw_st, True,
+                               self.time_major)
+        from ...tensor.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+def _cell_params(cell):
+    return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+
+def _run_rnn(cell, inputs, initial_states, is_reverse, time_major):
+    """Scan `cell` over the time axis as ONE recorded op."""
+    x = ensure_tensor(inputs)
+    time_axis = 0 if time_major else 1
+    batch = x.shape[1 if time_major else 0]
+    hid = cell.hidden_size
+    is_lstm = isinstance(cell, LSTMCell)
+
+    if initial_states is None:
+        z = jnp.zeros((batch, hid), jnp.float32)
+        init = (z, z) if is_lstm else z
+    else:
+        if is_lstm:
+            init = (ensure_tensor(initial_states[0])._data,
+                    ensure_tensor(initial_states[1])._data)
+        else:
+            st = initial_states[0] if isinstance(initial_states, (tuple, list)) \
+                else initial_states
+            init = ensure_tensor(st)._data
+
+    params = _cell_params(cell)
+    act = getattr(cell, 'activation', 'tanh')
+
+    def step_fn(carry, x_t, wi, wh, bi, bh):
+        if is_lstm:
+            h, c = carry
+            gates = x_t @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        if isinstance(cell, GRUCell):
+            h = carry
+            gi = x_t @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+        h = carry
+        a = jnp.tanh if act == 'tanh' else jax.nn.relu
+        h_new = a(x_t @ wi.T + bi + h @ wh.T + bh)
+        return h_new, h_new
+
+    def fn(xa, wi, wh, bi, bh):
+        xs = jnp.moveaxis(xa, time_axis, 0)
+        if is_reverse:
+            xs = jnp.flip(xs, axis=0)
+        carry, ys = jax.lax.scan(
+            lambda c, x_t: step_fn(c, x_t, wi, wh, bi, bh), init, xs)
+        if is_reverse:
+            ys = jnp.flip(ys, axis=0)
+        out = jnp.moveaxis(ys, 0, time_axis)
+        if is_lstm:
+            return out, carry[0], carry[1]
+        return out, carry
+
+    outs = run_op('rnn_scan', fn, x, *params)
+    if is_lstm:
+        out, h, c = outs
+        return out, (h, c)
+    out, h = outs
+    return out, h
+
+
+class _StackedRNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0,
+                 activation='tanh', weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ('bidirect', 'bidirectional')
+        self.num_directions = 2 if bidirect else 1
+
+        def make_cell(in_sz):
+            if mode == 'LSTM':
+                return LSTMCell(in_sz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if mode == 'GRU':
+                return GRUCell(in_sz, hidden_size, weight_ih_attr,
+                               weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(in_sz, hidden_size, activation,
+                                 weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                                 bias_hh_attr)
+
+        self._cells = LayerList()
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 \
+                else hidden_size * self.num_directions
+            self._cells.append(make_cell(in_sz))
+            if bidirect:
+                self._cells.append(make_cell(in_sz))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat, stack
+        from ..functional import dropout as dropout_fn
+        out = inputs
+        final_h, final_c = [], []
+        idx = 0
+        for layer_i in range(self.num_layers):
+            if self.num_directions == 2:
+                cell_f, cell_b = self._cells[idx], self._cells[idx + 1]
+                idx += 2
+                of, sf = _run_rnn(cell_f, out, None, False, self.time_major)
+                ob, sb = _run_rnn(cell_b, out, None, True, self.time_major)
+                out = concat([of, ob], axis=-1)
+                states = [sf, sb]
+            else:
+                cell = self._cells[idx]
+                idx += 1
+                out, st = _run_rnn(cell, out, None, False, self.time_major)
+                states = [st]
+            for st in states:
+                if self.mode == 'LSTM':
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            if self.dropout > 0 and layer_i < self.num_layers - 1:
+                out = dropout_fn(out, self.dropout, training=self.training)
+        h = stack(final_h, axis=0)
+        if self.mode == 'LSTM':
+            c = stack(final_c, axis=0)
+            return out, (h, c)
+        return out, h
+
+
+class SimpleRNN(_StackedRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0,
+                 activation='tanh', **kwargs):
+        super().__init__('RNN', input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_StackedRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0, **kwargs):
+        super().__init__('LSTM', input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_StackedRNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction='forward', time_major=False, dropout=0.0, **kwargs):
+        super().__init__('GRU', input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

@@ -1,0 +1,47 @@
+"""Weight norm reparameterization (reference: python/paddle/nn/utils/weight_norm_hook.py)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter, run_op
+
+__all__ = ['weight_norm', 'remove_weight_norm']
+
+
+def _norm_except(w, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(w)))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name='weight', dim=0):
+    w = getattr(layer, name)
+    g = Parameter(_norm_except(w._data, dim))
+    v = Parameter(w._data)
+    layer.add_parameter(name + '_g', g)
+    layer.add_parameter(name + '_v', v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        gg, vv = lyr._parameters[name + '_g'], lyr._parameters[name + '_v']
+
+        def fn(gx, vx):
+            return vx * (gx / _norm_except(vx, dim))
+        w_new = run_op('weight_norm', fn, gg, vv)
+        object.__setattr__(lyr, '_wn_cache_' + name, w_new)
+        lyr.__dict__[name] = w_new
+        return None
+    layer._wn_hook = layer.register_forward_pre_hook(hook)
+    # materialize once so attribute exists pre-forward
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name='weight'):
+    g = layer._parameters.pop(name + '_g')
+    v = layer._parameters.pop(name + '_v')
+    w = v._data * (g._data / _norm_except(v._data, 0))
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(w))
+    if hasattr(layer, '_wn_hook'):
+        layer._wn_hook.remove()
+    return layer

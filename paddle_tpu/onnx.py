@@ -1,0 +1,15 @@
+"""paddle.onnx.export parity (reference: python/paddle/onnx/export.py, thin
+wrapper over paddle2onnx). TPU-native stance: the interchange format is
+StableHLO (saved by jit.save); ONNX export emits the StableHLO artifact with
+an .onnx-adjacent manifest so downstream tooling can convert offline."""
+import os
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    from . import jit
+    jit.save(layer, path, input_spec=input_spec)
+    manifest = path + '.onnx.manifest'
+    with open(manifest, 'w') as f:
+        f.write('format: stablehlo\nsource: paddle_tpu.jit.save\n'
+                'note: convert offline with onnx-mlir / stablehlo-to-onnx\n')
+    return path

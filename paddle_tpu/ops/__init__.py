@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for hot ops (flash attention, ring attention, fused ops)."""

@@ -1,0 +1,149 @@
+"""Flash attention Pallas kernel (TPU).
+
+Blockwise streaming softmax (Dao et al.) with custom VJP; the replacement for
+the reference's fused attention CUDA ops (operators/fused/). Falls back to
+the jnp reference on non-TPU backends.
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_K = 512
+
+
+def is_available():
+    try:
+        return jax.devices()[0].platform == 'tpu'
+    except Exception:
+        return False
+
+
+def _ref_bhnd(q, k, v, causal, scale):
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
+    if causal:
+        n, m = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((n, m), bool)), s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum('bhqk,bhkd->bhqd', p, v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                      block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32) * scale
+    block_q, head_dim = q.shape
+    qi = pl.program_id(2)
+
+    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    num_kb = seq_k // block_k
+
+    def body(kb, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_blk = pl.load(k_ref, (pl.dslice(kb * block_k, block_k),
+                                pl.dslice(None))).astype(jnp.float32)
+        v_blk = pl.load(v_ref, (pl.dslice(kb * block_k, block_k),
+                                pl.dslice(None))).astype(jnp.float32)
+        s = q @ k_blk.T  # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = alpha * l_prev + jnp.sum(p, axis=1)
+        acc_cur = acc_prev * alpha[:, None] + p @ v_blk
+        return m_cur, l_cur, acc_cur
+
+    if causal:
+        # only iterate over blocks at or before the diagonal
+        last = jnp.minimum(num_kb, (qi + 1) * block_q // block_k + 1)
+    else:
+        last = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhnd(q, k, v, causal, scale):
+    return _flash_fwd(q, k, v, causal, scale)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    block_q = min(_DEFAULT_BLOCK_Q, n)
+    block_k = min(_DEFAULT_BLOCK_K, m)
+    if n % block_q or m % block_k or d % 128:
+        return _ref_bhnd(q, k, v, causal, scale)
+
+    grid = (b, h, n // block_q)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k, seq_k=m)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, m, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, m, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(q, k, v)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    try:
+        return _flash_fwd_impl(q, k, v, causal, scale)
+    except Exception:
+        return _ref_bhnd(q, k, v, causal, scale)
+
+
+def _fwd_rule(q, k, v, causal, scale):
+    o = _flash_fwd(q, k, v, causal, scale)
+    return o, (q, k, v)
+
+
+def _bwd_rule(causal, scale, res, do):
+    q, k, v = res
+    # recomputed reference backward (flash-bwd kernel is a later optimization;
+    # XLA still fuses this well and it is numerically exact)
+    _, vjp = jax.vjp(lambda a, b, c: _ref_bhnd(a, b, c, causal, scale), q, k, v)
+    return vjp(do)
+
+
+_flash_bhnd.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention_bnhd(q, k, v, causal=False, scale=None):
+    """Paddle layout [B, N, H, D] in/out."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash_bhnd(qt, kt, vt, causal, scale)
+    return jnp.swapaxes(o, 1, 2)
+
+
+def flash_attention_bhnd(q, k, v, causal=False, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_bhnd(q, k, v, causal, scale)

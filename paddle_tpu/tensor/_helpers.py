@@ -1,0 +1,81 @@
+"""Shared helpers for the tensor op library.
+
+The "op table" replacing the reference's operator registry
+(paddle/fluid/framework/op_registry.h:278): every public tensor function is a
+thin wrapper that closes attrs over a pure jax function and routes through
+framework.core.run_op (which handles VJP recording). XLA is the kernel
+library; there is no per-place dispatch.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, as_jax, wrap_out
+from ..framework import dtype as dtype_mod
+
+
+def ensure_tensor(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(x, dtype=dtype)
+
+
+def jdt(dtype):
+    return dtype_mod.to_jax_dtype(dtype)
+
+
+def unary_op(name, fn):
+    def op(x, name=None):
+        return run_op(name or op.__name__, fn, ensure_tensor(x))
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def _promote(x, y):
+    """Paddle-ish binary promotion: python scalars follow tensor dtype."""
+    xt = isinstance(x, Tensor)
+    yt = isinstance(y, Tensor)
+    if xt and not yt and not hasattr(y, 'shape'):
+        y = Tensor(jnp.asarray(y, dtype=x._data.dtype))
+    elif yt and not xt and not hasattr(x, 'shape'):
+        x = Tensor(jnp.asarray(x, dtype=y._data.dtype))
+    return ensure_tensor(x), ensure_tensor(y)
+
+
+def binary_op(name, fn, int_to_float=False):
+    def op(x, y, name=None):
+        xt, yt = _promote(x, y)
+        if int_to_float and not jnp.issubdtype(xt._data.dtype, jnp.inexact) \
+                and not jnp.issubdtype(yt._data.dtype, jnp.inexact):
+            xt = Tensor(xt._data.astype(jnp.float32))
+        return run_op(name or op.__name__, fn, xt, yt)
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def axes_arg(axis):
+    """Normalize paddle axis arg (None | int | list | Tensor) to jnp form."""
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return int(a) if a.ndim == 0 else tuple(int(v) for v in a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(as_static_int(v)) for v in axis)
+    return int(axis)
+
+
+def as_static_int(v):
+    if isinstance(v, Tensor):
+        return int(v.numpy())
+    return int(v)
+
+
+def shape_arg(shape):
+    """Normalize paddle shape arg (list of int/Tensor, or Tensor) to tuple."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(as_static_int(s)) for s in shape)
+    return (int(shape),)

@@ -1,0 +1,36 @@
+"""Attribute ops (reference: python/paddle/tensor/attribute.py)."""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, wrap_out, run_op
+from ._helpers import ensure_tensor
+
+__all__ = ['shape', 'rank', 'is_floating_point', 'is_integer', 'is_complex',
+           'real', 'imag']
+
+
+def shape(input):
+    return wrap_out(jnp.asarray(ensure_tensor(input).shape, dtype=jnp.int32))
+
+
+def rank(input):
+    return wrap_out(jnp.asarray(ensure_tensor(input).ndim, dtype=jnp.int32))
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(ensure_tensor(x)._data.dtype, jnp.complexfloating)
+
+
+def real(x, name=None):
+    return run_op('real', jnp.real, ensure_tensor(x))
+
+
+def imag(x, name=None):
+    return run_op('imag', jnp.imag, ensure_tensor(x))

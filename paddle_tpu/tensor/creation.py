@@ -1,0 +1,171 @@
+"""Tensor creation API (reference: python/paddle/tensor/creation.py)."""
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, to_tensor, wrap_out
+from ..framework import dtype as dtype_mod
+from ._helpers import ensure_tensor, jdt, shape_arg
+
+__all__ = [
+    'to_tensor', 'zeros', 'ones', 'full', 'zeros_like', 'ones_like',
+    'full_like', 'arange', 'linspace', 'logspace', 'eye', 'empty',
+    'empty_like', 'meshgrid', 'diag', 'diagflat', 'tril', 'triu', 'assign',
+    'clone', 'numel', 'tril_indices', 'triu_indices', 'complex', 'as_tensor',
+]
+
+
+def _default(dtype):
+    return jdt(dtype) if dtype else jdt(dtype_mod.get_default_dtype())
+
+
+def zeros(shape, dtype=None, name=None):
+    return wrap_out(jnp.zeros(shape_arg(shape), _default(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return wrap_out(jnp.ones(shape_arg(shape), _default(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return wrap_out(jnp.full(shape_arg(shape), fill_value, _default(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jnp.zeros_like(x._data, dtype=jdt(dtype) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jnp.ones_like(x._data, dtype=jdt(dtype) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jnp.full_like(x._data, fill_value, dtype=jdt(dtype) if dtype else None))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = 'int64' if all(
+            isinstance(v, (int, np.integer)) for v in (start, end, step)) else \
+            dtype_mod.get_default_dtype()
+    return wrap_out(jnp.arange(start, end, step, dtype=jdt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return wrap_out(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                 dtype=_default(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return wrap_out(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                                 base=_v(base), dtype=_default(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return wrap_out(jnp.eye(int(num_rows),
+                            int(num_columns) if num_columns is not None else None,
+                            dtype=_default(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    tensors = [ensure_tensor(a) for a in args]
+    outs = run_op('meshgrid', lambda *xs: tuple(jnp.meshgrid(*xs, indexing='ij')),
+                  *tensors)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+    if padding_value == 0 or x.ndim == 2:
+        return run_op('diag', lambda a: jnp.diag(a, k=offset), x)
+
+    def fn(a):
+        d = jnp.diag(a, k=offset)
+        mask = jnp.eye(d.shape[0], dtype=bool) if False else None
+        n = a.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, a.dtype)
+        idx = jnp.arange(a.shape[0])
+        return out.at[idx, idx + offset].set(a) if offset >= 0 else \
+            out.at[idx - offset, idx].set(a)
+    return run_op('diag', fn, x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return run_op('diagflat', lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return run_op('tril', lambda a: jnp.tril(a, k=diagonal), ensure_tensor(x))
+
+
+def triu(x, diagonal=0, name=None):
+    return run_op('triu', lambda a: jnp.triu(a, k=diagonal), ensure_tensor(x))
+
+
+def tril_indices(row, col=None, offset=0, dtype='int64'):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return wrap_out(jnp.stack([r, c]).astype(jdt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype='int64'):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return wrap_out(jnp.stack([r, c]).astype(jdt(dtype)))
+
+
+def assign(x, output=None):
+    x = ensure_tensor(x) if not isinstance(x, (list, tuple, np.ndarray, float, int)) \
+        else Tensor(np.asarray(x))
+    out = run_op('assign', lambda a: a + 0, x)
+    if output is not None:
+        output._data = out._data
+        output._grad_node = out._grad_node
+        output._node_out_idx = out._node_out_idx
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def numel(x, name=None):
+    return wrap_out(jnp.asarray(ensure_tensor(x).size, dtype=jnp.int64))
+
+
+def complex(real, imag, name=None):
+    return run_op('complex', lambda r, i: jax_lax_complex(r, i),
+                  ensure_tensor(real), ensure_tensor(imag))
+
+
+def jax_lax_complex(r, i):
+    import jax.lax as lax
+    return lax.complex(r, i)
+
+
+def as_tensor(data, dtype=None):
+    return to_tensor(data, dtype=dtype)

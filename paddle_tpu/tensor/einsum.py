@@ -1,0 +1,13 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py) — jnp.einsum, which XLA
+maps straight onto the MXU for contraction-heavy expressions."""
+import jax.numpy as jnp
+
+from ..framework.core import run_op
+from ._helpers import ensure_tensor
+
+__all__ = ['einsum']
+
+
+def einsum(equation, *operands):
+    ts = [ensure_tensor(t) for t in operands]
+    return run_op('einsum', lambda *xs: jnp.einsum(equation, *xs), *ts)

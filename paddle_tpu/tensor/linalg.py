@@ -1,0 +1,202 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out
+from ._helpers import ensure_tensor, axes_arg
+from .math import matmul, dot, mm, bmm, mv, addmm
+
+__all__ = [
+    'matmul', 'dot', 'mm', 'bmm', 'mv', 'addmm', 'norm', 'dist', 'cond',
+    'cholesky', 'inv', 'det', 'slogdet', 'svd', 'qr', 'eig', 'eigh',
+    'eigvals', 'eigvalsh', 'solve', 'triangular_solve', 'cholesky_solve',
+    'lstsq', 'matrix_power', 'matrix_rank', 'pinv', 'cross', 'multi_dot',
+    'histogram', 'bincount', 'corrcoef', 'cov', 'lu',
+]
+
+
+def norm(x, p='fro', axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+
+    def fn(a):
+        if p == 'fro' and ax is None:
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        if p == 'fro':
+            return jnp.linalg.norm(a, ord='fro' if isinstance(ax, tuple) else None,
+                                   axis=ax, keepdims=keepdim)
+        if p in (float('inf'), 'inf'):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p in (float('-inf'), '-inf'):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim),
+                         1.0 / p)
+    return run_op('norm', fn, x)
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float('inf'):
+            return jnp.max(d)
+        if p == float('-inf'):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return run_op('dist', fn, x, y)
+
+
+def cond(x, p=None, name=None):
+    return run_op('cond', lambda a: jnp.linalg.cond(a, p=p), ensure_tensor(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return run_op('cholesky', fn, ensure_tensor(x))
+
+
+def inv(x, name=None):
+    return run_op('inv', jnp.linalg.inv, ensure_tensor(x))
+
+
+def det(x, name=None):
+    return run_op('det', jnp.linalg.det, ensure_tensor(x))
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    outs = run_op('slogdet', lambda a: tuple(jnp.linalg.slogdet(a)), x)
+    return run_op('stack_slogdet', lambda s, l: jnp.stack([s, l]), outs[0], outs[1])
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return run_op('svd',
+                  lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode='reduced', name=None):
+    x = ensure_tensor(x)
+    if mode == 'r':
+        return run_op('qr_r', lambda a: jnp.linalg.qr(a, mode='r'), x)
+    return run_op('qr', lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    import numpy as np
+    w, v = np.linalg.eig(x.numpy())
+    return wrap_out(jnp.asarray(w)), wrap_out(jnp.asarray(v))
+
+
+def eigh(x, UPLO='L', name=None):
+    x = ensure_tensor(x)
+    return run_op('eigh', lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    return wrap_out(jnp.asarray(np.linalg.eigvals(ensure_tensor(x).numpy())))
+
+
+def eigvalsh(x, UPLO='L', name=None):
+    return run_op('eigvalsh', lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO),
+                  ensure_tensor(x))
+
+
+def solve(x, y, name=None):
+    return run_op('solve', jnp.linalg.solve, ensure_tensor(x), ensure_tensor(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return run_op('triangular_solve', fn, ensure_tensor(x), ensure_tensor(y))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return run_op('cholesky_solve', fn, ensure_tensor(x), ensure_tensor(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return (wrap_out(sol), wrap_out(res), wrap_out(rank), wrap_out(sv))
+
+
+def matrix_power(x, n, name=None):
+    return run_op('matrix_power', lambda a: jnp.linalg.matrix_power(a, n),
+                  ensure_tensor(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    t = tol._data if isinstance(tol, Tensor) else tol
+    return wrap_out(jnp.linalg.matrix_rank(x._data, tol=t))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return run_op('pinv', lambda a: jnp.linalg.pinv(a, rcond=rcond,
+                                                    hermitian=hermitian),
+                  ensure_tensor(x))
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis of size 3
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return run_op('cross', lambda a, b: jnp.cross(a, b, axis=ax), x, y)
+
+
+def multi_dot(x, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return run_op('multi_dot', lambda *xs: jnp.linalg.multi_dot(xs), *ts)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    a = ensure_tensor(input)._data
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    h, _ = jnp.histogram(a, bins=bins, range=(lo, hi))
+    return wrap_out(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = ensure_tensor(x)._data
+    w = ensure_tensor(weights)._data if weights is not None else None
+    n = max(int(a.max()) + 1 if a.size else 0, minlength)
+    return wrap_out(jnp.bincount(a, weights=w, length=n))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return run_op('corrcoef', lambda a: jnp.corrcoef(a, rowvar=rowvar),
+                  ensure_tensor(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = ensure_tensor(fweights)._data if fweights is not None else None
+    aw = ensure_tensor(aweights)._data if aweights is not None else None
+    return run_op('cov', lambda a: jnp.cov(a, rowvar=rowvar,
+                                           ddof=1 if ddof else 0,
+                                           fweights=fw, aweights=aw),
+                  ensure_tensor(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = ensure_tensor(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    outs = (wrap_out(lu_), wrap_out(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (wrap_out(jnp.zeros((), jnp.int32)),)
+    return outs

@@ -1,0 +1,72 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py).
+
+All comparison outputs are bool and non-differentiable — they bypass the tape.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, wrap_out, run_op
+from ._helpers import ensure_tensor, _promote
+
+__all__ = [
+    'equal', 'not_equal', 'less_than', 'less_equal', 'greater_than',
+    'greater_equal', 'logical_and', 'logical_or', 'logical_xor', 'logical_not',
+    'bitwise_and', 'bitwise_or', 'bitwise_xor', 'bitwise_not', 'is_empty',
+    'is_tensor', 'allclose', 'isclose', 'equal_all',
+]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        xt, yt = _promote(x, y)
+        return wrap_out(fn(xt._data, yt._data))
+    op.__name__ = name
+    return op
+
+
+equal = _cmp('equal', jnp.equal)
+not_equal = _cmp('not_equal', jnp.not_equal)
+less_than = _cmp('less_than', jnp.less)
+less_equal = _cmp('less_equal', jnp.less_equal)
+greater_than = _cmp('greater_than', jnp.greater)
+greater_equal = _cmp('greater_equal', jnp.greater_equal)
+logical_and = _cmp('logical_and', jnp.logical_and)
+logical_or = _cmp('logical_or', jnp.logical_or)
+logical_xor = _cmp('logical_xor', jnp.logical_xor)
+bitwise_and = _cmp('bitwise_and', jnp.bitwise_and)
+bitwise_or = _cmp('bitwise_or', jnp.bitwise_or)
+bitwise_xor = _cmp('bitwise_xor', jnp.bitwise_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return wrap_out(jnp.logical_not(ensure_tensor(x)._data))
+
+
+def bitwise_not(x, out=None, name=None):
+    return wrap_out(jnp.bitwise_not(ensure_tensor(x)._data))
+
+
+def is_empty(x, name=None):
+    return wrap_out(jnp.asarray(ensure_tensor(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return wrap_out(jnp.allclose(x._data, y._data, rtol=float(rtol),
+                                 atol=float(atol), equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return wrap_out(jnp.isclose(x._data, y._data, rtol=float(rtol),
+                                atol=float(atol), equal_nan=equal_nan))
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if tuple(x.shape) != tuple(y.shape):
+        return wrap_out(jnp.asarray(False))
+    return wrap_out(jnp.all(jnp.equal(x._data, y._data)))

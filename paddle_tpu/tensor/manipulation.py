@@ -1,0 +1,462 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+import builtins
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out
+from ._helpers import ensure_tensor, axes_arg, shape_arg, jdt, as_static_int
+
+__all__ = [
+    'reshape', 'transpose', 'concat', 'stack', 'unstack', 'split', 'chunk',
+    'squeeze', 'unsqueeze', 'flatten', 'gather', 'gather_nd', 'scatter',
+    'scatter_nd', 'scatter_nd_add', 'tile', 'expand', 'expand_as',
+    'broadcast_to', 'broadcast_tensors', 'flip', 'roll', 'cast', 'slice',
+    'strided_slice', 'unique', 'unique_consecutive', 'masked_select',
+    'index_select', 'index_sample', 'take_along_axis', 'put_along_axis',
+    'tensordot', 'moveaxis', 'rot90', 'as_complex', 'as_real', 'repeat_interleave',
+    'tolist', 'crop', 'fill_diagonal_', 'unbind', 'atleast_1d', 'atleast_2d', 'atleast_3d',
+]
+
+
+def _identity_op(x):
+    return run_op('identity', lambda a: a + 0, ensure_tensor(x))
+
+
+def cast(x, dtype):
+    x = ensure_tensor(x)
+    jd = jdt(dtype)
+    if jnp.issubdtype(jd, jnp.inexact) and jnp.issubdtype(x._data.dtype, jnp.inexact):
+        return run_op('cast', lambda a: a.astype(jd), x)
+    return wrap_out(x._data.astype(jd))
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = shape_arg(shape)
+    return run_op('reshape', lambda a: jnp.reshape(a, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._grad_node, x._node_out_idx = out._data, out._grad_node, out._node_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def transpose(x, perm=None, name=None):
+    x = ensure_tensor(x)
+    p = tuple(int(v) for v in perm) if perm is not None else None
+    return run_op('transpose', lambda a: jnp.transpose(a, p), x)
+
+
+def t(x, name=None):
+    x = ensure_tensor(x)
+    if x.ndim > 2:
+        raise ValueError("paddle.t only supports ndim<=2")
+    return run_op('t', lambda a: a.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return run_op('moveaxis',
+                  lambda a: jnp.moveaxis(a, source, destination), ensure_tensor(x))
+
+
+def concat(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    ax = as_static_int(axis)
+    return run_op('concat', lambda *xs: jnp.concatenate(xs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [ensure_tensor(t) for t in x]
+    return run_op('stack', lambda *xs: jnp.stack(xs, axis=axis), *tensors)
+
+
+def unstack(x, axis=0, num=None):
+    x = ensure_tensor(x)
+    n = num or x.shape[axis]
+    outs = run_op('unstack',
+                  lambda a: tuple(jnp.squeeze(s, axis=axis)
+                                  for s in jnp.split(a, n, axis=axis)), x)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    ax = as_static_int(axis)
+    if isinstance(num_or_sections, int):
+        outs = run_op('split', lambda a: tuple(jnp.split(a, num_or_sections, axis=ax)), x)
+    else:
+        secs = [as_static_int(s) for s in num_or_sections]
+        total = x.shape[ax]
+        known = [s for s in secs if s != -1]
+        secs = [s if s != -1 else total - int(np.sum(known)) for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = run_op('split', lambda a: tuple(jnp.split(a, idx, axis=ax)), x)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def fn(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        real = tuple(i for i in ax if a.shape[i if i >= 0 else a.ndim + i] == 1)
+        return jnp.squeeze(a, axis=real) if real else a
+    return run_op('squeeze', fn, x)
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    if isinstance(ax, int):
+        ax = (ax,)
+
+    def fn(a):
+        for i in sorted(ax):
+            a = jnp.expand_dims(a, i)
+        return a
+    return run_op('unsqueeze', fn, x)
+
+
+unsqueeze_ = unsqueeze
+squeeze_ = squeeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    s = start_axis if start_axis >= 0 else nd + start_axis
+    e = stop_axis if stop_axis >= 0 else nd + stop_axis
+
+    def fn(a):
+        shp = list(a.shape)
+        new = shp[:s] + [-1] + shp[e + 1:]
+        return jnp.reshape(a, new)
+    return run_op('flatten', fn, x)
+
+
+def gather(x, index, axis=0, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data
+    ax = as_static_int(axis) if not isinstance(axis, type(None)) else 0
+
+    def fn(a):
+        i = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(a, i, axis=ax)
+    return run_op('gather', fn, x)
+
+
+def gather_nd(x, index, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data
+
+    def fn(a):
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[ii]
+    return run_op('gather_nd', fn, x)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x = ensure_tensor(x)
+    u = ensure_tensor(updates)
+    idx = ensure_tensor(index)._data.reshape(-1)
+
+    def fn(a, up):
+        if overwrite:
+            return a.at[idx].set(up)
+        zeroed = a.at[idx].set(jnp.zeros_like(up))
+        return zeroed.at[idx].add(up)
+    return run_op('scatter', fn, x, u)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data, x._grad_node, x._node_out_idx = out._data, out._grad_node, out._node_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x = ensure_tensor(x)
+    u = ensure_tensor(updates)
+    idx = ensure_tensor(index)._data
+
+    def fn(a, up):
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return a.at[ii].add(up)
+    return run_op('scatter_nd_add', fn, x, u)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    u = ensure_tensor(updates)
+    idx = ensure_tensor(index)._data
+    shp = shape_arg(shape)
+
+    def fn(up):
+        base = jnp.zeros(shp, up.dtype)
+        ii = tuple(jnp.moveaxis(idx, -1, 0))
+        return base.at[ii].add(up)
+    return run_op('scatter_nd', fn, u)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = shape_arg(repeat_times)
+    return run_op('tile', lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    shp = list(shape_arg(shape))
+    xs = x.shape
+    off = len(shp) - len(xs)
+    for i in range(len(shp)):
+        if shp[i] == -1:
+            shp[i] = xs[i - off] if i >= off else 1
+    return run_op('expand', lambda a: jnp.broadcast_to(a, tuple(shp)), x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, ensure_tensor(y).shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(input, name=None):
+    ts = [ensure_tensor(t) for t in input]
+    shp = jnp.broadcast_shapes(*[tuple(t.shape) for t in ts])
+    return [expand(t, shp) for t in ts]
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('flip', lambda a: jnp.flip(a, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return run_op('rot90', lambda a: jnp.rot90(a, k=k, axes=tuple(axes)),
+                  ensure_tensor(x))
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    sh = shifts if isinstance(shifts, int) else tuple(int(s) for s in shifts)
+    return run_op('roll', lambda a: jnp.roll(a, sh, axis=ax), x)
+
+
+def slice(input, axes, starts, ends, name=None):
+    x = ensure_tensor(input)
+    axes = [as_static_int(a) for a in axes]
+    starts = [as_static_int(s) for s in starts]
+    ends = [as_static_int(e) for e in ends]
+
+    def fn(a):
+        idx = [builtin_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtin_slice(s, e)
+        return a[tuple(idx)]
+    return run_op('slice', fn, x)
+
+
+builtin_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = ensure_tensor(x)
+    axes = [as_static_int(a) for a in axes]
+    starts = [as_static_int(s) for s in starts]
+    ends = [as_static_int(e) for e in ends]
+    strides = [as_static_int(s) for s in strides]
+
+    def fn(a):
+        idx = [builtin_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtin_slice(s, e, st)
+        return a[tuple(idx)]
+    return run_op('strided_slice', fn, x)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype='int64', name=None):
+    x = ensure_tensor(x)
+    vals, idx, inv, cnt = np.unique(x.numpy(), return_index=True,
+                                    return_inverse=True, return_counts=True, axis=axis)
+    outs = [wrap_out(jnp.asarray(vals))]
+    if return_index:
+        outs.append(wrap_out(jnp.asarray(idx, dtype=jdt(dtype))))
+    if return_inverse:
+        outs.append(wrap_out(jnp.asarray(inv, dtype=jdt(dtype))))
+    if return_counts:
+        outs.append(wrap_out(jnp.asarray(cnt, dtype=jdt(dtype))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype='int64', name=None):
+    a = ensure_tensor(x).numpy()
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.ones(a.shape[0], dtype=bool)
+    keep[1:] = np.any((a[1:] != a[:-1]).reshape(a.shape[0] - 1, -1), axis=1) \
+        if a.ndim > 1 else a[1:] != a[:-1]
+    vals = a[keep]
+    outs = [wrap_out(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(wrap_out(jnp.asarray(inv, dtype=jdt(dtype))))
+    if return_counts:
+        pos = np.flatnonzero(keep)
+        cnt = np.diff(np.append(pos, a.shape[0]))
+        outs.append(wrap_out(jnp.asarray(cnt, dtype=jdt(dtype))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def masked_select(x, mask, name=None):
+    x = ensure_tensor(x)
+    m = ensure_tensor(mask).numpy().astype(bool)
+    flat_idx = jnp.asarray(np.flatnonzero(np.broadcast_to(m, x._data.shape).reshape(-1)))
+
+    def fn(a):
+        return a.reshape(-1)[flat_idx]
+    return run_op('masked_select', fn, x)
+
+
+def index_select(x, index, axis=0, name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data
+    return run_op('index_select', lambda a: jnp.take(a, idx, axis=axis), x)
+
+
+def index_sample(x, index):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data
+
+    def fn(a):
+        rows = jnp.arange(a.shape[0])[:, None]
+        return a[rows, idx]
+    return run_op('index_sample', fn, x)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    x = ensure_tensor(arr)
+    idx = ensure_tensor(indices)._data
+    return run_op('take_along_axis',
+                  lambda a: jnp.take_along_axis(a, idx, axis=axis), x)
+
+
+def put_along_axis(arr, indices, values, axis, reduce='assign', name=None):
+    x = ensure_tensor(arr)
+    v = ensure_tensor(values)
+    idx = ensure_tensor(indices)._data
+
+    def fn(a, val):
+        val = jnp.broadcast_to(val, idx.shape).astype(a.dtype)
+        if reduce == 'add':
+            dim_idx = [jnp.arange(s).reshape([-1 if i == d else 1
+                                              for i in range(a.ndim)])
+                       for d, s in enumerate(idx.shape)]
+            dim_idx[axis] = idx
+            return a.at[tuple(dim_idx)].add(val)
+        dim_idx = [jnp.arange(s).reshape([-1 if i == d else 1
+                                          for i in range(a.ndim)])
+                   for d, s in enumerate(idx.shape)]
+        dim_idx[axis] = idx
+        if reduce == 'multiply' or reduce == 'mul':
+            return a.at[tuple(dim_idx)].multiply(val)
+        return a.at[tuple(dim_idx)].set(val)
+    return run_op('put_along_axis', fn, x, v)
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a) if isinstance(a, (list, tuple)) else a for a in ax)
+    return run_op('tensordot', lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def as_complex(x, name=None):
+    return run_op('as_complex', lambda a: jax.lax.complex(a[..., 0], a[..., 1]),
+                  ensure_tensor(x))
+
+
+def as_real(x, name=None):
+    return run_op('as_real',
+                  lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                  ensure_tensor(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    r = ensure_tensor(repeats)._data if isinstance(repeats, Tensor) else repeats
+    total = None
+    if not isinstance(r, int):
+        total = int(np.sum(np.asarray(r)))
+    return run_op('repeat_interleave',
+                  lambda a: jnp.repeat(a, r, axis=axis, total_repeat_length=total), x)
+
+
+def tolist(x):
+    return ensure_tensor(x).tolist()
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    shp = shape_arg(shape)
+    offs = [as_static_int(o) for o in offsets] if offsets is not None else [0] * x.ndim
+    shp = [s if s != -1 else x.shape[i] - offs[i] for i, s in enumerate(shp)]
+
+    def fn(a):
+        idx = tuple(builtin_slice(o, o + s) for o, s in zip(offs, shp))
+        return a[idx]
+    return run_op('crop', fn, x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x = ensure_tensor(x)
+    n = min(x.shape[-2], x.shape[-1])
+    i = jnp.arange(n - (offset if offset > 0 else 0))
+
+    def fn(a):
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        return a.at[..., r, c].set(value)
+    out = run_op('fill_diagonal_', fn, x)
+    x._data, x._grad_node, x._node_out_idx = out._data, out._grad_node, out._node_out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [run_op('atleast_1d', jnp.atleast_1d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [run_op('atleast_2d', jnp.atleast_2d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [run_op('atleast_3d', jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs

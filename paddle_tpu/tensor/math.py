@@ -1,0 +1,314 @@
+"""Math op library (reference: python/paddle/tensor/math.py, ~150 fns)."""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out
+from ._helpers import ensure_tensor, unary_op, binary_op, axes_arg, jdt, _promote
+
+# -- elementwise unary ------------------------------------------------------
+exp = unary_op('exp', jnp.exp)
+expm1 = unary_op('expm1', jnp.expm1)
+log = unary_op('log', jnp.log)
+log2 = unary_op('log2', jnp.log2)
+log10 = unary_op('log10', jnp.log10)
+log1p = unary_op('log1p', jnp.log1p)
+sqrt = unary_op('sqrt', jnp.sqrt)
+rsqrt = unary_op('rsqrt', jax.lax.rsqrt)
+square = unary_op('square', jnp.square)
+abs = unary_op('abs', jnp.abs)
+sign = unary_op('sign', jnp.sign)
+neg = unary_op('neg', jnp.negative)
+reciprocal = unary_op('reciprocal', jnp.reciprocal)
+sin = unary_op('sin', jnp.sin)
+cos = unary_op('cos', jnp.cos)
+tan = unary_op('tan', jnp.tan)
+asin = unary_op('asin', jnp.arcsin)
+acos = unary_op('acos', jnp.arccos)
+atan = unary_op('atan', jnp.arctan)
+sinh = unary_op('sinh', jnp.sinh)
+cosh = unary_op('cosh', jnp.cosh)
+tanh = unary_op('tanh', jnp.tanh)
+asinh = unary_op('asinh', jnp.arcsinh)
+acosh = unary_op('acosh', jnp.arccosh)
+atanh = unary_op('atanh', jnp.arctanh)
+erf = unary_op('erf', jax.scipy.special.erf)
+erfinv = unary_op('erfinv', jax.scipy.special.erfinv)
+floor = unary_op('floor', jnp.floor)
+ceil = unary_op('ceil', jnp.ceil)
+round = unary_op('round', jnp.round)
+trunc = unary_op('trunc', jnp.trunc)
+frac = unary_op('frac', lambda x: x - jnp.trunc(x))
+angle = unary_op('angle', jnp.angle)
+conj = unary_op('conj', jnp.conj)
+digamma = unary_op('digamma', jax.scipy.special.digamma)
+lgamma = unary_op('lgamma', jax.scipy.special.gammaln)
+sigmoid = unary_op('sigmoid', jax.nn.sigmoid)
+i0 = unary_op('i0', lambda x: jax.scipy.special.i0(x))
+
+# -- elementwise binary -----------------------------------------------------
+add = binary_op('add', jnp.add)
+subtract = binary_op('subtract', jnp.subtract)
+multiply = binary_op('multiply', jnp.multiply)
+divide = binary_op('divide', jnp.divide, int_to_float=True)
+floor_divide = binary_op('floor_divide', jnp.floor_divide)
+mod = binary_op('mod', jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = binary_op('pow', jnp.power)
+maximum = binary_op('maximum', jnp.maximum)
+minimum = binary_op('minimum', jnp.minimum)
+fmax = binary_op('fmax', jnp.fmax)
+fmin = binary_op('fmin', jnp.fmin)
+atan2 = binary_op('atan2', jnp.arctan2)
+hypot = binary_op('hypot', jnp.hypot)
+logaddexp = binary_op('logaddexp', jnp.logaddexp)
+heaviside = binary_op('heaviside', jnp.heaviside)
+nextafter = binary_op('nextafter', jnp.nextafter)
+copysign = binary_op('copysign', jnp.copysign)
+ldexp = binary_op('ldexp', jnp.ldexp)
+gcd = binary_op('gcd', jnp.gcd)
+lcm = binary_op('lcm', jnp.lcm)
+inner = binary_op('inner', jnp.inner)
+outer = binary_op('outer', jnp.outer)
+kron = binary_op('kron', jnp.kron)
+
+# legacy names
+elementwise_add, elementwise_sub = add, subtract
+elementwise_mul, elementwise_div = multiply, divide
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = ensure_tensor(x)
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def fn(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+    out = run_op('scale', fn, x)
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    x = ensure_tensor(x)
+    out = run_op('increment', lambda a: a + value, x)
+    x._data = out._data
+    return x
+
+
+def clip(x, min=None, max=None, name=None):
+    x = ensure_tensor(x)
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return run_op('clip', lambda a: jnp.clip(a, mn, mx), x)
+
+
+def lerp(x, y, weight, name=None):
+    x, y = _promote(x, y)
+    if isinstance(weight, Tensor):
+        return run_op('lerp', lambda a, b, w: a + w * (b - a), x, y, weight)
+    return run_op('lerp', lambda a, b: a + weight * (b - a), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return run_op('stanh', lambda a: scale_b * jnp.tanh(scale_a * a), ensure_tensor(x))
+
+
+def multiplex(inputs, index, name=None):
+    idx = ensure_tensor(index)
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def fn(ix, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        ix = ix.reshape(-1)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[ix, rows]
+    return run_op('multiplex', fn, idx, *ts)
+
+
+# -- reductions -------------------------------------------------------------
+def _reduction(op_name, fn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = ensure_tensor(x)
+        ax = axes_arg(axis)
+        kw = {}
+        if dtype is not None:
+            kw['dtype'] = jdt(dtype)
+        return run_op(op_name, lambda a: fn(a, axis=ax, keepdims=keepdim, **kw), x)
+    op.__name__ = op_name
+    return op
+
+
+sum = _reduction('sum', jnp.sum)
+prod = _reduction('prod', jnp.prod)
+mean = _reduction('mean', jnp.mean)
+max = _reduction('max', jnp.max)
+min = _reduction('min', jnp.min)
+amax = _reduction('amax', jnp.max)
+amin = _reduction('amin', jnp.min)
+nansum = _reduction('nansum', jnp.nansum)
+nanmean = _reduction('nanmean', jnp.nanmean)
+all = _reduction('all', jnp.all)
+any = _reduction('any', jnp.any)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('logsumexp',
+                  lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return wrap_out(jnp.count_nonzero(x._data, axis=ax, keepdims=keepdim))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=jdt(dtype) if dtype else None)
+        return jnp.cumsum(a, axis=int(axis), dtype=jdt(dtype) if dtype else None)
+    return run_op('cumsum', fn, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return run_op('cumprod',
+                  lambda a: jnp.cumprod(a, axis=int(dim), dtype=jdt(dtype) if dtype else None), x)
+
+
+def cummax(x, axis=None, dtype='int64', name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    vals = run_op('cummax',
+                  lambda a: jax.lax.cummax(a.reshape(-1) if axis is None else a,
+                                           axis=ax), x)
+    # indices computed without grad
+    a = x._data.reshape(-1) if axis is None else x._data
+    eq = jnp.equal(jax.lax.cummax(a, axis=ax), a)
+    ar = jnp.arange(a.shape[ax]).reshape(
+        [-1 if i == ax else 1 for i in range(a.ndim)])
+    indices = jax.lax.cummax(jnp.where(eq, ar, -1), axis=ax)
+    return vals, wrap_out(indices.astype(jdt(dtype)))
+
+
+def cummin(x, axis=None, dtype='int64', name=None):
+    x = ensure_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    a = x._data.reshape(-1) if axis is None else x._data
+    vals = run_op('cummin', lambda v: jax.lax.cummin(v.reshape(-1) if axis is None else v,
+                                                     axis=ax), x)
+    eq = jnp.equal(jax.lax.cummin(a, axis=ax), a)
+    ar = jnp.arange(a.shape[ax]).reshape([-1 if i == ax else 1 for i in range(a.ndim)])
+    indices = jax.lax.cummax(jnp.where(eq, ar, -1), axis=ax)
+    return vals, wrap_out(indices.astype(jdt(dtype)))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return run_op('diff', lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op('trace',
+                  lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                  ensure_tensor(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return run_op('diagonal',
+                  lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                  ensure_tensor(x))
+
+
+# -- predicates (no grad) ---------------------------------------------------
+def isfinite(x, name=None):
+    return wrap_out(jnp.isfinite(ensure_tensor(x)._data))
+
+
+def isinf(x, name=None):
+    return wrap_out(jnp.isinf(ensure_tensor(x)._data))
+
+
+def isnan(x, name=None):
+    return wrap_out(jnp.isnan(ensure_tensor(x)._data))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return run_op('nan_to_num',
+                  lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                  ensure_tensor(x))
+
+
+# -- matmul-family (also exported via linalg) -------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = _promote(x, y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return run_op('matmul', fn, x, y)
+
+
+def dot(x, y, name=None):
+    x, y = _promote(x, y)
+
+    def fn(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return run_op('dot', fn, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return run_op('addmm', lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                  ensure_tensor(input), ensure_tensor(x), ensure_tensor(y))
+
+
+def rad2deg(x, name=None):
+    return run_op('rad2deg', jnp.rad2deg, ensure_tensor(x))
+
+
+def deg2rad(x, name=None):
+    return run_op('deg2rad', jnp.deg2rad, ensure_tensor(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def take(x, index, mode='raise', name=None):
+    x = ensure_tensor(x)
+    idx = ensure_tensor(index)._data
+
+    def fn(a):
+        flat = a.reshape(-1)
+        i = idx
+        if mode == 'wrap':
+            i = jnp.mod(i, flat.shape[0])
+        elif mode == 'clip':
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        return flat[i]
+    return run_op('take', fn, x)

@@ -1,0 +1,114 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Eager path draws keys from the global Generator (framework/random.py). Under a
+jit trace these appear as constants of the trace — the train-step compiler
+threads a live key instead (framework/functional.py).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, wrap_out, run_op
+from ..framework import random as rng
+from ..framework import dtype as dtype_mod
+from ._helpers import ensure_tensor, shape_arg, jdt
+
+__all__ = [
+    'rand', 'randn', 'randint', 'randint_like', 'randperm', 'uniform',
+    'normal', 'standard_normal', 'bernoulli', 'multinomial', 'poisson',
+    'uniform_', 'normal_', 'exponential_',
+]
+
+
+def _default(dtype):
+    return jdt(dtype) if dtype else jdt(dtype_mod.get_default_dtype())
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return wrap_out(jax.random.uniform(key, shape_arg(shape), _default(dtype),
+                                       minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return wrap_out(jax.random.normal(rng.next_key(), shape_arg(shape),
+                                      _default(dtype)))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = ensure_tensor(mean)._data if isinstance(mean, Tensor) else mean
+        s = ensure_tensor(std)._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            m.shape if hasattr(m, 'shape') else (),
+            s.shape if hasattr(s, 'shape') else ())
+        return wrap_out(m + s * jax.random.normal(rng.next_key(), shp, jnp.float32))
+    shp = shape_arg(shape) if shape is not None else ()
+    return wrap_out(mean + std * jax.random.normal(rng.next_key(), shp,
+                                                   _default(None)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype='int64', name=None):
+    if high is None:
+        low, high = 0, low
+    return wrap_out(jax.random.randint(rng.next_key(), shape_arg(shape),
+                                       low, high, jdt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype='int64', name=None):
+    return wrap_out(jax.random.permutation(rng.next_key(), n).astype(jdt(dtype)))
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jax.random.bernoulli(rng.next_key(), x._data).astype(x._data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(rng.next_key(), logits, axis=-1,
+                                     shape=(num_samples,) + x._data.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        g = jax.random.gumbel(rng.next_key(), x._data.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap_out(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    return wrap_out(jax.random.poisson(rng.next_key(), x._data).astype(x._data.dtype))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    x = ensure_tensor(x)
+    x._data = jax.random.uniform(rng.next_key(), tuple(x._data.shape),
+                                 x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x = ensure_tensor(x)
+    x._data = mean + std * jax.random.normal(rng.next_key(), tuple(x._data.shape),
+                                             x._data.dtype)
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    x._data = jax.random.exponential(rng.next_key(),
+                                     tuple(x._data.shape), x._data.dtype) / lam
+    return x

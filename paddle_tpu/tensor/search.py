@@ -1,0 +1,134 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py).
+
+Mixed-output ops (values+indices) follow the tape rule from framework/core:
+indices are computed grad-free first, then differentiable values are gathered
+with a recorded op, so VJPs never see integer cotangents.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out
+from ._helpers import ensure_tensor, jdt
+
+__all__ = [
+    'argmax', 'argmin', 'argsort', 'sort', 'topk', 'where', 'nonzero',
+    'index_select', 'masked_select', 'searchsorted', 'kthvalue', 'mode',
+    'index_sample',
+]
+
+from .manipulation import index_select, masked_select, index_sample, take_along_axis
+
+
+def argmax(x, axis=None, keepdim=False, dtype='int64', name=None):
+    x = ensure_tensor(x)
+    a = x._data
+    if axis is None:
+        out = jnp.argmax(a.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * a.ndim)
+        return wrap_out(out.astype(jdt(dtype)))
+    out = jnp.argmax(a, axis=int(axis), keepdims=keepdim)
+    return wrap_out(out.astype(jdt(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype='int64', name=None):
+    x = ensure_tensor(x)
+    a = x._data
+    if axis is None:
+        out = jnp.argmin(a.reshape(-1))
+        if keepdim:
+            out = out.reshape((1,) * a.ndim)
+        return wrap_out(out.astype(jdt(dtype)))
+    out = jnp.argmin(a, axis=int(axis), keepdims=keepdim)
+    return wrap_out(out.astype(jdt(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    a = x._data
+    idx = jnp.argsort(-a if descending else a, axis=axis)
+    return wrap_out(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    x = ensure_tensor(x)
+    idx = argsort(x, axis=axis, descending=descending)
+    return take_along_axis(x, idx, axis=axis)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.numpy())
+    ax = -1 if axis is None else int(axis)
+    a = x._data
+    moved = jnp.moveaxis(a, ax, -1)
+    if largest:
+        _, idx = jax.lax.top_k(moved, k)
+    else:
+        _, idx = jax.lax.top_k(-moved, k)
+    idx = jnp.moveaxis(idx, -1, ax)
+    vals = take_along_axis(x, wrap_out(idx), axis=ax)
+    return vals, wrap_out(idx.astype(jnp.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(cond, as_tuple=True)
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+    c = cond._data
+    return run_op('where', lambda a, b: jnp.where(c, a, b), xt, yt)
+
+
+def nonzero(x, as_tuple=False):
+    import numpy as np
+    a = np.asarray(ensure_tensor(x).numpy())
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(wrap_out(jnp.asarray(i, dtype=jnp.int64)) for i in nz)
+    return wrap_out(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    s = ensure_tensor(sorted_sequence)._data
+    v = ensure_tensor(values)._data
+    side = 'right' if right else 'left'
+    if s.ndim == 1:
+        out = jnp.searchsorted(s, v, side=side)
+    else:
+        out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+        out = out.reshape(v.shape)
+    return wrap_out(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    a = x._data
+    idx = jnp.argsort(a, axis=axis)
+    kth_idx = jnp.take(idx, k - 1, axis=axis)
+    kth_idx_e = jnp.expand_dims(kth_idx, axis)
+    vals = take_along_axis(x, wrap_out(kth_idx_e), axis=axis)
+    if not keepdim:
+        from .manipulation import squeeze
+        vals = squeeze(vals, axis=axis)
+        return vals, wrap_out(kth_idx.astype(jnp.int64))
+    return vals, wrap_out(kth_idx_e.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    import numpy as np
+    a = ensure_tensor(x).numpy()
+    moved = np.moveaxis(a, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=a.dtype)
+    for i, row in enumerate(flat):
+        u, c = np.unique(row, return_counts=True)
+        vals[i] = u[np.argmax(c)]
+    vals = vals.reshape(moved.shape[:-1])
+    idx = np.argmax(np.moveaxis(a, axis, -1) == vals[..., None], axis=-1)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idx = np.expand_dims(idx, axis)
+    return wrap_out(jnp.asarray(vals)), wrap_out(jnp.asarray(idx, dtype=jnp.int64))

@@ -1,0 +1,53 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+import jax.numpy as jnp
+
+from ..framework.core import run_op, wrap_out
+from ._helpers import ensure_tensor, axes_arg
+from .math import mean, sum
+
+__all__ = ['mean', 'std', 'var', 'median', 'nanmedian', 'quantile',
+           'nanquantile', 'numel']
+
+from .creation import numel
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('var', lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('std', lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('median', lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    return run_op('nanmedian', lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    qq = jnp.asarray(q)
+    return run_op('quantile', lambda a: jnp.quantile(a, qq, axis=ax,
+                                                     keepdims=keepdim), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = axes_arg(axis)
+    qq = jnp.asarray(q)
+    return run_op('nanquantile', lambda a: jnp.nanquantile(a, qq, axis=ax,
+                                                           keepdims=keepdim), x)

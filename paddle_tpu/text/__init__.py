@@ -1,0 +1,5 @@
+"""paddle.text parity (reference: python/paddle/text/): NLP datasets + (ours)
+a transformer LM model zoo used by the benchmarks."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from .datasets import *  # noqa: F401,F403

@@ -1,0 +1,100 @@
+"""NLP datasets (reference: python/paddle/text/datasets/). Zero-egress: file
+loaders for local copies + FakeTextDataset for tests/benches."""
+import os
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ['Imdb', 'Conll05st', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16',
+           'FakeTextDataset', 'FakeLMDataset']
+
+
+class FakeTextDataset(Dataset):
+    """Deterministic synthetic token-classification data."""
+
+    def __init__(self, num_samples=1024, seq_len=128, vocab_size=30522,
+                 num_classes=2, seed=0):
+        rng = np.random.RandomState(seed)
+        self.tokens = rng.randint(0, vocab_size, size=(num_samples, seq_len))
+        self.labels = rng.randint(0, num_classes, size=num_samples)
+
+    def __getitem__(self, idx):
+        return (self.tokens[idx].astype(np.int64),
+                np.asarray(self.labels[idx], np.int64))
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FakeLMDataset(Dataset):
+    """Synthetic causal-LM data: input ids + shifted labels."""
+
+    def __init__(self, num_samples=1024, seq_len=512, vocab_size=50304,
+                 seed=0):
+        self.num_samples = num_samples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self._seeds = np.random.RandomState(seed).randint(
+            0, 2 ** 31 - 1, size=num_samples)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seeds[idx])
+        ids = rng.randint(0, self.vocab_size, size=self.seq_len + 1)
+        return ids[:-1].astype(np.int64), ids[1:].astype(np.int64)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode='train', download=True):
+        base = os.environ.get('PADDLE_TPU_DATA_HOME',
+                              os.path.expanduser('~/.cache/paddle_tpu'))
+        path = data_file or os.path.join(base, 'uci_housing', 'housing.data')
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                "uci housing data not found at %s (zero-egress)" % path)
+        raw = np.loadtxt(path).astype(np.float32)
+        feats = raw[:, :-1]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        n_train = int(0.8 * len(raw))
+        if mode == 'train':
+            self.x, self.y = feats[:n_train], raw[:n_train, -1:]
+        else:
+            self.x, self.y = feats[n_train:], raw[n_train:, -1:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class _LocalFileTextDataset(Dataset):
+    REQUIRED = 'dataset archive'
+
+    def __init__(self, *a, **k):
+        raise FileNotFoundError(
+            "%s requires a local copy (zero-egress env); use "
+            "FakeTextDataset/FakeLMDataset for tests" % type(self).__name__)
+
+
+class Imdb(_LocalFileTextDataset):
+    pass
+
+
+class Conll05st(_LocalFileTextDataset):
+    pass
+
+
+class Movielens(_LocalFileTextDataset):
+    pass
+
+
+class WMT14(_LocalFileTextDataset):
+    pass
+
+
+class WMT16(_LocalFileTextDataset):
+    pass

@@ -1,0 +1,2 @@
+from .bert import BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401
+from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401

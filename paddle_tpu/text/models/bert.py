@@ -1,0 +1,112 @@
+"""BERT/ERNIE-style encoder (capability target of BASELINE config 3; the
+reference serves this via PaddleNLP on top of nn.TransformerEncoder —
+python/paddle/nn/layer/transformer.py)."""
+from ... import nn
+from ...tensor import manipulation as M
+from ...framework.core import Tensor
+
+import jax.numpy as jnp
+
+__all__ = ['BertModel', 'BertForSequenceClassification', 'BertForPretraining']
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, vocab_size, hidden_size, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(vocab_size, hidden_size)
+        self.position_embeddings = nn.Embedding(max_position_embeddings,
+                                                hidden_size)
+        self.token_type_embeddings = nn.Embedding(type_vocab_size, hidden_size)
+        self.layer_norm = nn.LayerNorm(hidden_size)
+        self.dropout = nn.Dropout(hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        seq_len = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(seq_len, dtype=jnp.int64)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(input_ids._data))
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids) + \
+            self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+        self.activation = nn.Tanh()
+
+    def forward(self, hidden_states):
+        return self.activation(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act='gelu',
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        super().__init__()
+        self.pad_token_id = pad_token_id
+        self.embeddings = BertEmbeddings(vocab_size, hidden_size,
+                                         max_position_embeddings,
+                                         type_vocab_size, hidden_dropout_prob)
+        enc_layer = nn.TransformerEncoderLayer(
+            hidden_size, num_attention_heads, intermediate_size,
+            dropout=hidden_dropout_prob, activation=hidden_act,
+            attn_dropout=attention_probs_dropout_prob, act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_hidden_layers)
+        self.pooler = BertPooler(hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            mask = (input_ids._data != self.pad_token_id)
+            attention_mask = Tensor(
+                jnp.where(mask, 0.0, -1e9)[:, None, None, :].astype(jnp.float32))
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        encoded = self.encoder(emb, attention_mask)
+        pooled = self.pooler(encoded)
+        return encoded, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, bert=None, num_classes=2, dropout=0.1, **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.dense._out_features
+        self.dropout = nn.Dropout(dropout)
+        self.classifier = nn.Linear(hidden, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads."""
+
+    def __init__(self, bert=None, **bert_kwargs):
+        super().__init__()
+        self.bert = bert or BertModel(**bert_kwargs)
+        hidden = self.bert.pooler.dense._out_features
+        vocab = self.bert.embeddings.word_embeddings._num_embeddings
+        self.transform = nn.Linear(hidden, hidden)
+        self.act = nn.GELU()
+        self.layer_norm = nn.LayerNorm(hidden)
+        self.decoder = nn.Linear(hidden, vocab)
+        self.seq_relationship = nn.Linear(hidden, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        encoded, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                    attention_mask)
+        mlm = self.decoder(self.layer_norm(self.act(self.transform(encoded))))
+        nsp = self.seq_relationship(pooled)
+        return mlm, nsp

@@ -1,0 +1,177 @@
+"""GPT-style decoder-only LM — the flagship model (BASELINE configs 3/5).
+
+Built from paddle_tpu.nn layers so the distributed strategies (TP layer
+placements, ZeRO sharding specs, pipeline stages) apply uniformly. Causal
+attention routes through F.scaled_dot_product_attention → pallas flash
+kernel on TPU.
+"""
+import math
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...framework.core import Tensor
+from ...nn import functional as F
+from ...tensor import manipulation as M
+
+__all__ = ['GPTConfig', 'GPTModel', 'GPTForCausalLM']
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None,
+                 max_position_embeddings=1024, dropout=0.1,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 use_rmsnorm=False, tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.dropout = dropout
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.use_rmsnorm = use_rmsnorm
+        self.tie_word_embeddings = tie_word_embeddings
+
+    @staticmethod
+    def gpt2_small():
+        return GPTConfig()
+
+    @staticmethod
+    def bert_base_equiv():
+        return GPTConfig(vocab_size=30522, hidden_size=768, num_layers=12,
+                         num_heads=12, max_position_embeddings=512)
+
+    @staticmethod
+    def gpt3_13b():
+        return GPTConfig(vocab_size=50304, hidden_size=5120, num_layers=40,
+                         num_heads=40, max_position_embeddings=2048)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.num_heads = config.num_heads
+        self.head_dim = config.hidden_size // config.num_heads
+        self.hidden_size = config.hidden_size
+        self.qkv_proj = nn.Linear(config.hidden_size, 3 * config.hidden_size)
+        self.out_proj = nn.Linear(config.hidden_size, config.hidden_size)
+        self.dropout = config.dropout
+        # TP placement hints consumed by distributed/strategy.py
+        self.qkv_proj.weight.placement = (None, 'mp')
+        self.qkv_proj.bias.placement = ('mp',)
+        self.out_proj.weight.placement = ('mp', None)
+
+    def forward(self, x, cache=None):
+        b, n = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = M.reshape(qkv, [b, n, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout if self.training else 0.0)
+        out = M.reshape(out, [b, n, self.hidden_size])
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.fc_in = nn.Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = nn.Linear(config.intermediate_size, config.hidden_size)
+        self.dropout = nn.Dropout(config.dropout)
+        self.fc_in.weight.placement = (None, 'mp')
+        self.fc_in.bias.placement = ('mp',)
+        self.fc_out.weight.placement = ('mp', None)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x),
+                                               approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        Norm = nn.RMSNorm if config.use_rmsnorm else nn.LayerNorm
+        self.ln_1 = Norm(config.hidden_size, config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = Norm(config.hidden_size, config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        config = config or GPTConfig(**kwargs)
+        self.config = config
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size)
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList([GPTBlock(config)
+                               for _ in range(config.num_layers)])
+        Norm = nn.RMSNorm if config.use_rmsnorm else nn.LayerNorm
+        self.ln_f = Norm(config.hidden_size, config.layer_norm_epsilon)
+        self.wte.weight.placement = ('mp', None)
+
+    def forward(self, input_ids, position_ids=None):
+        n = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(n, dtype=jnp.int64)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config=None, **kwargs):
+        super().__init__()
+        config = config or GPTConfig(**kwargs)
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        if self.lm_head is None:
+            logits = F.linear(hidden,
+                              M.transpose(self.gpt.wte.weight, [1, 0]))
+        else:
+            logits = self.lm_head(hidden)
+        return logits
+
+    def loss(self, logits, labels):
+        b, n, v = logits.shape
+        return F.cross_entropy(M.reshape(logits, [b * n, v]),
+                               M.reshape(labels, [b * n]))
+
+    def num_params(self):
+        import numpy as np
+        return int(sum(np.prod(p.shape) for p in self.parameters()))
+
+    def flops_per_token(self):
+        """Approximate fwd+bwd FLOPs/token (6N + attention quadratic term)."""
+        c = self.config
+        n_params = self.num_params()
+        attn = 12 * c.num_layers * c.hidden_size * c.max_position_embeddings
+        return 6 * n_params + attn

@@ -1,0 +1,19 @@
+"""paddle.vision parity (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return 'numpy'
+
+
+def image_load(path, backend=None):
+    from .datasets import _load_image
+    return _load_image(path)

@@ -1,0 +1,228 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: loaders read standard local files (download=False
+semantics); `FakeData` provides deterministic synthetic data for tests and
+benchmarks (the reference's tests download; ours must not).
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ['MNIST', 'FashionMNIST', 'Cifar10', 'Cifar100', 'FakeData',
+           'DatasetFolder', 'ImageFolder', 'Flowers', 'VOC2012']
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (size, shape, classes configurable)."""
+
+    def __init__(self, num_samples=1024, image_shape=(1, 28, 28),
+                 num_classes=10, mode='train', transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed + (0 if mode == 'train' else 1))
+        self._labels = rng.randint(0, num_classes, size=num_samples)
+        self._seeds = rng.randint(0, 2 ** 31 - 1, size=num_samples)
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seeds[idx])
+        img = rng.standard_normal(self.image_shape).astype(np.float32) * 0.5
+        # class-dependent bright square (conv-learnable spatial pattern)
+        label = int(self._labels[idx])
+        if len(self.image_shape) == 3:
+            _, h, w = self.image_shape
+            side = max(h // 7, 2)
+            cols = max(w // side, 1)
+            r = (label // cols) * side % max(h - side, 1)
+            c = (label % cols) * side % max(w - side, 1)
+            img[:, r:r + side, c:c + side] += 3.0
+        else:
+            img.reshape(-1)[:self.num_classes] += \
+                np.eye(self.num_classes, dtype=np.float32)[label] * 3.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(label, dtype=np.int64)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """IDX-format loader (reference: vision/datasets/mnist.py). Point
+    image_path/label_path at local idx files."""
+    NAME = 'mnist'
+
+    def __init__(self, image_path=None, label_path=None, mode='train',
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.environ.get('PADDLE_TPU_DATA_HOME',
+                              os.path.expanduser('~/.cache/paddle_tpu'))
+        prefix = 'train' if mode == 'train' else 't10k'
+        self.image_path = image_path or os.path.join(
+            base, self.NAME, '%s-images-idx3-ubyte.gz' % prefix)
+        self.label_path = label_path or os.path.join(
+            base, self.NAME, '%s-labels-idx1-ubyte.gz' % prefix)
+        if not os.path.exists(self.image_path):
+            raise FileNotFoundError(
+                "MNIST idx files not found at %s (zero-egress env: place "
+                "files locally or use vision.datasets.FakeData)" %
+                self.image_path)
+        self._load()
+
+    def _load(self):
+        opener = gzip.open if self.image_path.endswith('.gz') else open
+        with opener(self.image_path, 'rb') as f:
+            magic, n, rows, cols = struct.unpack('>IIII', f.read(16))
+            self.images = np.frombuffer(f.read(), dtype=np.uint8).reshape(
+                n, rows, cols)
+        with opener(self.label_path, 'rb') as f:
+            magic, n = struct.unpack('>II', f.read(8))
+            self.labels = np.frombuffer(f.read(), dtype=np.uint8).astype(
+                np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None, :, :] / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = 'fashion-mnist'
+
+
+class Cifar10(Dataset):
+    """python-pickle batches loader (reference: vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        base = os.environ.get('PADDLE_TPU_DATA_HOME',
+                              os.path.expanduser('~/.cache/paddle_tpu'))
+        self.data_file = data_file or os.path.join(base, 'cifar',
+                                                   'cifar-10-python.tar.gz')
+        if not os.path.exists(self.data_file):
+            raise FileNotFoundError(
+                "cifar archive not found at %s (zero-egress env: place it "
+                "locally or use vision.datasets.FakeData)" % self.data_file)
+        names = ['data_batch_%d' % i for i in range(1, 6)] if mode == 'train' \
+            else ['test_batch']
+        xs, ys = [], []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                if any(m.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(m), encoding='bytes')
+                    xs.append(d[b'data'])
+                    ys.extend(d[b'labels' if b'labels' in d else b'fine_labels'])
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend=None):
+        base = os.environ.get('PADDLE_TPU_DATA_HOME',
+                              os.path.expanduser('~/.cache/paddle_tpu'))
+        data_file = data_file or os.path.join(base, 'cifar',
+                                              'cifar-100-python.tar.gz')
+        super().__init__(data_file, mode, transform, download, backend)
+
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.npy')
+
+
+def _load_image(path):
+    if path.endswith('.npy'):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert('RGB'))
+    except ImportError as e:
+        raise RuntimeError("PIL unavailable; use .npy images") from e
+
+
+class DatasetFolder(Dataset):
+    """class-subdir image tree (reference: vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or _load_image
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        self.samples = [os.path.join(root, f) for f in sorted(os.listdir(root))
+                        if f.lower().endswith(extensions)]
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode='train', transform=None, download=True, backend=None):
+        raise FileNotFoundError(
+            "Flowers requires local archives (zero-egress env); use "
+            "DatasetFolder over an extracted copy or FakeData")
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode='train', transform=None,
+                 download=True, backend=None):
+        raise FileNotFoundError(
+            "VOC2012 requires local archives (zero-egress env); use "
+            "DatasetFolder over an extracted copy or FakeData")

@@ -1,0 +1,499 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — yolo_box, yolo_loss,
+nms, roi_align, deform_conv, distribute_fpn_proposals…).
+
+Detection post-processing ops are jnp where shape-static (TPU-jittable) and
+numpy where inherently dynamic (host post-processing, same place the
+reference runs them in deployment).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, run_op, wrap_out
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ['yolo_box', 'yolo_loss', 'nms', 'roi_align', 'roi_pool',
+           'box_coder', 'prior_box', 'deform_conv2d', 'DeformConv2D',
+           'distribute_fpn_proposals', 'generate_proposals', 'PSRoIPool',
+           'RoIAlign', 'RoIPool']
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (operators/detection/
+    yolo_box_op.* parity, fully vectorized for TPU)."""
+    x = ensure_tensor(x)
+    imgs = ensure_tensor(img_size)._data
+    na = len(anchors) // 2
+    anchors_arr = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, na, -1, h, w)
+        grid_x = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
+        grid_y = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
+        bx = (jax.nn.sigmoid(a[:, :, 0]) * scale_x_y -
+              0.5 * (scale_x_y - 1.0) + grid_x) / w
+        by = (jax.nn.sigmoid(a[:, :, 1]) * scale_x_y -
+              0.5 * (scale_x_y - 1.0) + grid_y) / h
+        bw = jnp.exp(a[:, :, 2]) * anchors_arr[:, 0].reshape(1, na, 1, 1) / \
+            (w * downsample_ratio)
+        bh = jnp.exp(a[:, :, 3]) * anchors_arr[:, 1].reshape(1, na, 1, 1) / \
+            (h * downsample_ratio)
+        conf = jax.nn.sigmoid(a[:, :, 4])
+        probs = jax.nn.sigmoid(a[:, :, 5:5 + class_num])
+        scores = conf[:, :, None] * probs
+        img_h = imgs[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
+        img_w = imgs[:, 1].reshape(n, 1, 1, 1).astype(jnp.float32)
+        x0 = (bx - bw / 2) * img_w
+        y0 = (by - bh / 2) * img_h
+        x1 = (bx + bw / 2) * img_w
+        y1 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x0 = jnp.clip(x0, 0, img_w - 1)
+            y0 = jnp.clip(y0, 0, img_h - 1)
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+        boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(n, -1, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        mask = (conf.reshape(n, -1, 1) > conf_thresh).astype(boxes.dtype)
+        return boxes * mask, scores * mask
+    boxes, scores = run_op('yolo_box', fn, x)
+    return boxes, scores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (operators/detection/yolov3_loss_op.*)."""
+    x = ensure_tensor(x)
+    gtb = ensure_tensor(gt_box)._data
+    gtl = ensure_tensor(gt_label)._data
+    na = len(anchor_mask)
+    anchors_full = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    mask_anchors = anchors_full[jnp.asarray(anchor_mask)]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, na, 5 + class_num, h, w)
+        input_size = h * downsample_ratio
+        # build targets: per gt box, responsible anchor/cell
+        bx = gtb[..., 0] * w
+        by = gtb[..., 1] * h
+        gw = gtb[..., 2] * input_size
+        gh = gtb[..., 3] * input_size
+        gi = jnp.clip(bx.astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip(by.astype(jnp.int32), 0, h - 1)
+        # anchor iou on wh
+        inter = jnp.minimum(gw[..., None], anchors_full[:, 0]) * \
+            jnp.minimum(gh[..., None], anchors_full[:, 1])
+        union = gw[..., None] * gh[..., None] + \
+            anchors_full[:, 0] * anchors_full[:, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)
+        valid = (gtb[..., 2] > 0)
+        mask_idx = jnp.asarray(anchor_mask)
+        in_mask = (best[..., None] == mask_idx).any(-1) & valid
+        local_a = jnp.argmax((best[..., None] == mask_idx).astype(jnp.int32),
+                             axis=-1)
+
+        bidx = jnp.arange(n)[:, None] * jnp.ones_like(gi)
+        sel = (bidx, local_a, gj, gi)
+        tx = bx - jnp.floor(bx)
+        ty = by - jnp.floor(by)
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(
+            mask_anchors[local_a, 0], 1e-9), 1e-9))
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(
+            mask_anchors[local_a, 1], 1e-9), 1e-9))
+        scale = 2.0 - gtb[..., 2] * gtb[..., 3]
+
+        px = jax.nn.sigmoid(a[:, :, 0])
+        py = jax.nn.sigmoid(a[:, :, 1])
+        pw = a[:, :, 2]
+        ph = a[:, :, 3]
+        pobj = a[:, :, 4]
+        pcls = a[:, :, 5:]
+
+        m = in_mask.astype(a.dtype)
+        loss_xy = jnp.sum(m * scale * ((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2))
+        loss_wh = jnp.sum(m * scale * ((pw[sel] - tw) ** 2 + (ph[sel] - th) ** 2))
+        obj_target = jnp.zeros((n, na, h, w), a.dtype)
+        obj_target = obj_target.at[sel].max(m)
+        bce = jnp.maximum(pobj, 0) - pobj * obj_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(pobj)))
+        loss_obj = jnp.sum(bce)
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        cls_target = jax.nn.one_hot(gtl, class_num, dtype=a.dtype)
+        cls_target = cls_target * (1 - smooth) + smooth / 2
+        pc = pcls.transpose(0, 1, 3, 4, 2)[sel]
+        bce_c = jnp.maximum(pc, 0) - pc * cls_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(pc)))
+        loss_cls = jnp.sum(m[..., None] * bce_c)
+        return (loss_xy + loss_wh + loss_obj + loss_cls) * jnp.ones((n,)) / n
+    return run_op('yolo_loss', fn, x)
+
+
+def _iou_matrix(boxes):
+    x0, y0, x1, y1 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = (x1 - x0) * (y1 - y0)
+    ix0 = np.maximum(x0[:, None], x0[None, :])
+    iy0 = np.maximum(y0[:, None], y0[None, :])
+    ix1 = np.minimum(x1[:, None], x1[None, :])
+    iy1 = np.minimum(y1[:, None], y1[None, :])
+    iw = np.maximum(ix1 - ix0, 0)
+    ih = np.maximum(iy1 - iy0, 0)
+    inter = iw * ih
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host NMS (operators/detection/nms_op parity; dynamic output shape
+    keeps this off-device, same as deployment practice)."""
+    b = ensure_tensor(boxes).numpy()
+    s = ensure_tensor(scores).numpy() if scores is not None else None
+    order = np.argsort(-s) if s is not None else np.arange(len(b))
+    if category_idxs is not None:
+        cats = ensure_tensor(category_idxs).numpy()
+    else:
+        cats = np.zeros(len(b), dtype=np.int64)
+    iou = _iou_matrix(b)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        over = (iou[i] > iou_threshold) & (cats == cats[i])
+        suppressed |= over
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return wrap_out(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear grid sampling (operators/roi_align_op parity)."""
+    x = ensure_tensor(x)
+    rois = ensure_tensor(boxes)._data
+    nums = ensure_tensor(boxes_num)._data
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+
+    def fn(feat):
+        n, c, h, w = feat.shape
+        # batch index per roi
+        batch_idx = jnp.repeat(jnp.arange(nums.shape[0]), nums,
+                               total_repeat_length=rois.shape[0])
+        offset = 0.5 if aligned else 0.0
+        x0 = rois[:, 0] * spatial_scale - offset
+        y0 = rois[:, 1] * spatial_scale - offset
+        x1 = rois[:, 2] * spatial_scale - offset
+        y1 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x1 - x0, 1e-3)
+        rh = jnp.maximum(y1 - y0, 1e-3)
+        ys = y0[:, None] + (jnp.arange(ph) + 0.5) / ph * rh[:, None]
+        xs = x0[:, None] + (jnp.arange(pw) + 0.5) / pw * rw[:, None]
+
+        def bilinear(fmap, yy, xx):
+            y0i = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0i = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0i + 1, 0, h - 1)
+            x1i = jnp.clip(x0i + 1, 0, w - 1)
+            wy = yy - y0i
+            wx = xx - x0i
+            v00 = fmap[:, y0i, x0i]
+            v01 = fmap[:, y0i, x1i]
+            v10 = fmap[:, y1i, x0i]
+            v11 = fmap[:, y1i, x1i]
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+
+        def per_roi(bi, ys_r, xs_r):
+            fmap = feat[bi]
+            yy = jnp.repeat(ys_r, pw)
+            xx = jnp.tile(xs_r, ph)
+            vals = bilinear(fmap, yy, xx)  # [C, ph*pw]
+            return vals.reshape(c, ph, pw)
+        out = jax.vmap(per_roi)(batch_idx, ys, xs)
+        return out
+    return run_op('roi_align', fn, x)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     aligned=False)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(RoIAlign):
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+class PSRoIPool(RoIAlign):
+    pass
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type='encode_center_size',
+              box_normalized=True, axis=0, name=None):
+    pb = ensure_tensor(prior_box)._data
+    pbv = ensure_tensor(prior_box_var)._data if not isinstance(
+        prior_box_var, (list, tuple)) else jnp.asarray(prior_box_var)
+    tb = ensure_tensor(target_box)
+
+    def fn(t):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph_ = pb[:, 3] - pb[:, 1] + norm
+        pcx = (pb[:, 0] + pb[:, 2]) / 2
+        pcy = (pb[:, 1] + pb[:, 3]) / 2
+        if code_type == 'encode_center_size':
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = (t[:, 0] + t[:, 2]) / 2
+            tcy = (t[:, 1] + t[:, 3]) / 2
+            ox = (tcx - pcx) / pw / pbv[..., 0]
+            oy = (tcy - pcy) / ph_ / pbv[..., 1]
+            ow = jnp.log(tw / pw) / pbv[..., 2]
+            oh = jnp.log(th / ph_) / pbv[..., 3]
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode
+        ox = t[..., 0] * pbv[..., 0] * pw + pcx
+        oy = t[..., 1] * pbv[..., 1] * ph_ + pcy
+        ow = jnp.exp(t[..., 2] * pbv[..., 2]) * pw
+        oh = jnp.exp(t[..., 3] * pbv[..., 3]) * ph_
+        return jnp.stack([ox - ow / 2, oy - oh / 2,
+                          ox + ow / 2 - norm, oy + oh / 2 - norm], axis=-1)
+    return run_op('box_coder', fn, tb)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0., 0.), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    feat = ensure_tensor(input)
+    img = ensure_tensor(image)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_h = steps[1] or ih / h
+    step_w = steps[0] or iw / w
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if ar != 1.0:
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        sizes = []
+        for ar in ars:
+            sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = max_sizes[ms_i]
+            sizes.insert(1, (np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        for (bw, bh) in sizes:
+            cy, cx = np.mgrid[0:h, 0:w].astype(np.float32)
+            cx = (cx + offset) * step_w
+            cy = (cy + offset) * step_h
+            boxes.append(np.stack([(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                   (cx + bw / 2) / iw, (cy + bh / 2) / ih],
+                                  axis=-1))
+    out = np.stack(boxes, axis=2)  # H, W, num_priors, 4
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32), out.shape).copy()
+    return wrap_out(jnp.asarray(out)), wrap_out(jnp.asarray(var))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 via gather+matmul (operators/deformable_conv_op).
+    Bilinear-samples input at offset positions then does a dense matmul —
+    MXU-friendly formulation."""
+    x = ensure_tensor(x)
+    off = ensure_tensor(offset)
+    w = ensure_tensor(weight)
+    msk = ensure_tensor(mask) if mask is not None else None
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    sh, sw = _pair(stride)
+    ph_, pw_ = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def fn(a, o, ww, *mb):
+        n, cin, h, wdt = a.shape
+        cout, cin_g, kh, kw = ww.shape
+        oh = (h + 2 * ph_ - dh * (kh - 1) - 1) // sh + 1
+        ow = (wdt + 2 * pw_ - dw * (kw - 1) - 1) // sw + 1
+        a_p = jnp.pad(a, [(0, 0), (0, 0), (ph_, ph_), (pw_, pw_)])
+        hp, wp = a_p.shape[2], a_p.shape[3]
+        base_y = (jnp.arange(oh) * sh)[:, None, None] + \
+            (jnp.arange(kh) * dh)[None, :, None]
+        base_x = (jnp.arange(ow) * sw)[:, None, None] + \
+            (jnp.arange(kw) * dw)[None, :, None]
+        o = o.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        oy = o[:, :, :, 0]
+        ox = o[:, :, :, 1]
+        ky = jnp.arange(kh)[:, None] * jnp.ones((1, kw))
+        kx = jnp.ones((kh, 1)) * jnp.arange(kw)[None, :]
+        yy = base_y.reshape(oh, 1, kh, 1) + jnp.zeros((1, ow, 1, kw))
+        xx = jnp.zeros((oh, 1, kh, 1)) + base_x.reshape(1, ow, 1, kw)
+        yy = yy.reshape(1, 1, oh, ow, kh * kw) + \
+            oy.transpose(0, 1, 3, 4, 2).reshape(n, deformable_groups, oh, ow,
+                                                kh * kw)
+        xx = xx.reshape(1, 1, oh, ow, kh * kw) + \
+            ox.transpose(0, 1, 3, 4, 2).reshape(n, deformable_groups, oh, ow,
+                                                kh * kw)
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def gather(ai, yi, xi):
+            yi_c = jnp.clip(yi.astype(jnp.int32), 0, hp - 1)
+            xi_c = jnp.clip(xi.astype(jnp.int32), 0, wp - 1)
+            inb = ((yi >= 0) & (yi <= hp - 1) & (xi >= 0) &
+                   (xi <= wp - 1)).astype(ai.dtype)
+            g = ai[:, :, yi_c, xi_c]
+            return g * inb
+
+        cpg = cin // deformable_groups
+        outs = []
+        for dg in range(deformable_groups):
+            ai = a_p[:, dg * cpg:(dg + 1) * cpg]
+            vals = 0.
+            for (dy, dx, wgt) in [(0, 0, (1 - wy) * (1 - wx)),
+                                  (0, 1, (1 - wy) * wx),
+                                  (1, 0, wy * (1 - wx)), (1, 1, wy * wx)]:
+                yi = y0[:, dg] + dy
+                xi = x0[:, dg] + dx
+                g = jax.vmap(lambda am, ym, xm: gather(
+                    am[None], ym, xm)[0])(ai, yi, xi)
+                vals = vals + g * wgt[:, None] if g.ndim == 5 else \
+                    vals + g * wgt[:, dg if False else 0]
+            outs.append(vals)
+        sampled = jnp.concatenate(outs, axis=1)  # n, cin, oh, ow, kh*kw
+        if mb and msk is not None:
+            mm = mb[-1].reshape(n, deformable_groups, kh * kw, oh, ow)
+            mm = jnp.repeat(mm, cpg, axis=1).transpose(0, 1, 3, 4, 2)
+            sampled = sampled * mm
+        cols = sampled.transpose(0, 2, 3, 1, 4).reshape(
+            n, oh, ow, cin * kh * kw)
+        wflat = ww.reshape(cout, cin_g * kh * kw)
+        if groups == 1:
+            out = jnp.einsum('nhwk,ck->nchw', cols, wflat)
+        else:
+            cols_g = cols.reshape(n, oh, ow, groups, -1)
+            wg = wflat.reshape(groups, cout // groups, -1)
+            out = jnp.einsum('nhwgk,gck->ngchw', cols_g, wg).reshape(
+                n, cout, oh, ow)
+        if mb and bias is not None:
+            out = out + mb[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, off, w]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+    if msk is not None:
+        args.append(msk)
+    return run_op('deform_conv2d', fn, *args)
+
+
+class DeformConv2D:
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+        self._layer = nn.Conv2D(in_channels, out_channels, kernel_size,
+                                stride, padding, dilation, groups,
+                                weight_attr=weight_attr, bias_attr=bias_attr)
+        self.args = (stride, padding, dilation, deformable_groups, groups)
+
+    def __call__(self, x, offset, mask=None):
+        s, p, d, dg, g = self.args
+        return deform_conv2d(x, offset, self._layer.weight, self._layer.bias,
+                             s, p, d, dg, g, mask)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    rois = ensure_tensor(fpn_rois).numpy()
+    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0]) *
+                               (rois[:, 3] - rois[:, 1]), 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == l)
+        outs.append(wrap_out(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
+    restore = np.argsort(order)
+    out_num = [wrap_out(jnp.asarray(np.asarray([len(i)], np.int32)))
+               for i in idxs]
+    return outs, wrap_out(jnp.asarray(restore.reshape(-1, 1))), out_num
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    s = ensure_tensor(scores).numpy()
+    d = ensure_tensor(bbox_deltas).numpy()
+    a = ensure_tensor(anchors).numpy().reshape(-1, 4)
+    v = ensure_tensor(variances).numpy().reshape(-1, 4)
+    n = s.shape[0]
+    all_rois, all_scores, nums = [], [], []
+    for b in range(n):
+        sb = s[b].transpose(1, 2, 0).reshape(-1)
+        db = d[b].transpose(1, 2, 0).reshape(-1, 4)
+        order = np.argsort(-sb)[:pre_nms_top_n]
+        sb, db, ab, vb = sb[order], db[order], a[order % len(a)], v[order % len(v)]
+        aw = ab[:, 2] - ab[:, 0]
+        ah = ab[:, 3] - ab[:, 1]
+        acx = ab[:, 0] + aw / 2
+        acy = ab[:, 1] + ah / 2
+        cx = db[:, 0] * vb[:, 0] * aw + acx
+        cy = db[:, 1] * vb[:, 1] * ah + acy
+        bw = np.exp(np.minimum(db[:, 2] * vb[:, 2], 10)) * aw
+        bh = np.exp(np.minimum(db[:, 3] * vb[:, 3], 10)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
+                         axis=-1)
+        keep_mask = (bw >= min_size) & (bh >= min_size)
+        boxes, sb = boxes[keep_mask], sb[keep_mask]
+        iou = _iou_matrix(boxes)
+        keep = []
+        supp = np.zeros(len(boxes), bool)
+        for i in range(len(boxes)):
+            if supp[i]:
+                continue
+            keep.append(i)
+            if len(keep) >= post_nms_top_n:
+                break
+            supp |= iou[i] > nms_thresh
+            supp[i] = True
+        all_rois.append(boxes[keep])
+        all_scores.append(sb[keep])
+        nums.append(len(keep))
+    rois = wrap_out(jnp.asarray(np.concatenate(all_rois)))
+    rscores = wrap_out(jnp.asarray(np.concatenate(all_scores)))
+    if return_rois_num:
+        return rois, rscores, wrap_out(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores

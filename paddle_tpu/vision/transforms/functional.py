@@ -1,0 +1,195 @@
+"""Transform functionals over numpy HWC images (reference:
+python/paddle/vision/transforms/functional*.py; CHW/HWC both supported)."""
+import numbers
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ['to_tensor', 'normalize', 'resize', 'crop', 'center_crop', 'hflip',
+           'vflip', 'pad', 'rotate', 'adjust_brightness', 'adjust_contrast',
+           'adjust_saturation', 'adjust_hue', 'to_grayscale']
+
+
+def _np_img(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format='CHW'):
+    img = _np_img(pic)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if img.dtype == np.uint8:
+        img = img.astype(np.float32) / 255.0
+    else:
+        img = img.astype(np.float32)
+    if data_format == 'CHW':
+        img = np.transpose(img, (2, 0, 1))
+    return Tensor(img)
+
+
+def normalize(img, mean, std, data_format='CHW', to_rgb=False):
+    arr = _np_img(img).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if isinstance(img, Tensor) or arr.ndim == 3:
+        if data_format == 'CHW':
+            mean = mean.reshape(-1, 1, 1)
+            std = std.reshape(-1, 1, 1)
+    out = (arr - mean) / std
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def _interp_resize(img, size):
+    """Nearest/bilinear resize of an HWC numpy image via jax.image."""
+    import jax
+    import jax.numpy as jnp
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    out_shape = (oh, ow) + img.shape[2:]
+    out = jax.image.resize(jnp.asarray(img.astype(np.float32)), out_shape,
+                           method='linear')
+    res = np.asarray(out)
+    if img.dtype == np.uint8:
+        res = np.clip(res, 0, 255).astype(np.uint8)
+    return res
+
+
+def resize(img, size, interpolation='bilinear'):
+    arr = _np_img(img)
+    return _interp_resize(arr, size)
+
+
+def crop(img, top, left, height, width):
+    arr = _np_img(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _np_img(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _np_img(img)[:, ::-1]
+
+
+def vflip(img):
+    return _np_img(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode='constant'):
+    arr = _np_img(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {'constant': 'constant', 'edge': 'edge', 'reflect': 'reflect',
+            'symmetric': 'symmetric'}[padding_mode]
+    if mode == 'constant':
+        return np.pad(arr, pads, mode=mode, constant_values=fill)
+    return np.pad(arr, pads, mode=mode)
+
+
+def rotate(img, angle, interpolation='nearest', expand=False, center=None,
+           fill=0):
+    arr = _np_img(img)
+    k = int(round(angle / 90.0)) % 4
+    if abs(angle - 90 * round(angle / 90.0)) < 1e-6:
+        return np.rot90(arr, k).copy()
+    # arbitrary angles: inverse-map nearest sampling
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
+        (center[1], center[0])
+    theta = np.deg2rad(angle)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    ys = (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta) + cy
+    xs = (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta) + cx
+    yi = np.clip(np.round(ys).astype(np.int64), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(np.int64), 0, w - 1)
+    out = arr[yi, xi]
+    outside = (ys < 0) | (ys > h - 1) | (xs < 0) | (xs > w - 1)
+    out[outside] = fill
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _np_img(img).astype(np.float32)
+    out = arr * brightness_factor
+    return _clip_like(out, img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _np_img(img).astype(np.float32)
+    mean = arr.mean()
+    out = (arr - mean) * contrast_factor + mean
+    return _clip_like(out, img)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _np_img(img).astype(np.float32)
+    gray = arr.mean(axis=-1, keepdims=True)
+    out = (arr - gray) * saturation_factor + gray
+    return _clip_like(out, img)
+
+
+def adjust_hue(img, hue_factor):
+    arr = _np_img(img).astype(np.float32) / 255.0
+    # RGB->HSV hue rotation
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr[..., :3].max(-1)
+    minc = arr[..., :3].min(-1)
+    v = maxc
+    delta = maxc - minc + 1e-8
+    s = delta / (maxc + 1e-8)
+    rc = (maxc - r) / delta
+    gc = (maxc - g) / delta
+    bc = (maxc - b) / delta
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1) * 255.0
+    return _clip_like(out, img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _np_img(img).astype(np.float32)
+    gray = (0.2989 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2])
+    out = np.stack([gray] * num_output_channels, axis=-1)
+    return _clip_like(out, img)
+
+
+def _clip_like(out, ref):
+    arr = _np_img(ref)
+    if arr.dtype == np.uint8:
+        return np.clip(out, 0, 255).astype(np.uint8)
+    return out.astype(arr.dtype)

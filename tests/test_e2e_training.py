@@ -1,0 +1,180 @@
+"""End-to-end training tests (reference pattern: tests/book/
+test_recognize_digits.py — small real models to a loss threshold +
+save/load round trip)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.io import DataLoader
+from paddle_tpu.framework.functional import TrainStep
+
+
+def test_lenet_eager_convergence():
+    paddle.seed(42)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    ds = FakeData(num_samples=256, image_shape=(1, 28, 28), num_classes=10)
+    loader = DataLoader(ds, batch_size=64, shuffle=True)
+    losses = []
+    for epoch in range(8):
+        for img, label in loader:
+            loss = loss_fn(model(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < 1.0, 'did not converge: %s' % losses[-5:]
+
+
+def test_trainstep_matches_eager_exactly():
+    def build():
+        paddle.seed(7)
+        m = LeNet()
+        o = paddle.optimizer.Adam(learning_rate=1e-3,
+                                  parameters=m.parameters())
+        return m, o
+
+    rng = np.random.RandomState(0)
+    img = rng.standard_normal((8, 1, 28, 28)).astype(np.float32)
+    lab = rng.randint(0, 10, 8)
+    loss_fn = nn.CrossEntropyLoss()
+
+    m1, o1 = build()
+    for _ in range(3):
+        l1 = loss_fn(m1(paddle.to_tensor(img)), paddle.to_tensor(lab))
+        l1.backward()
+        o1.step()
+        o1.clear_grad()
+
+    m2, o2 = build()
+    step = TrainStep(m2, loss_fn, o2)
+    for _ in range(3):
+        l2 = step(paddle.to_tensor(img), paddle.to_tensor(lab))
+
+    np.testing.assert_allclose(float(l1.numpy()), float(l2.numpy()),
+                               rtol=1e-4)
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-5,
+                                   err_msg=n1)
+
+
+def test_trainstep_overfits_fast():
+    paddle.seed(0)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                parameters=model.parameters())
+    step = TrainStep(model, nn.CrossEntropyLoss(), opt)
+    rng = np.random.RandomState(0)
+    img = paddle.to_tensor(rng.standard_normal((32, 1, 28, 28)).astype(np.float32))
+    lab = paddle.to_tensor(rng.randint(0, 10, 32))
+    for _ in range(80):
+        loss = step(img, lab)
+    assert float(loss.numpy()) < 0.05
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    path = str(tmp_path / 'ckpt')
+    paddle.save(model.state_dict(), path + '.pdparams')
+    paddle.save(opt.state_dict(), path + '.pdopt')
+
+    model2 = LeNet()
+    model2.set_state_dict(paddle.load(path + '.pdparams'))
+    x = paddle.randn([2, 1, 28, 28])
+    model.eval()
+    model2.eval()
+    np.testing.assert_allclose(model(x).numpy(), model2(x).numpy(),
+                               rtol=1e-6)
+
+
+def test_hapi_model_fit():
+    paddle.seed(1)
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=2e-3,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    train_ds = FakeData(num_samples=128, image_shape=(1, 28, 28))
+    val_ds = FakeData(num_samples=64, image_shape=(1, 28, 28), mode='test')
+    model.fit(train_ds, val_ds, batch_size=32, epochs=2, verbose=0)
+    res = model.evaluate(val_ds, batch_size=32, verbose=0)
+    assert 'loss' in res
+    preds = model.predict(val_ds, batch_size=32)
+    assert len(preds) > 0
+
+
+def test_jit_to_static_layer():
+    paddle.seed(3)
+    model = LeNet()
+    model.eval()
+    x = paddle.randn([2, 1, 28, 28])
+    ref = model(x).numpy()
+    static_model = paddle.jit.to_static(model)
+    out = static_model(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_to_static_training_grad():
+    paddle.seed(4)
+    layer = nn.Linear(4, 2)
+
+    @paddle.jit.to_static
+    def fwd(x):
+        return layer(x)
+
+    x = paddle.randn([3, 4])
+    out = fwd(x)
+    loss = out.sum()
+    loss.backward()
+    assert layer.weight.grad is not None
+    ref_grad = np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(layer.weight.grad.numpy(),
+                               x.numpy().T @ ref_grad, rtol=1e-4)
+
+
+def test_jit_save_load(tmp_path):
+    model = LeNet()
+    model.eval()
+    path = str(tmp_path / 'lenet')
+    from paddle_tpu.static import InputSpec
+    paddle.jit.save(model, path, input_spec=[InputSpec([1, 1, 28, 28])])
+    assert os.path.exists(path + '.pdiparams')
+    loaded = paddle.jit.load(path)
+    x = paddle.randn([2, 1, 28, 28])
+    np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                               rtol=1e-5)
+
+
+def test_dataloader_multiworker():
+    ds = FakeData(num_samples=64, image_shape=(1, 8, 8))
+    loader = DataLoader(ds, batch_size=16, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    img, lab = batches[0]
+    assert img.shape == [16, 1, 8, 8]
+    # deterministic order matches single-worker
+    loader0 = DataLoader(ds, batch_size=16, num_workers=0)
+    img0, lab0 = next(iter(loader0))
+    np.testing.assert_allclose(img.numpy(), img0.numpy())
+
+
+def test_amp_autocast_eager():
+    with paddle.amp.auto_cast(enable=True, dtype='bfloat16'):
+        x = paddle.randn([4, 4])
+        y = paddle.randn([4, 4])
+        z = paddle.matmul(x, y)
+    assert z.dtype == 'bfloat16'
+    w = paddle.matmul(x, y)
+    assert w.dtype == 'float32'

@@ -1,0 +1,249 @@
+"""nn layer tests (reference pattern: unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == [2, 3]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shapes():
+    layer = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    assert layer(x).shape == [2, 8, 8, 8]
+
+
+def test_conv2d_matches_manual():
+    import jax.numpy as jnp
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    w = conv.weight.numpy()
+    out = conv(x).numpy()
+    ref = np.zeros((1, 1, 2, 2), np.float32)
+    xv = x.numpy()[0, 0]
+    for i in range(2):
+        for j in range(2):
+            ref[0, 0, i, j] = (xv[i:i+2, j:j+2] * w[0, 0]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_conv_transpose_shape():
+    layer = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+    x = paddle.randn([1, 4, 8, 8])
+    assert layer(x).shape == [1, 2, 15, 15]
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 8]) * 5
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros(2), atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), np.ones(2), atol=1e-2)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    d.train()
+    y = d(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor(np.asarray([[1, 2], [3, 4]], np.int64))
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll)) == 3
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters_and_buffers():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+            self.bn = nn.BatchNorm1D(2)
+
+        def forward(self, x):
+            return self.bn(self.fc(x))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert 'fc.weight' in names and 'bn.weight' in names
+    bufs = dict(net.named_buffers())
+    assert 'bn._mean' in bufs
+
+
+def test_parameter_training_via_layer():
+    layer = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layer.parameters())
+    x = paddle.randn([16, 4])
+    # realizable target: a fixed random linear map
+    w_true = paddle.randn([4, 1])
+    target = paddle.matmul(x, w_true)
+    for _ in range(80):
+        loss = ((layer(x) - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < 0.05
+
+
+def test_rnn_lstm_gru():
+    for cls, states in [(nn.SimpleRNN, 1), (nn.LSTM, 2), (nn.GRU, 1)]:
+        rnn = cls(input_size=4, hidden_size=8, num_layers=2)
+        x = paddle.randn([3, 6, 4])  # batch, time, feat
+        out, st = rnn(x)
+        assert out.shape == [3, 6, 8]
+        if states == 2:
+            h, c = st
+            assert h.shape == [2, 3, 8] and c.shape == [2, 3, 8]
+
+
+def test_lstm_backward():
+    rnn = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    x.stop_gradient = False
+    out, _ = rnn(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert rnn._cells[0].weight_ih.grad is not None
+
+
+def test_bidirectional_lstm():
+    rnn = nn.LSTM(4, 8, direction='bidirect')
+    x = paddle.randn([2, 5, 4])
+    out, (h, c) = rnn(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == [2, 6, 16]
+    # layers are independent copies
+    p = list(enc.layers[0].named_parameters())
+    q = list(enc.layers[1].named_parameters())
+    assert p[0][1] is not q[0][1]
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 5, 16])
+    tgt = paddle.randn([2, 7, 16])
+    out = model(src, tgt)
+    assert out.shape == [2, 7, 16]
+
+
+def test_pool_layers():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    assert nn.AdaptiveAvgPool2D(3)(x).shape == [2, 3, 3, 3]
+
+
+def test_losses():
+    logits = paddle.randn([8, 5])
+    labels = paddle.to_tensor(np.random.RandomState(0).randint(0, 5, 8))
+    ce = nn.CrossEntropyLoss()(logits, labels)
+    assert ce.shape == []
+    ref = -np.log(np.exp(logits.numpy() -
+                         logits.numpy().max(-1, keepdims=True)) /
+                  np.exp(logits.numpy() -
+                         logits.numpy().max(-1, keepdims=True)).sum(
+                             -1, keepdims=True))
+    picked = ref[np.arange(8), labels.numpy()]
+    np.testing.assert_allclose(float(ce.numpy()), picked.mean(), rtol=1e-5)
+
+    a, b = paddle.randn([4, 3]), paddle.randn([4, 3])
+    np.testing.assert_allclose(nn.MSELoss()(a, b).numpy(),
+                               ((a.numpy() - b.numpy()) ** 2).mean(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(nn.L1Loss()(a, b).numpy(),
+                               np.abs(a.numpy() - b.numpy()).mean(),
+                               rtol=1e-5)
+
+
+def test_grad_clip():
+    layer = nn.Linear(4, 4)
+    clip = nn.ClipGradByGlobalNorm(0.001)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=layer.parameters(),
+                               grad_clip=clip)
+    x = paddle.randn([8, 4]) * 100
+    loss = (layer(x) ** 2).sum()
+    loss.backward()
+    before = {id(p): p.numpy().copy() for p in layer.parameters()}
+    opt.step()
+    total_delta = sum(np.abs(p.numpy() - before[id(p)]).sum()
+                      for p in layer.parameters())
+    assert total_delta < 0.01  # clipped to tiny global norm
+
+
+def test_weight_norm():
+    from paddle_tpu.nn import weight_norm, remove_weight_norm
+    layer = nn.Linear(4, 3)
+    w0 = layer.weight.numpy().copy()
+    weight_norm(layer, 'weight', dim=0)
+    assert 'weight_g' in dict(layer.named_parameters())
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ w0 + layer.bias.numpy(),
+                               rtol=1e-4)
+    remove_weight_norm(layer)
+    assert 'weight_g' not in dict(layer.named_parameters())

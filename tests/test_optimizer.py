@@ -1,0 +1,135 @@
+"""Optimizer tests (reference pattern: unittests/test_sgd_op.py,
+test_adam_op.py ...: update rules vs numpy reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _quad_problem(opt_factory, steps=100):
+    paddle.seed(0)
+    target = np.asarray([1.0, -2.0, 3.0], np.float32)
+    w = paddle.framework.Parameter(np.zeros(3, np.float32))
+    opt = opt_factory([w])
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+@pytest.mark.parametrize('factory', [
+    lambda ps: paddle.optimizer.SGD(learning_rate=0.1, parameters=ps),
+    lambda ps: paddle.optimizer.Momentum(learning_rate=0.05, parameters=ps),
+    lambda ps: paddle.optimizer.Adam(learning_rate=0.2, parameters=ps),
+    lambda ps: paddle.optimizer.AdamW(learning_rate=0.2, parameters=ps,
+                                      weight_decay=0.0),
+    lambda ps: paddle.optimizer.RMSProp(learning_rate=0.05, parameters=ps),
+    lambda ps: paddle.optimizer.Adagrad(learning_rate=0.5, parameters=ps),
+    lambda ps: paddle.optimizer.Adamax(learning_rate=0.2, parameters=ps),
+    lambda ps: paddle.optimizer.Adadelta(learning_rate=10.0, parameters=ps),
+    lambda ps: paddle.optimizer.Lamb(learning_rate=0.1, parameters=ps,
+                                     lamb_weight_decay=0.0),
+], ids=['sgd', 'momentum', 'adam', 'adamw', 'rmsprop', 'adagrad', 'adamax',
+        'adadelta', 'lamb'])
+def test_optimizers_converge(factory):
+    w, target = _quad_problem(factory, steps=300)
+    np.testing.assert_allclose(w, target, atol=0.3)
+
+
+def test_sgd_exact_rule():
+    w = paddle.framework.Parameter(np.asarray([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    (w * 3.0).backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 3.0], rtol=1e-6)
+
+
+def test_adam_matches_reference_formula():
+    rng = np.random.RandomState(0)
+    w0 = rng.rand(4).astype(np.float32)
+    g = rng.rand(4).astype(np.float32)
+    w = paddle.framework.Parameter(w0.copy())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[w])
+    (w * paddle.to_tensor(g)).sum().backward()
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    ref = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), ref, rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = paddle.framework.Parameter(np.asarray([2.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                               weight_decay=0.5)
+    (w * 0.0).sum().backward()
+    opt.step()
+    # grad = 0 + 0.5*2.0 = 1.0 -> w = 2 - 0.1
+    np.testing.assert_allclose(w.numpy(), [1.9], rtol=1e-6)
+
+
+def test_lr_scheduler_step():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2,
+                                          gamma=0.5)
+    w = paddle.framework.Parameter(np.zeros(1, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    lrs = []
+    for i in range(5):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025], rtol=1e-6)
+
+
+def test_lr_schedulers_shapes():
+    L = paddle.optimizer.lr
+    scheds = [
+        L.NoamDecay(128, 100), L.PiecewiseDecay([3, 6], [0.1, 0.05, 0.01]),
+        L.NaturalExpDecay(0.1, 0.5), L.InverseTimeDecay(0.1, 0.5),
+        L.PolynomialDecay(0.1, 10), L.ExponentialDecay(0.1, 0.9),
+        L.MultiStepDecay(0.1, [2, 4]), L.StepDecay(0.1, 3),
+        L.LambdaDecay(0.1, lambda e: 0.9 ** e),
+        L.CosineAnnealingDecay(0.1, 10),
+        L.LinearWarmup(0.1, 5, 0.0, 0.1),
+        L.OneCycleLR(0.1, 20), L.CyclicLR(0.01, 0.1, 5),
+    ]
+    for s in scheds:
+        for _ in range(8):
+            s.step()
+        assert np.isfinite(s())
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.framework.Parameter(np.ones(3, np.float32))
+    w.name = 'w'
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w ** 2).sum().backward()
+    opt.step()
+    state = opt.state_dict()
+    assert state['step'] == 1
+
+    w2 = paddle.framework.Parameter(np.ones(3, np.float32))
+    w2.name = 'w'
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(state)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(opt2._get_slots(w2)['moment1'],
+                               opt._get_slots(w)['moment1'])
+
+
+def test_grad_scaler_fp16_contract():
+    from paddle_tpu.amp import GradScaler
+    w = paddle.framework.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = GradScaler(init_loss_scaling=4.0)
+    loss = (w * 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    # unscaled grad = 2 -> w = 1 - 0.2
+    np.testing.assert_allclose(w.numpy(), [0.8, 0.8], rtol=1e-6)

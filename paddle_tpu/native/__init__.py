@@ -1,0 +1,45 @@
+"""Native (C++) runtime components, built on demand with g++.
+
+The reference implements its graph engine / data feed / allocator in C++
+(SURVEY.md §2.1); the TPU build keeps the hot host-side paths native too:
+  graph_store.cc — sharded graph + alias-method sampling (GNN engine core)
+  datafeed.cc    — MultiSlot format parser (PS ingestion hot loop)
+Compute stays in XLA; these are host subsystems where python would be the
+bottleneck.
+"""
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def _build(name):
+    src = os.path.join(_DIR, name + '.cc')
+    out = os.path.join(_DIR, 'lib%s.so' % name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-o', out, src,
+           '-pthread']
+    subprocess.run(cmd, check=True, capture_output=True)
+    return out
+
+
+def load_library(name):
+    """Compile (cached) and dlopen a native helper; raises on failure so the
+    caller can fall back to a python implementation."""
+    with _BUILD_LOCK:
+        if name not in _LIBS:
+            _LIBS[name] = ctypes.CDLL(_build(name))
+        return _LIBS[name]
+
+
+def available(name):
+    try:
+        load_library(name)
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,147 @@
+"""Ring attention: sequence-parallel exact attention over the 'sp' mesh axis.
+
+Beyond-reference capability (SURVEY.md §5.7): the reference's long-sequence
+levers are recompute+pipeline; TPU-native long context shards the sequence
+over ICI and rotates K/V blocks with ppermute while accumulating streaming
+softmax (Liu et al. ring attention; blockwise from Dao et al.).
+
+Pure jax functions designed to run INSIDE shard_map (axis_name bound).
+Complexity per rank: O((N/sp)^2 * sp) flops but N/sp memory — the point.
+The per-block compute maps to the MXU via jnp.einsum; the ppermute rides
+ICI concurrently with compute (XLA async collectives overlap the loop body).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ['ring_attention', 'ulysses_attention', 'ring_attention_sharded',
+           'ulysses_attention_sharded']
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One blockwise attention step in f32 accumulators.
+
+    q: [B, Nq, H, D]; k/v: [B, Nk, H, D]; mask: [Nq, Nk] bool or None.
+    Returns (scores_max [B,H,Nq], exp-sum [B,H,Nq], acc [B,Nq,H,D])."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, -1e30)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return m, l, acc
+
+
+def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
+    """Exact attention with K/V rotating around the ring.
+
+    All inputs are the LOCAL sequence shard [B, N_local, H, D]; output is
+    the local shard of the attention result. Call inside shard_map with
+    `axis_name` bound to the sequence mesh axis.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n_dev = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, n_loc, h, d = q.shape
+
+    q32 = q.astype(jnp.float32)
+
+    # positions of the local q block (global)
+    q_pos = my_idx * n_loc + jnp.arange(n_loc)
+
+    def step(carry, r):
+        m_prev, l_prev, acc_prev, k_cur, v_cur = carry
+        # kv block currently held came from rank (my_idx - r) mod n_dev
+        src = jnp.mod(my_idx - r, n_dev)
+        if causal:
+            k_pos = src * n_loc + jnp.arange(n_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = None
+        m_blk, l_blk, acc_blk = _block_attn(q32, k_cur, v_cur, scale, mask)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = alpha * l_prev + beta * l_blk
+        acc_new = acc_prev * jnp.moveaxis(alpha, 1, 2)[..., None] + \
+            acc_blk * jnp.moveaxis(beta, 1, 2)[..., None]
+        # rotate kv to the next rank (ring)
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    m0 = jnp.full((b, h, n_loc), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, n_loc), jnp.float32)
+    acc0 = jnp.zeros((b, n_loc, h, d), jnp.float32)
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
+                                    jnp.arange(n_dev))
+    l = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
+    return (acc / l).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
+                      attn_fn=None):
+    """Ulysses (DeepSpeed) sequence parallelism: all_to_all swaps the
+    sequence shard for a head shard, runs full-sequence attention on H/sp
+    heads locally, and swaps back. Heads must divide the axis size."""
+    n_dev = lax.axis_size(axis_name)
+    b, n_loc, h, d = q.shape
+    assert h % n_dev == 0, 'ulysses needs heads %% sp == 0'
+
+    def seq2head(x):
+        # [B, N/sp, H, D] -> [B, N, H/sp, D]
+        x = x.reshape(b, n_loc, n_dev, h // n_dev, d)
+        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                           tiled=False)
+        return x.reshape(b, n_loc * n_dev, h // n_dev, d)
+
+    def head2seq(x):
+        n = x.shape[1]
+        x = x.reshape(b, n_dev, n // n_dev, h // n_dev, d)
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                           tiled=False)
+        return x.reshape(b, n // n_dev, h, d)
+
+    qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+    if attn_fn is None:
+        if scale is None:
+            scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jnp.einsum('bqhd,bkhd->bhqk', qf.astype(jnp.float32), kf,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            n = s.shape[-1]
+            cm = jnp.tril(jnp.ones((n, n), bool))
+            s = jnp.where(cm[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        of = jnp.einsum('bhqk,bkhd->bqhd', p.astype(vf.dtype), vf)
+    else:
+        of = attn_fn(qf, kf, vf)
+    return head2seq(of.astype(q.dtype))
+
+
+def _sharded(fn, mesh, axis_name, q, k, v, **kw):
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    spec = P(None, axis_name, None, None)
+    wrapped = shard_map(
+        functools.partial(fn, axis_name=axis_name, **kw), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+    return wrapped(q, k, v)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis_name='sp', causal=False):
+    """Host-level entry: q/k/v are GLOBAL [B, N, H, D] arrays; shard_map
+    splits the sequence over `axis_name` and runs the ring."""
+    return _sharded(ring_attention, mesh, axis_name, q, k, v, causal=causal)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, axis_name='sp', causal=False):
+    return _sharded(ulysses_attention, mesh, axis_name, q, k, v,
+                    causal=causal)

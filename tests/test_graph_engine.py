@@ -1,0 +1,66 @@
+"""Graph engine tests (reference pattern: distributed/test/graph_node_test.cc
+— same-process server+client, load edges, sample neighbors)."""
+import os
+
+import numpy as np
+import pytest
+
+
+def test_native_store_weighted_sampling():
+    from paddle_tpu.native.graph_store import GraphStore
+    gs = GraphStore(seed=3)
+    gs.add_edges([0] * 3, [10, 11, 12], weight=[1.0, 2.0, 7.0])
+    s = gs.sample_neighbors([0], 2000)[0]
+    frac_12 = float(np.mean(s == 12))
+    assert 0.6 < frac_12 < 0.8  # ~0.7
+
+
+def test_native_store_file_load(tmp_path):
+    from paddle_tpu.native.graph_store import GraphStore
+    p = tmp_path / 'edges.txt'
+    p.write_text('1\t2\n1\t3\n2\t4\t0.5\n')
+    gs = GraphStore()
+    n = gs.load_edge_file(str(p))
+    assert n == 3
+    assert gs.node_count() == 2
+    np.testing.assert_array_equal(gs.degree([1, 2]), [2, 1])
+
+
+def test_graph_service_cluster():
+    from paddle_tpu.distributed.graph_service import GraphPyService
+    svc = GraphPyService()
+    client = svc.set_up(num_servers=2)
+    try:
+        src = np.arange(100) % 10
+        dst = (np.arange(100) * 7) % 50 + 100
+        client.add_edges('default', src, dst)
+        deg = client.get_degree('default', np.arange(10))
+        assert deg.sum() == 100
+        samples = client.random_sample_neighboors('default',
+                                                  np.arange(10), 5)
+        assert samples.shape == (10, 5)
+        assert (samples >= 100).all()
+        # features round trip
+        ids = np.asarray([3, 7])
+        client.set_node_feat('default', ids,
+                             np.asarray([[1., 2.], [3., 4.]]))
+        feats = client.get_node_feat('default', ids, 2)
+        np.testing.assert_allclose(feats, [[1., 2.], [3., 4.]])
+        # node listing
+        nodes = client.random_sample_nodes('default', 0, 5)
+        assert len(nodes) <= 5
+    finally:
+        svc.stop()
+
+
+def test_multislot_parser_native_vs_python():
+    from paddle_tpu.native.datafeed import parse_multislot
+    text = '2 0.5 0.25 3 1 2 3\n1 9.0 2 7 8\nbad line\n1 1.0 1 5\n'
+    for force in (False, True):
+        slots, n = parse_multislot(text, ['float', 'int'],
+                                   force_python=force)
+        assert n == 3
+        np.testing.assert_allclose(slots[0][0], [0.5, 0.25, 9.0, 1.0])
+        np.testing.assert_array_equal(slots[0][1], [0, 2, 3, 4])
+        np.testing.assert_array_equal(slots[1][0], [1, 2, 3, 7, 8, 5])
+        np.testing.assert_array_equal(slots[1][1], [0, 3, 5, 6])

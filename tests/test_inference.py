@@ -1,0 +1,58 @@
+"""Inference engine tests (reference pattern:
+inference/tests/api/analyzer_*_tester.cc — save a model, load through the
+predictor, compare vs native forward)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.vision.models import LeNet
+
+
+def test_predictor_roundtrip(tmp_path):
+    paddle.seed(0)
+    model = LeNet()
+    model.eval()
+    path = str(tmp_path / 'lenet')
+    from paddle_tpu.static import InputSpec
+    paddle.jit.save(model, path, input_spec=[InputSpec([1, 1, 28, 28])])
+
+    from paddle_tpu import inference
+    config = inference.Config(path)
+    config.enable_memory_optim()
+    config.switch_ir_optim(True)
+    predictor = inference.create_predictor(config)
+
+    x = np.random.RandomState(0).standard_normal((2, 1, 28, 28)).astype(
+        np.float32)
+    # zero-copy style API
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    # list API + signature-cache second shape
+    out2 = predictor.run([x[:1]])[0]
+    np.testing.assert_allclose(out2, ref[:1], rtol=1e-4, atol=1e-5)
+
+
+def test_predictor_bf16_precision(tmp_path):
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / 'mlp')
+    paddle.jit.save(model, path)
+
+    from paddle_tpu import inference
+    config = inference.Config(path)
+    config.enable_tensorrt_engine(
+        precision_mode=inference.PrecisionType.Bfloat16)
+    predictor = inference.create_predictor(config)
+    x = np.random.RandomState(1).standard_normal((4, 8)).astype(np.float32)
+    out = predictor.run([x])[0]
+    ref = model(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out.astype(np.float32), ref, atol=0.1)

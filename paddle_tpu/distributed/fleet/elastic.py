@@ -9,6 +9,7 @@ the launcher.
 """
 import json
 import os
+import threading
 import time
 
 HEARTBEAT_TTL = 10.0
@@ -27,6 +28,8 @@ class ElasticManager:
         self.host = host
         self.ttl = ttl
         self._last_view = None
+        self._hb_stop = None
+        self._hb_thread = None
 
     def _hb_path(self, host=None):
         return os.path.join(self.dir, 'hb_%s.json' % (host or self.host))
@@ -34,8 +37,34 @@ class ElasticManager:
     def register(self):
         self.heartbeat()
         self._last_view = frozenset(self.hosts())
+        # keep the lease fresh while the launcher blocks in its watch loop —
+        # without this every peer's view goes stale after ttl and a clean
+        # exit looks like a membership change (infinite relaunch)
+        if (self._hb_thread is None or not self._hb_thread.is_alive()
+                or self._hb_stop.is_set()):
+            if self._hb_thread is not None and self._hb_thread.is_alive():
+                # re-register after unregister: retire the stopping thread
+                # before arming a fresh one, or the lease silently stops
+                self._hb_stop.set()
+                self._hb_thread.join()
+            self._hb_stop = threading.Event()
+            self._hb_thread = threading.Thread(target=self._hb_loop,
+                                               daemon=True)
+            self._hb_thread.start()
+
+    def _hb_loop(self):
+        while not self._hb_stop.wait(self.ttl / 3.0):
+            try:
+                self.heartbeat()
+            except OSError:
+                pass
 
     def unregister(self):
+        if self._hb_stop is not None:
+            # stop and JOIN before removing the file — an in-flight
+            # heartbeat write after the remove would resurrect the lease
+            self._hb_stop.set()
+            self._hb_thread.join()
         try:
             os.remove(self._hb_path())
         except FileNotFoundError:

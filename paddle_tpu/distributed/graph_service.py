@@ -219,6 +219,14 @@ class GraphPyClient:
                 self._call(s, {'op': 'stop'})
             except Exception:
                 pass
+        self.close()
+
+    def close(self):
+        for sock in self._socks:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class GraphPyService:
@@ -249,3 +257,13 @@ class GraphPyService:
     def stop(self):
         if self._client:
             self._client.stop_server()
+            self._client = None
+        for s in self._servers:
+            # the 'stop' op only shuts down serve_forever; release the
+            # listening socket too so repeated set_up/stop cycles don't
+            # leak fds
+            try:
+                s.stop_server()
+            except Exception:
+                pass
+        self._servers = []

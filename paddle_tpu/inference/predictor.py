@@ -145,9 +145,11 @@ class Tensor:
         self._predictor._input_shapes[self._name] = tuple(shape)
 
     def copy_from_cpu(self, data):
+        self._predictor._check_input_name(self._name)
         self._predictor._inputs[self._name] = np.ascontiguousarray(data)
 
     def share_external_data(self, data):
+        self._predictor._check_input_name(self._name)
         self._predictor._inputs[self._name] = np.asarray(data)
 
     # output side
@@ -194,9 +196,33 @@ class Predictor:
         self._layer = layer
         self._params = func_mod.extract_params(layer)
         self._buffers = func_mod.extract_buffers(layer)
-        # input names from saved spec if available, else positional
-        meta = getattr(self._translated, '_meta', None)
-        self._input_names = ['input_%d' % i for i in range(8)]
+        # input names from the saved input spec when available; otherwise
+        # arity is unknown until run() and positional input_<i> names are
+        # accepted open-endedly
+        meta = getattr(self._translated, '_meta', None) or {}
+        spec = meta.get('input_spec')
+        if spec:
+            self._input_names = [
+                (s[2] if len(s) > 2 and s[2] else 'input_%d' % i)
+                for i, s in enumerate(spec)]
+        else:
+            # no saved spec: derive arity from forward's required
+            # positional params so get_input_names() stays discoverable;
+            # variadic forwards stay fully dynamic (None)
+            self._input_names = None
+            import inspect
+            try:
+                sig = inspect.signature(layer.forward)
+                ps = list(sig.parameters.values())
+                if not any(p.kind == p.VAR_POSITIONAL for p in ps):
+                    req = [p for p in ps
+                           if p.kind in (p.POSITIONAL_ONLY,
+                                         p.POSITIONAL_OR_KEYWORD)
+                           and p.default is p.empty]
+                    self._input_names = ['input_%d' % i
+                                         for i in range(len(req))]
+            except (TypeError, ValueError):
+                pass
         self._fn = self._make_fn()
 
     def _make_fn(self):
@@ -210,9 +236,38 @@ class Predictor:
             return out
         return pure
 
+    def _check_input_name(self, name):
+        if self._input_names is not None:
+            if name not in self._input_names:
+                raise ValueError(
+                    'unknown input %r; model inputs are %s'
+                    % (name, self._input_names))
+        elif not (name.startswith('input_')
+                  and name[len('input_'):].isdigit()):
+            raise ValueError(
+                'model was saved without an input spec; use positional '
+                'names input_0..input_<n-1>, got %r' % name)
+
+    def _gather_inputs(self):
+        """Assemble run arguments in declared order, failing loudly on
+        missing inputs instead of silently dropping them."""
+        if self._input_names is not None:
+            missing = [n for n in self._input_names if n not in self._inputs]
+            if missing:
+                raise ValueError('inputs not set: %s' % missing)
+            return [self._inputs[n] for n in self._input_names]
+        idx = sorted(int(n[len('input_'):]) for n in self._inputs)
+        if idx != list(range(len(idx))):
+            raise ValueError(
+                'positional inputs must be contiguous input_0..input_%d, '
+                'got %s' % (len(idx) - 1, sorted(self._inputs)))
+        return [self._inputs['input_%d' % i] for i in idx]
+
     # -- handles -------------------------------------------------------------
     def get_input_names(self):
-        return self._input_names
+        if self._input_names is not None:
+            return list(self._input_names)
+        return sorted(self._inputs, key=lambda n: int(n[len('input_'):]))
 
     def get_input_handle(self, name):
         return Tensor(name, self)
@@ -236,8 +291,7 @@ class Predictor:
             # paddle-inference python API: run([np arrays]) -> [np arrays]
             arrays = [np.asarray(a) for a in input_list]
         else:
-            arrays = [self._inputs[n] for n in self._input_names
-                      if n in self._inputs]
+            arrays = self._gather_inputs()
         sig = tuple((a.shape, str(a.dtype)) for a in arrays)
         with self._lock:
             if sig not in self._compiled:

@@ -211,7 +211,9 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec:
         specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
                  for s in input_spec]
-        meta['input_spec'] = [(tuple(s.shape), s.dtype) for s in specs]
+        meta['input_spec'] = [(tuple(s.shape), s.dtype,
+                               s.name or 'input_%d' % i)
+                              for i, s in enumerate(specs)]
         was_training = layer.training
         layer.eval()
         try:
@@ -261,8 +263,9 @@ def _strip_for_pickle(layer):
 class TranslatedLayer:
     """Runs a loaded program (reference: dygraph/io.py:1082)."""
 
-    def __init__(self, layer, params, buffers):
+    def __init__(self, layer, params, buffers, meta=None):
         self._layer = layer
+        self._meta = meta or {}
         if layer is not None:
             pmap = dict(layer.named_parameters())
             for k, v in params.items():
@@ -307,4 +310,5 @@ def load(path, **configs):
                 for k, t in list(d.items()):
                     if t is not None and isinstance(t._data, np.ndarray):
                         t._data = jnp.asarray(t._data)
-    return TranslatedLayer(layer, state['params'], state['buffers'])
+    return TranslatedLayer(layer, state['params'], state['buffers'],
+                           meta=model_payload.get('meta'))

@@ -17,10 +17,11 @@ _BUILD_LOCK = threading.Lock()
 _LIBS = {}
 
 
-def _build(name):
+def _build(name, force=False):
     src = os.path.join(_DIR, name + '.cc')
     out = os.path.join(_DIR, 'lib%s.so' % name)
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    if not force and os.path.exists(out) \
+            and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-o', out, src,
            '-pthread']
@@ -33,7 +34,11 @@ def load_library(name):
     caller can fall back to a python implementation."""
     with _BUILD_LOCK:
         if name not in _LIBS:
-            _LIBS[name] = ctypes.CDLL(_build(name))
+            try:
+                _LIBS[name] = ctypes.CDLL(_build(name))
+            except OSError:
+                # existing .so not loadable on this platform — rebuild
+                _LIBS[name] = ctypes.CDLL(_build(name, force=True))
         return _LIBS[name]
 
 

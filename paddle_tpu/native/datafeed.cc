@@ -57,6 +57,9 @@ void* df_parse(const char* buf, int64_t len, int num_slots,
     std::vector<std::pair<int64_t, const char*>> starts;
     for (int s = 0; s < num_slots && ok; s++) {
       q = skip_ws(q, line_end);
+      // strto* skip '\n' themselves, so an exhausted line would silently
+      // consume tokens from the NEXT line; bound every parse by line_end.
+      if (q >= line_end) { ok = false; break; }
       char* next = nullptr;
       long n = strtol(q, &next, 10);
       if (next == q || n < 0) { ok = false; break; }
@@ -64,6 +67,7 @@ void* df_parse(const char* buf, int64_t len, int num_slots,
       SlotBuf& sb = res->slots[s];
       for (long i = 0; i < n; i++) {
         q = skip_ws(q, line_end);
+        if (q >= line_end) { ok = false; break; }
         if (sb.is_float) {
           float v = strtof(q, &next);
           if (next == q) { ok = false; break; }

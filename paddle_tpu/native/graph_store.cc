@@ -85,6 +85,23 @@ struct GraphStore {
 
 thread_local std::mt19937 g_rng{std::random_device{}()};
 
+// Append one edge keeping weights consistent when weighted and unweighted
+// inserts are mixed for the same node: a missing weight means 1.0, and a
+// late first weight backfills 1.0 for all earlier neighbors, so
+// weights.size() is always 0 or nbrs.size() (the sampler relies on this).
+inline void push_edge(Node& nd, int64_t dst, bool has_w, float w) {
+  nd.nbrs.push_back(dst);
+  if (has_w) {
+    if (nd.weights.size() + 1 < nd.nbrs.size())
+      nd.weights.resize(nd.nbrs.size() - 1, 1.f);
+    nd.weights.push_back(w);
+  } else if (!nd.weights.empty()) {
+    nd.weights.push_back(1.f);
+  }
+  delete nd.alias;
+  nd.alias = nullptr;
+}
+
 }  // namespace
 
 extern "C" {
@@ -105,10 +122,7 @@ int64_t gs_add_edges(void* h, const int64_t* src, const int64_t* dst,
     Shard& sh = gs->shard_of(src[i]);
     std::lock_guard<std::mutex> lk(sh.mu);
     Node& nd = sh.nodes[src[i]];
-    nd.nbrs.push_back(dst[i]);
-    if (weight) nd.weights.push_back(weight[i]);
-    delete nd.alias;
-    nd.alias = nullptr;
+    push_edge(nd, dst[i], weight != nullptr, weight ? weight[i] : 1.f);
   }
   gs->edge_count += n;
   return n;
@@ -140,10 +154,7 @@ int64_t gs_load_edge_file(void* h, const char* path, int reversed) {
     Shard& sh = gs->shard_of(s);
     std::lock_guard<std::mutex> lk(sh.mu);
     Node& nd = sh.nodes[s];
-    nd.nbrs.push_back(d);
-    if (got >= 3) nd.weights.push_back(w);
-    delete nd.alias;
-    nd.alias = nullptr;
+    push_edge(nd, d, got >= 3, w);
     count++;
   }
   fclose(f);

@@ -139,11 +139,26 @@ class _PythonStore:
         self._feat = {}
         self._rng = np.random.RandomState(seed)
 
+    def _push(self, s, d, w):
+        # mirror native push_edge: a missing weight means 1.0, and a late
+        # first weight backfills 1.0, so weights is always absent or
+        # len(nbrs) long
+        nbrs = self._nbrs.setdefault(s, [])
+        nbrs.append(d)
+        ws = self._weights.get(s)
+        if w is not None:
+            if ws is None:
+                ws = self._weights.setdefault(s, [])
+            if len(ws) + 1 < len(nbrs):
+                ws.extend([1.0] * (len(nbrs) - 1 - len(ws)))
+            ws.append(float(w))
+        elif ws is not None:
+            ws.append(1.0)
+
     def add_edges(self, src, dst, weight=None):
         for i, (s, d) in enumerate(zip(np.asarray(src), np.asarray(dst))):
-            self._nbrs.setdefault(int(s), []).append(int(d))
-            if weight is not None:
-                self._weights.setdefault(int(s), []).append(float(weight[i]))
+            self._push(int(s), int(d),
+                       float(weight[i]) if weight is not None else None)
         return len(src)
 
     def add_nodes(self, ids):
@@ -161,9 +176,7 @@ class _PythonStore:
                 a, b = int(parts[0]), int(parts[1])
                 if reversed:
                     a, b = b, a
-                self._nbrs.setdefault(a, []).append(b)
-                if len(parts) >= 3:
-                    self._weights.setdefault(a, []).append(float(parts[2]))
+                self._push(a, b, float(parts[2]) if len(parts) >= 3 else None)
                 n += 1
         return n
 

@@ -105,7 +105,9 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
     def head2seq(x):
         n = x.shape[1]
         x = x.reshape(b, n_dev, n // n_dev, h // n_dev, d)
-        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+        # concat the incoming device axis BEFORE the local-head axis so the
+        # flattened head index is dev*h_loc+local, matching seq2head's split
+        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                            tiled=False)
         return x.reshape(b, n // n_dev, h, d)
 

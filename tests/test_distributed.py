@@ -174,18 +174,28 @@ def test_ulysses_attention_matches_full():
     from paddle_tpu.ops.ring_attention import ulysses_attention_sharded
     mesh = _mesh((8,), ('sp',))
     rng = np.random.RandomState(1)
-    b, n, h, d = 2, 64, 8, 16   # h divisible by sp=8
+    # h=16 over sp=8 gives 2 local heads per device — exercises the
+    # head-reconstruction order in head2seq (regression: heads were
+    # permuted whenever h/sp > 1)
+    b, n, h, d = 2, 64, 16, 16
     q = jnp.asarray(rng.standard_normal((b, n, h, d)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((b, n, h, d)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((b, n, h, d)), jnp.float32)
 
     s = np.einsum('bqhd,bkhd->bhqk', np.asarray(q), np.asarray(k)) / np.sqrt(d)
-    p = np.exp(s - s.max(-1, keepdims=True))
-    p = p / p.sum(-1, keepdims=True)
-    ref = np.einsum('bhqk,bkhd->bqhd', p, np.asarray(v))
 
-    out = ulysses_attention_sharded(q, k, v, mesh, causal=False)
-    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4)
+    def ref_of(scores, causal):
+        if causal:
+            mask = np.tril(np.ones((n, n), bool))
+            scores = np.where(mask[None, None], scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        return np.einsum('bhqk,bkhd->bqhd', p, np.asarray(v))
+
+    for causal in (False, True):
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), ref_of(s, causal),
+                                   atol=2e-4, err_msg='causal=%s' % causal)
 
 
 def test_collective_api_world1_identity():

@@ -64,3 +64,43 @@ def test_multislot_parser_native_vs_python():
         np.testing.assert_array_equal(slots[0][1], [0, 2, 3, 4])
         np.testing.assert_array_equal(slots[1][0], [1, 2, 3, 7, 8, 5])
         np.testing.assert_array_equal(slots[1][1], [0, 3, 5, 6])
+
+
+def test_mixed_weighted_unweighted_edges():
+    # regression: a node receiving both weighted and unweighted edges must
+    # sample over ALL neighbors (missing weight means 1.0), and native and
+    # python fallbacks must agree on the semantics
+    from paddle_tpu.native.graph_store import GraphStore
+    for force in (False, True):
+        gs = GraphStore(seed=7, force_python=force)
+        gs.add_edges([0, 0], [10, 11])                 # unweighted first
+        gs.add_edges([0], [12], weight=[6.0])          # then weighted
+        s = gs.sample_neighbors([0], 4000)[0]
+        seen = set(np.unique(s).tolist())
+        assert seen == {10, 11, 12}, (force, seen)
+        frac_12 = float(np.mean(s == 12))
+        assert 0.65 < frac_12 < 0.85, (force, frac_12)  # 6/8 = 0.75
+
+
+def test_multislot_truncated_line_not_stealing_next(tmp_path):
+    # regression: a line declaring more values than it supplies must be
+    # dropped without consuming tokens from the following line
+    from paddle_tpu.native.datafeed import parse_multislot
+    text = '1 0.5 2 7\n2 1.0 2.0 3 1 2 3\n'
+    for force in (False, True):
+        slots, n = parse_multislot(text, ['float', 'int'], force_python=force)
+        assert n == 1, ('force_python=%s' % force)
+        np.testing.assert_allclose(slots[0][0], [1.0, 2.0])
+        np.testing.assert_array_equal(slots[1][0], [1, 2, 3])
+
+
+def test_graph_service_restart_cycle():
+    # regression: set_up/stop must release listening sockets so repeated
+    # cycles in one process don't leak fds
+    from paddle_tpu.distributed.graph_service import GraphPyService
+    for _ in range(3):
+        svc = GraphPyService()
+        client = svc.set_up(num_servers=2)
+        client.add_edges('default', [1], [2])
+        assert client.get_degree('default', [1])[0] == 1
+        svc.stop()

@@ -56,3 +56,30 @@ def test_predictor_bf16_precision(tmp_path):
     out = predictor.run([x])[0]
     ref = model(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(out.astype(np.float32), ref, atol=0.1)
+
+
+def test_predictor_named_inputs_and_validation(tmp_path):
+    # inputs resolved by the SAVED spec names; unknown names rejected at
+    # copy_from_cpu time; run() fails loudly on missing inputs
+    import pytest
+    paddle.seed(2)
+    model = nn.Sequential(nn.Linear(8, 4))
+    model.eval()
+    path = str(tmp_path / 'named')
+    from paddle_tpu.static import InputSpec
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([2, 8], name='features')])
+
+    from paddle_tpu import inference
+    predictor = inference.create_predictor(inference.Config(path))
+    assert predictor.get_input_names() == ['features']
+    with pytest.raises(ValueError):
+        predictor.get_input_handle('bogus').copy_from_cpu(np.zeros((2, 8)))
+    with pytest.raises(ValueError):
+        predictor.run()
+    x = np.random.RandomState(2).standard_normal((2, 8)).astype(np.float32)
+    predictor.get_input_handle('features').copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle('output_0').copy_to_cpu()
+    np.testing.assert_allclose(out, model(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,24 @@
+"""Model compression (slim): quantization-aware training and post-training
+quantization.
+
+Reference: python/paddle/fluid/contrib/slim/ — quantization_pass.py (static
+QAT graph rewrite), imperative/qat.py (ImperativeQuantAware),
+post_training_quantization.py, cal_kl_threshold.py. On TPU the static-graph
+rewrite and the imperative wrapper collapse into one mechanism (layer
+swapping; XLA compiles either way), so one API serves both modes.
+"""
+from .cal_kl_threshold import cal_kl_threshold
+from .ptq import ImperativePTQ, PostTrainingQuantization
+from .qat import ImperativeQuantAware
+from .quant_layers import (FakeQuantAbsMax, FakeQuantMovingAverageAbsMax,
+                           QuantedConv2D, QuantedLinear,
+                           fake_quant_dequant_abs_max,
+                           fake_quant_dequant_channel_wise,
+                           fake_quant_dequant_with_scale)
+
+__all__ = [
+    'ImperativeQuantAware', 'PostTrainingQuantization', 'ImperativePTQ',
+    'cal_kl_threshold', 'QuantedLinear', 'QuantedConv2D', 'FakeQuantAbsMax',
+    'FakeQuantMovingAverageAbsMax', 'fake_quant_dequant_abs_max',
+    'fake_quant_dequant_channel_wise', 'fake_quant_dequant_with_scale',
+]

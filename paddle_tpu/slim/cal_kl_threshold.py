@@ -1,0 +1,86 @@
+"""KL-divergence calibration threshold.
+
+Parity: python/paddle/fluid/contrib/slim/quantization/cal_kl_threshold.py
+(the TensorRT-style entropy calibration: pick the clip threshold whose
+quantized distribution has minimal KL divergence from the original
+histogram).
+"""
+import math
+
+import numpy as np
+
+__all__ = ['cal_kl_threshold']
+
+
+def _expand_quantized_bins(quantized_bins, reference_bins):
+    """Spread each quantized bin's mass uniformly over its source bins
+    (zero-count source bins stay zero)."""
+    expanded = np.zeros(len(reference_bins), np.float64)
+    num_merged = len(reference_bins) // len(quantized_bins) \
+        if len(quantized_bins) else 0
+    if num_merged == 0:
+        return expanded
+    j_start = 0
+    for idx, q in enumerate(quantized_bins):
+        j_end = len(reference_bins) if idx == len(quantized_bins) - 1 \
+            else j_start + num_merged
+        zero_count = int(np.count_nonzero(
+            np.asarray(reference_bins[j_start:j_end]) == 0))
+        num_bins = j_end - j_start
+        nonzero = num_bins - zero_count
+        avg = q / nonzero if nonzero else 0.0
+        for j in range(j_start, j_end):
+            expanded[j] = 0.0 if reference_bins[j] == 0 else avg
+        j_start = j_end
+    return expanded
+
+
+def _safe_kl(reference, candidate):
+    """KL(reference || candidate) over matching bins, skipping zeros."""
+    total = float(np.sum(reference))
+    if total <= 0:
+        return float('inf')
+    kl = 0.0
+    for p, q in zip(reference, candidate):
+        if p > 0:
+            kl += math.inf if q <= 0 else p * math.log(p / q)
+            if kl == math.inf:
+                break
+    return kl / total
+
+
+def cal_kl_threshold(hist, bin_width, bits):
+    """Return the activation clip threshold for `hist` (histogram of |x|).
+
+    hist: counts over [0, abs_max); bin_width: abs_max/len(hist);
+    bits: target bit width (8 → 127 positive quant levels, matching the
+    reference's 2**(bits-1)-1).
+    """
+    assert hist.ndim == 1
+    hist_bins = len(hist)
+    starting_iter = hist_bins // 2
+    quant_range = 2 ** (bits - 1) - 1
+
+    p_sum = float(np.sum(hist))
+    if p_sum <= 0 or hist_bins <= quant_range:
+        return bin_width * hist_bins
+
+    min_kl = float('inf')
+    best_i = hist_bins
+    for i in range(starting_iter, hist_bins + 1):
+        reference = hist[:i].astype(np.float64).copy()
+        # outliers beyond i clip into the last bin
+        reference[-1] += float(np.sum(hist[i:]))
+        if reference[-1] == 0 or quant_range >= i:
+            continue
+        # quantize reference into quant_range merged bins
+        num_merged = i // quant_range
+        used = num_merged * quant_range
+        q = reference[:used].reshape(quant_range, num_merged).sum(axis=1)
+        q[-1] += float(np.sum(reference[used:]))
+        candidate = _expand_quantized_bins(q, reference)
+        kl = _safe_kl(reference, candidate)
+        if kl < min_kl:
+            min_kl = kl
+            best_i = i
+    return (best_i + 0.5) * bin_width

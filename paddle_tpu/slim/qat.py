@@ -1,0 +1,68 @@
+"""Imperative quantization-aware training.
+
+Parity: python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:40
+(ImperativeQuantAware.quantize walks the model and swaps quantizable layers
+for fake-quant wrappers; save_quantized_model exports for inference).
+"""
+from .. import nn
+from .quant_layers import QUANT_LAYER_MAP
+
+__all__ = ['ImperativeQuantAware']
+
+
+class ImperativeQuantAware:
+    def __init__(self, quantizable_layer_type=('Conv2D', 'Linear'),
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_preprocess_layer=None, act_preprocess_layer=None,
+                 weight_quantize_layer=None, act_quantize_layer=None):
+        for t in quantizable_layer_type:
+            key = t if isinstance(t, str) else t.__name__
+            if key not in QUANT_LAYER_MAP:
+                raise ValueError('unsupported quantizable layer type %r '
+                                 '(supported: %s)'
+                                 % (t, sorted(QUANT_LAYER_MAP)))
+        if weight_quantize_type not in ('abs_max', 'channel_wise_abs_max'):
+            raise ValueError('weight_quantize_type must be abs_max or '
+                             'channel_wise_abs_max')
+        if activation_quantize_type not in ('abs_max',
+                                            'moving_average_abs_max'):
+            raise ValueError('activation_quantize_type must be abs_max or '
+                             'moving_average_abs_max')
+        self._types = tuple(t if isinstance(t, str) else t.__name__
+                            for t in quantizable_layer_type)
+        self._wq_type = weight_quantize_type
+        self._aq_type = activation_quantize_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def _wrap(self, layer):
+        for tname in self._types:
+            cls, quanted = QUANT_LAYER_MAP[tname]
+            if type(layer) is cls:
+                if getattr(layer, 'skip_quant', False):
+                    return layer
+                return quanted(layer, weight_bits=self._wbits,
+                               activation_bits=self._abits,
+                               weight_quantize_type=self._wq_type,
+                               activation_quantize_type=self._aq_type,
+                               moving_rate=self._rate)
+        return layer
+
+    def quantize(self, model):
+        """In-place: swap quantizable sublayers for fake-quant wrappers.
+        Returns the model (reference returns None; returning it is a strict
+        superset)."""
+        if not isinstance(model, nn.Layer):
+            raise TypeError('quantize expects a paddle Layer')
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                layer._sub_layers[name] = self._wrap(sub)
+        return model
+
+    def save_quantized_model(self, layer, path, input_spec=None, **config):
+        from .. import jit
+        layer.eval()
+        jit.save(layer, path, input_spec=input_spec, **config)

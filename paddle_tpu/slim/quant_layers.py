@@ -1,0 +1,194 @@
+"""Fake-quant primitives and quantized layer wrappers.
+
+TPU-native equivalent of the reference's fake_quantize ops + quant layers
+(paddle/fluid/operators/fake_quantize_op.cc, python/paddle/fluid/contrib/
+slim/quantization/imperative/quant_layers usage in qat.py). Quantization is
+simulated (quantize-dequantize) with a straight-through estimator so QAT
+trains on TPU inside jit; scales live as Layer buffers so they ride the
+functional_call state path like BN running stats.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.core import Tensor, run_op
+from ..nn import functional as F
+
+__all__ = [
+    'fake_quant_dequant_abs_max', 'fake_quant_dequant_channel_wise',
+    'fake_quant_dequant_with_scale', 'FakeQuantAbsMax',
+    'FakeQuantMovingAverageAbsMax', 'QuantedLinear', 'QuantedConv2D',
+    'QUANT_LAYER_MAP',
+]
+
+_EPS = 1e-9
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _ste_round_clip(y, qmax):
+    return jnp.round(jnp.clip(y, -qmax, qmax))
+
+
+def _ste_fwd(y, qmax):
+    return _ste_round_clip(y, qmax), jnp.abs(y) <= qmax
+
+
+def _ste_bwd(qmax, in_range, g):
+    # straight-through inside [-qmax, qmax] (inclusive), zero outside —
+    # lax.clip would split gradient 0.5/0.5 at exact boundaries
+    return (jnp.where(in_range, g, 0.0),)
+
+
+_ste_round_clip.defvjp(_ste_fwd, _ste_bwd)
+
+
+def fake_quant_dequant_with_scale(x, scale, bits=8):
+    """Quantize-dequantize against a given scale (per-tensor or broadcast).
+
+    Gradient is straight-through inside the clip range, zero outside
+    (reference fake_quantize_dequantize_moving_average_abs_max behavior).
+    """
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), _EPS)
+    return _ste_round_clip(x / s * qmax, qmax) * s / qmax
+
+
+def fake_quant_dequant_abs_max(x, bits=8):
+    """Dynamic per-tensor abs-max quant-dequant (reference 'abs_max')."""
+    scale = jnp.max(jnp.abs(x))
+    return fake_quant_dequant_with_scale(x, jax.lax.stop_gradient(scale),
+                                         bits)
+
+
+def fake_quant_dequant_channel_wise(w, bits=8, axis=0):
+    """Per-output-channel abs-max (reference 'channel_wise_abs_max')."""
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(w), axis=red, keepdims=True)
+    return fake_quant_dequant_with_scale(w, jax.lax.stop_gradient(scale),
+                                         bits)
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Weight quanter: dynamic abs-max each call (no state)."""
+
+    def __init__(self, bits=8, channel_wise=False, axis=0):
+        super().__init__()
+        self._bits = bits
+        self._channel_wise = channel_wise
+        self._axis = axis
+
+    def forward(self, x):
+        # through run_op so the eager tape records the STE vjp and grads
+        # reach the (possibly Parameter) input
+        if self._channel_wise:
+            return run_op(
+                'fake_quant_channel_wise',
+                lambda a: fake_quant_dequant_channel_wise(
+                    a, self._bits, self._axis), x)
+        return run_op('fake_quant_abs_max',
+                      lambda a: fake_quant_dequant_abs_max(a, self._bits), x)
+
+
+class FakeQuantMovingAverageAbsMax(nn.Layer):
+    """Activation quanter: EMA of abs-max during training, frozen scale in
+    eval (reference 'moving_average_abs_max', moving_rate=0.9)."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self._bits = bits
+        self._rate = moving_rate
+        self.register_buffer('scale', Tensor(jnp.zeros([])))
+        self.register_buffer('initialized', Tensor(jnp.zeros([], jnp.int32)))
+
+    def forward(self, x):
+        arr = x._data if isinstance(x, Tensor) else x
+        if self.training:
+            cur = jax.lax.stop_gradient(jnp.max(jnp.abs(arr))
+                                        .astype(jnp.float32))
+            inited = self.initialized._data > 0
+            prev = self.scale._data
+            new = jnp.where(inited, self._rate * prev + (1 - self._rate) * cur,
+                            cur)
+            self.scale._data = new
+            self.initialized._data = jnp.ones([], jnp.int32)
+            scale = new
+        else:
+            scale = jnp.where(self.scale._data > 0, self.scale._data,
+                              jnp.max(jnp.abs(arr)).astype(jnp.float32))
+        scale = jax.lax.stop_gradient(scale)
+        return run_op(
+            'fake_quant_moving_avg',
+            lambda a: fake_quant_dequant_with_scale(
+                a, scale.astype(a.dtype), self._bits), x)
+
+
+def _make_weight_quanter(quantize_type, bits, axis):
+    return FakeQuantAbsMax(bits=bits,
+                           channel_wise=quantize_type == 'channel_wise_abs_max',
+                           axis=axis)
+
+
+def _make_act_quanter(quantize_type, bits, moving_rate):
+    if quantize_type == 'moving_average_abs_max':
+        return FakeQuantMovingAverageAbsMax(bits=bits,
+                                            moving_rate=moving_rate)
+    return FakeQuantAbsMax(bits=bits)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized input and weight (qat.py QuantizedLinear)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        # paddle Linear weight is [in, out]: per-channel axis is 1
+        self._weight_quanter = _make_weight_quanter(weight_quantize_type,
+                                                    weight_bits, axis=1)
+        self._act_quanter = _make_act_quanter(activation_quantize_type,
+                                              activation_bits, moving_rate)
+
+    def forward(self, x):
+        xq = self._act_quanter(x)
+        wq = self._weight_quanter(self.weight)
+        return F.linear(xq, wq, self.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    """Conv2D with fake-quantized input and weight (qat.py QuantizedConv2D)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 weight_quantize_type='abs_max',
+                 activation_quantize_type='moving_average_abs_max',
+                 moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+        self._data_format = getattr(layer, '_data_format', 'NCHW')
+        # conv weight is [out, in/g, kh, kw]: per-channel axis 0
+        self._weight_quanter = _make_weight_quanter(weight_quantize_type,
+                                                    weight_bits, axis=0)
+        self._act_quanter = _make_act_quanter(activation_quantize_type,
+                                              activation_bits, moving_rate)
+
+    def forward(self, x):
+        xq = self._act_quanter(x)
+        wq = self._weight_quanter(self.weight)
+        return F.conv2d(xq, wq, self.bias, stride=self._stride,
+                        padding=self._padding, dilation=self._dilation,
+                        groups=self._groups, data_format=self._data_format)
+
+
+QUANT_LAYER_MAP = {
+    'Linear': (nn.Linear, QuantedLinear),
+    'Conv2D': (nn.Conv2D, QuantedConv2D),
+}

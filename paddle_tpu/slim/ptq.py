@@ -15,7 +15,8 @@ from ..framework.core import Tensor
 from .cal_kl_threshold import cal_kl_threshold
 from .qat import ImperativeQuantAware
 from .quant_layers import (QUANT_LAYER_MAP, FakeQuantMovingAverageAbsMax,
-                           QuantedConv2D, QuantedLinear)
+                           QuantedConv2D, QuantedLinear,
+                           resolve_quant_types)
 
 __all__ = ['PostTrainingQuantization', 'ImperativePTQ']
 
@@ -35,6 +36,7 @@ class _Observer:
         self.samples = []
         self.hist = None
         self.hist_range = 0.0
+        self._mse_rng = np.random.RandomState(0)
 
     def _rebin(self, new_range):
         """Proportionally redistribute hist counts from [0, hist_range)
@@ -68,7 +70,8 @@ class _Observer:
             # plain randint draw beats an O(n) no-replacement permutation)
             flat = arr.reshape(-1)
             if flat.size > 1 << 16:
-                idx = np.random.RandomState(0).randint(0, flat.size, 1 << 16)
+                # persistent rng: each batch samples different positions
+                idx = self._mse_rng.randint(0, flat.size, 1 << 16)
                 flat = flat[idx]
             self.samples.append(flat)
         elif self.algo in ('KL', 'hist'):
@@ -140,14 +143,16 @@ class PostTrainingQuantization:
             raise ValueError('algo must be one of %s' % (_ALGOS,))
         if model is None or data_loader is None:
             raise ValueError('model and data_loader are required')
+        if weight_quantize_type not in ('abs_max', 'channel_wise_abs_max'):
+            raise ValueError('weight_quantize_type must be abs_max or '
+                             'channel_wise_abs_max')
         self._model = model
         self._loader = data_loader
         self._batch_nums = batch_nums
         self._algo = algo
         self._bins = bins
         self._hist_percent = hist_percent
-        self._types = tuple(t if isinstance(t, str) else t.__name__
-                            for t in quantizable_op_type)
+        self._types = resolve_quant_types(quantizable_op_type)
         self._wbits = weight_bits
         self._abits = activation_bits
         self._wq_type = weight_quantize_type
@@ -174,14 +179,16 @@ class PostTrainingQuantization:
             removes.append(sub.register_forward_pre_hook(hook))
 
         # decide feed arity up front (no retry — a retry after a mid-model
-        # TypeError would double-count observations on early layers)
+        # TypeError would double-count observations on early layers).
+        # Count ALL positional params (optional ones included: a loader may
+        # legitimately supply them); only the surplus beyond that — e.g. a
+        # trailing label — is dropped.
         import inspect
         n_feed = None
         try:
             sig = inspect.signature(self._model.forward)
             ps = [p for p in sig.parameters.values()
-                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-                  and p.default is p.empty]
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
             if not any(p.kind == p.VAR_POSITIONAL
                        for p in sig.parameters.values()):
                 n_feed = len(ps)

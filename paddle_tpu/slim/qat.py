@@ -5,7 +5,7 @@ Parity: python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:40
 for fake-quant wrappers; save_quantized_model exports for inference).
 """
 from .. import nn
-from .quant_layers import QUANT_LAYER_MAP
+from .quant_layers import QUANT_LAYER_MAP, resolve_quant_types
 
 __all__ = ['ImperativeQuantAware']
 
@@ -17,12 +17,7 @@ class ImperativeQuantAware:
                  weight_bits=8, activation_bits=8, moving_rate=0.9,
                  weight_preprocess_layer=None, act_preprocess_layer=None,
                  weight_quantize_layer=None, act_quantize_layer=None):
-        for t in quantizable_layer_type:
-            key = t if isinstance(t, str) else t.__name__
-            if key not in QUANT_LAYER_MAP:
-                raise ValueError('unsupported quantizable layer type %r '
-                                 '(supported: %s)'
-                                 % (t, sorted(QUANT_LAYER_MAP)))
+        types = resolve_quant_types(quantizable_layer_type)
         if weight_quantize_type not in ('abs_max', 'channel_wise_abs_max'):
             raise ValueError('weight_quantize_type must be abs_max or '
                              'channel_wise_abs_max')
@@ -30,25 +25,36 @@ class ImperativeQuantAware:
                                             'moving_average_abs_max'):
             raise ValueError('activation_quantize_type must be abs_max or '
                              'moving_average_abs_max')
-        self._types = tuple(t if isinstance(t, str) else t.__name__
-                            for t in quantizable_layer_type)
+        if any(l is not None for l in (weight_preprocess_layer,
+                                       act_preprocess_layer,
+                                       weight_quantize_layer,
+                                       act_quantize_layer)):
+            raise NotImplementedError(
+                'custom preprocess/quantize layers are not supported yet; '
+                'use weight_quantize_type/activation_quantize_type')
+        self._types = types
         self._wq_type = weight_quantize_type
         self._aq_type = activation_quantize_type
         self._wbits = weight_bits
         self._abits = activation_bits
         self._rate = moving_rate
 
-    def _wrap(self, layer):
+    def _wrap(self, layer, memo):
         for tname in self._types:
             cls, quanted = QUANT_LAYER_MAP[tname]
             if type(layer) is cls:
                 if getattr(layer, 'skip_quant', False):
                     return layer
-                return quanted(layer, weight_bits=self._wbits,
-                               activation_bits=self._abits,
-                               weight_quantize_type=self._wq_type,
-                               activation_quantize_type=self._aq_type,
-                               moving_rate=self._rate)
+                # a layer shared at several model paths gets ONE wrapper
+                # (so e.g. PTQ scale assignment covers every path)
+                if id(layer) not in memo:
+                    memo[id(layer)] = quanted(
+                        layer, weight_bits=self._wbits,
+                        activation_bits=self._abits,
+                        weight_quantize_type=self._wq_type,
+                        activation_quantize_type=self._aq_type,
+                        moving_rate=self._rate)
+                return memo[id(layer)]
         return layer
 
     def quantize(self, model):
@@ -57,9 +63,10 @@ class ImperativeQuantAware:
         superset)."""
         if not isinstance(model, nn.Layer):
             raise TypeError('quantize expects a paddle Layer')
+        memo = {}
         for layer in model.sublayers(include_self=True):
             for name, sub in list(layer._sub_layers.items()):
-                layer._sub_layers[name] = self._wrap(sub)
+                layer._sub_layers[name] = self._wrap(sub, memo)
         return model
 
     def save_quantized_model(self, layer, path, input_spec=None, **config):

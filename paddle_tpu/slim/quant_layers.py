@@ -192,3 +192,26 @@ QUANT_LAYER_MAP = {
     'Linear': (nn.Linear, QuantedLinear),
     'Conv2D': (nn.Conv2D, QuantedConv2D),
 }
+
+# the reference's static-graph op names, accepted as aliases by both QAT
+# and PTQ constructors
+QUANT_TYPE_ALIASES = {
+    'conv2d': 'Conv2D', 'depthwise_conv2d': 'Conv2D',
+    'linear': 'Linear', 'mul': 'Linear', 'matmul': 'Linear',
+}
+
+
+def resolve_quant_types(types):
+    """Normalize user-provided quantizable layer/op types to
+    QUANT_LAYER_MAP keys; raises ValueError on unknown names."""
+    out = []
+    for t in types:
+        key = t if isinstance(t, str) else t.__name__
+        key = QUANT_TYPE_ALIASES.get(key, key)
+        if key not in QUANT_LAYER_MAP:
+            raise ValueError('unsupported quantizable type %r '
+                             '(supported: %s + aliases %s)'
+                             % (t, sorted(QUANT_LAYER_MAP),
+                                sorted(QUANT_TYPE_ALIASES)))
+        out.append(key)
+    return tuple(dict.fromkeys(out))

@@ -1,0 +1,13 @@
+"""C++ custom-op extensions: JIT compile, load, and register.
+
+Parity: python/paddle/utils/cpp_extension/ (load(), CppExtension,
+BuildExtension over setuptools) + framework/custom_operator.cc:511,867
+(LoadOpMetaInfoAndRegisterOp). TPU-native twist: the host C++ kernel is
+wired into jax via pure_callback (works inside jit) and an optional grad
+kernel becomes the op's custom VJP — no framework rebuild, no protobuf.
+"""
+from .extension_utils import (CppExtension, CUDAExtension, BuildExtension,
+                              get_include_dir, load, load_op_library, setup)
+
+__all__ = ['load', 'load_op_library', 'setup', 'CppExtension',
+           'CUDAExtension', 'BuildExtension', 'get_include_dir']

@@ -1,0 +1,293 @@
+"""Build/load machinery for C++ custom ops (see package docstring)."""
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+__all__ = ['load', 'load_op_library', 'setup', 'CppExtension',
+           'CUDAExtension', 'BuildExtension', 'get_include_dir']
+
+_DTYPE_CODES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+_DTYPE_TO_CODE = {np.dtype(v): k for k, v in _DTYPE_CODES.items()}
+_PD_MAX_DIMS = 8
+
+
+class PDTensor(ctypes.Structure):
+    _fields_ = [('data', ctypes.c_void_p),
+                ('ndim', ctypes.c_int64),
+                ('shape', ctypes.c_int64 * _PD_MAX_DIMS),
+                ('dtype', ctypes.c_int32)]
+
+
+def get_include_dir():
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'include')
+
+
+def _compile(sources, name, extra_cflags=None, extra_ldflags=None,
+             extra_include_paths=None, build_directory=None, verbose=False):
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), 'paddle_tpu_extensions')
+    os.makedirs(build_dir, exist_ok=True)
+    key = hashlib.sha256()
+    # hash the framework header too: an ABI change (PDTensor layout,
+    # pd_op_meta contract) must invalidate cached .so artifacts
+    header_files = [os.path.join(get_include_dir(), 'pd_extension.h')]
+    for p in (extra_include_paths or []):
+        if os.path.isdir(p):
+            header_files += sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(('.h', '.hpp')))
+    for s in list(sources) + header_files:
+        with open(s, 'rb') as f:
+            key.update(f.read())
+    key.update(' '.join((extra_cflags or []) + (extra_ldflags or []))
+               .encode())
+    out = os.path.join(build_dir, '%s_%s.so' % (name, key.hexdigest()[:12]))
+    if os.path.exists(out):
+        return out
+    cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17',
+           '-I', get_include_dir()]
+    for p in (extra_include_paths or []):
+        cmd += ['-I', p]
+    cmd += (extra_cflags or []) + ['-o', out] + list(sources) + \
+        (extra_ldflags or [])
+    if verbose:
+        print('compiling:', ' '.join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError('extension compile failed:\n%s' % proc.stderr)
+    return out
+
+
+def _as_pd_tensor(arr):
+    t = PDTensor()
+    t.data = arr.ctypes.data if arr.size else None
+    t.ndim = arr.ndim
+    for i, d in enumerate(arr.shape):
+        t.shape[i] = d
+    t.dtype = _DTYPE_TO_CODE[arr.dtype]
+    return t
+
+
+class _LoadedOp:
+    """One custom op: callable over Tensors/arrays, jit-safe, differentiable
+    when a grad kernel was registered."""
+
+    def __init__(self, lib, idx, name, meta):
+        import jax
+
+        self._lib = lib
+        self._idx = idx
+        self.name = name
+        (self.n_inputs, self.n_outputs, self._has_infer,
+         self.grad_n_inputs, self.grad_n_outputs, self._has_grad) = \
+            [int(m) for m in meta]
+
+        def host_call(is_grad, *arrays):
+            arrays = [np.ascontiguousarray(a) for a in arrays]
+            ins = (PDTensor * len(arrays))(*[_as_pd_tensor(a)
+                                            for a in arrays])
+            n_out = self.grad_n_outputs if is_grad else self.n_outputs
+            out_metas = (PDTensor * n_out)()
+            # infer shapes (forward uses pd_infer_shape; grad outputs are
+            # grads of forward inputs, so they take those shapes)
+            if is_grad:
+                out_arrays = [np.empty(arrays[i].shape, arrays[i].dtype)
+                              for i in range(n_out)]
+            else:
+                rc = lib.pd_infer_shape(idx, ins, len(arrays), out_metas,
+                                        n_out)
+                if rc != 0:
+                    raise RuntimeError('pd_infer_shape(%s) failed rc=%d'
+                                       % (name, rc))
+                out_arrays = []
+                for m in out_metas:
+                    shape = tuple(m.shape[i] for i in range(m.ndim))
+                    out_arrays.append(
+                        np.empty(shape, _DTYPE_CODES[m.dtype]))
+            outs = (PDTensor * n_out)(*[_as_pd_tensor(a)
+                                        for a in out_arrays])
+            rc = lib.pd_run(idx, 1 if is_grad else 0, ins, len(arrays),
+                            outs, n_out)
+            if rc != 0:
+                raise RuntimeError('custom op %s%s failed rc=%d'
+                                   % (name, ' (grad)' if is_grad else '',
+                                      rc))
+            return tuple(out_arrays)
+
+        self._host_call = host_call
+
+        single_out = self.n_outputs == 1
+
+        def fwd_arrays(*arrays):
+            # single-output ops return a bare array (run_op's backward
+            # passes a leaf cotangent for one output, tuple otherwise)
+            out_shapes = self._infer_shapes(arrays)
+            structs = tuple(jax.ShapeDtypeStruct(s, d)
+                            for s, d in out_shapes)
+            out = jax.pure_callback(
+                lambda *a: host_call(False, *a), structs, *arrays,
+                vmap_method='sequential')
+            return out[0] if single_out else out
+
+        # ALWAYS wrap in custom_vjp: pure_callback has no JVP rule, so a
+        # bare wrapper would crash at jax.vjp time (i.e. during any
+        # forward with grad-requiring inputs) even if no gradient is ever
+        # pulled. Without a grad kernel the error fires only on backward.
+        @jax.custom_vjp
+        def op_fn(*arrays):
+            return fwd_arrays(*arrays)
+
+        def vjp_fwd(*arrays):
+            return fwd_arrays(*arrays), arrays
+
+        has_grad = self._has_grad
+        op_name = self.name
+
+        def vjp_bwd(res, cts):
+            if not has_grad:
+                raise NotImplementedError(
+                    'custom op %s has no grad kernel registered '
+                    '(PD_BUILD_GRAD_OP missing)' % op_name)
+            cts_t = (cts,) if single_out else tuple(cts)
+            structs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for a in res)
+            grads = jax.pure_callback(
+                lambda *a: host_call(True, *a), structs,
+                *(tuple(res) + cts_t), vmap_method='sequential')
+            return tuple(grads)
+
+        op_fn.defvjp(vjp_fwd, vjp_bwd)
+        self._fn = op_fn
+
+    def _infer_shapes(self, arrays):
+        """Host-side shape inference over ShapeDtypeStructs/arrays."""
+        metas_in = (PDTensor * len(arrays))()
+        for i, a in enumerate(arrays):
+            metas_in[i].data = None
+            metas_in[i].ndim = len(a.shape)
+            for j, d in enumerate(a.shape):
+                metas_in[i].shape[j] = d
+            metas_in[i].dtype = _DTYPE_TO_CODE[np.dtype(a.dtype)]
+        metas_out = (PDTensor * self.n_outputs)()
+        rc = self._lib.pd_infer_shape(self._idx, metas_in, len(arrays),
+                                      metas_out, self.n_outputs)
+        if rc != 0:
+            raise RuntimeError('pd_infer_shape(%s) failed rc=%d'
+                               % (self.name, rc))
+        return [(tuple(m.shape[i] for i in range(m.ndim)),
+                 _DTYPE_CODES[m.dtype]) for m in metas_out]
+
+    def __call__(self, *args):
+        from ...framework.core import Tensor, run_op
+        tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+        if len(tensors) != self.n_inputs:
+            raise ValueError('%s expects %d inputs, got %d'
+                             % (self.name, self.n_inputs, len(tensors)))
+        return run_op('custom_' + self.name, self._fn, *tensors)
+
+
+class _Module:
+    """Namespace holding the ops of one loaded extension."""
+
+    def __init__(self, name, ops):
+        self.__name__ = name
+        self._ops = {op.name: op for op in ops}
+        for op in ops:
+            setattr(self, op.name, op)
+
+    def op_names(self):
+        return sorted(self._ops)
+
+
+def load_op_library(so_path, name=None):
+    """dlopen an already-built extension and register its ops.
+
+    Parity: paddle.utils.cpp_extension.load_op_library /
+    framework/custom_operator.cc LoadOpMetaInfoAndRegisterOp.
+    """
+    lib = ctypes.CDLL(so_path)
+    lib.pd_num_ops.restype = ctypes.c_int
+    lib.pd_op_name.restype = ctypes.c_char_p
+    lib.pd_op_name.argtypes = [ctypes.c_int]
+    lib.pd_op_meta.restype = ctypes.c_int
+    lib.pd_op_meta.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+    lib.pd_infer_shape.restype = ctypes.c_int
+    lib.pd_infer_shape.argtypes = [ctypes.c_int, ctypes.POINTER(PDTensor),
+                                   ctypes.c_int, ctypes.POINTER(PDTensor),
+                                   ctypes.c_int]
+    lib.pd_run.restype = ctypes.c_int
+    lib.pd_run.argtypes = [ctypes.c_int, ctypes.c_int,
+                           ctypes.POINTER(PDTensor), ctypes.c_int,
+                           ctypes.POINTER(PDTensor), ctypes.c_int]
+    ops = []
+    for i in range(lib.pd_num_ops()):
+        op_name = lib.pd_op_name(i).decode()
+        meta = (ctypes.c_int64 * 6)()
+        lib.pd_op_meta(i, meta)
+        n_in, n_out = int(meta[0]), int(meta[1])
+        g_in, g_out, has_grad = int(meta[3]), int(meta[4]), bool(meta[5])
+        if has_grad and (g_in != n_in + n_out or g_out != n_in):
+            # the VJP supplies (fwd inputs..., cotangents...) and expects
+            # one grad per fwd input — catch arity mismatches at load time
+            # instead of as an OOB read inside the native kernel
+            raise RuntimeError(
+                'grad kernel of %s declares %d inputs/%d outputs; expected '
+                '%d inputs (fwd inputs + fwd outputs) and %d outputs (one '
+                'grad per fwd input)'
+                % (op_name, g_in, g_out, n_in + n_out, n_in))
+        ops.append(_LoadedOp(lib, i, op_name, list(meta)))
+    if not ops:
+        raise RuntimeError('%s exports no custom ops (PD_BUILD_OP missing?)'
+                           % so_path)
+    return _Module(name or os.path.basename(so_path), ops)
+
+
+def load(name, sources, extra_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None,
+         build_directory=None, verbose=False):
+    """JIT-compile `sources` against pd_extension.h and return a module
+    whose attributes are the registered ops (paddle cpp_extension.load
+    parity; extra_cuda_cflags accepted and ignored — host C++ only here)."""
+    so = _compile(sources, name, extra_cflags=extra_cflags,
+                  extra_ldflags=extra_ldflags,
+                  extra_include_paths=extra_include_paths,
+                  build_directory=build_directory, verbose=verbose)
+    return load_op_library(so, name=name)
+
+
+# ---- setuptools-style surface ---------------------------------------------
+class CppExtension:
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+# accepted for API compatibility; compiles the same host C++ path
+CUDAExtension = CppExtension
+
+
+class BuildExtension:
+    """Minimal build_ext stand-in: building produces the .so eagerly."""
+
+    @staticmethod
+    def with_options(**_):
+        return BuildExtension
+
+
+def setup(name, ext_modules=None, **kwargs):
+    """Build each extension now and return the artifact paths (the
+    reference's setuptools path writes an installable egg; here the build
+    directory module is the product, loadable via load_op_library)."""
+    outs = []
+    for ext in (ext_modules or []):
+        outs.append(_compile(ext.sources, name,
+                             **{k: v for k, v in ext.kwargs.items()
+                                if k in ('extra_cflags', 'extra_ldflags',
+                                         'extra_include_paths',
+                                         'build_directory', 'verbose')}))
+    return outs

@@ -1,0 +1,155 @@
+"""Custom-op extension tests (reference pattern:
+python/paddle/fluid/tests/custom_op/ — JIT-compile an extension .so then
+run it, checking forward, backward, and jit integration)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RELU_SRC = textwrap.dedent('''
+#include "pd_extension.h"
+
+static int relu_fwd(const PDTensor* ins, int n_in, PDTensor* outs,
+                    int n_out) {
+  const float* x = (const float*)ins[0].data;
+  float* y = (float*)outs[0].data;
+  for (int64_t i = 0; i < pd_numel(&ins[0]); i++)
+    y[i] = x[i] > 0.f ? x[i] : 0.f;
+  return 0;
+}
+
+// ins: (x, dy) -> dx
+static int relu_bwd(const PDTensor* ins, int n_in, PDTensor* outs,
+                    int n_out) {
+  const float* x = (const float*)ins[0].data;
+  const float* dy = (const float*)ins[1].data;
+  float* dx = (float*)outs[0].data;
+  for (int64_t i = 0; i < pd_numel(&ins[0]); i++)
+    dx[i] = x[i] > 0.f ? dy[i] : 0.f;
+  return 0;
+}
+
+PD_BUILD_OP(custom_relu, 1, 1, relu_fwd);
+PD_BUILD_GRAD_OP(custom_relu, 2, 1, relu_bwd);
+
+// concat-last-dim op with a real infer function: [N,A],[N,B] -> [N,A+B]
+static int cat_infer(const PDTensor* ins, int n_in, PDTensor* outs,
+                     int n_out) {
+  outs[0].ndim = 2;
+  outs[0].shape[0] = ins[0].shape[0];
+  outs[0].shape[1] = ins[0].shape[1] + ins[1].shape[1];
+  outs[0].dtype = ins[0].dtype;
+  return 0;
+}
+
+static int cat_fwd(const PDTensor* ins, int n_in, PDTensor* outs,
+                   int n_out) {
+  int64_t n = ins[0].shape[0], a = ins[0].shape[1], b = ins[1].shape[1];
+  const float* x = (const float*)ins[0].data;
+  const float* y = (const float*)ins[1].data;
+  float* o = (float*)outs[0].data;
+  for (int64_t r = 0; r < n; r++) {
+    for (int64_t i = 0; i < a; i++) o[r * (a + b) + i] = x[r * a + i];
+    for (int64_t i = 0; i < b; i++) o[r * (a + b) + a + i] = y[r * b + i];
+  }
+  return 0;
+}
+
+PD_BUILD_OP_INFER(custom_cat2, 2, 1, cat_fwd, cat_infer);
+''')
+
+
+@pytest.fixture(scope='module')
+def ext(tmp_path_factory):
+    from paddle_tpu.utils.cpp_extension import load
+    d = tmp_path_factory.mktemp('ext')
+    src = d / 'custom_ops.cc'
+    src.write_text(RELU_SRC)
+    return load('custom_ops', [str(src)], build_directory=str(d))
+
+
+def test_custom_relu_forward(ext):
+    x = np.random.RandomState(0).standard_normal((4, 5)).astype(np.float32)
+    out = ext.custom_relu(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), np.maximum(x, 0))
+
+
+def test_custom_relu_backward(ext):
+    x = paddle.to_tensor(np.asarray([[-1.0, 2.0], [3.0, -4.0]],
+                                    np.float32), stop_gradient=False)
+    y = ext.custom_relu(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[0.0, 1.0], [1.0, 0.0]])
+
+
+def test_custom_op_under_jit(ext):
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray([[-1.0, 2.0]], jnp.float32)
+
+    @jax.jit
+    def f(a):
+        return ext._ops['custom_relu']._fn(a) * 2.0
+
+    np.testing.assert_allclose(np.asarray(f(x)), [[0.0, 4.0]])
+    g = jax.grad(lambda a: jnp.sum(ext._ops['custom_relu']._fn(a)))(x)
+    np.testing.assert_allclose(np.asarray(g), [[0.0, 1.0]])
+
+
+def test_custom_infer_shape_op(ext):
+    a = paddle.to_tensor(np.ones((3, 2), np.float32))
+    b = paddle.to_tensor(np.zeros((3, 4), np.float32))
+    out = ext.custom_cat2(a, b)
+    assert tuple(out.shape) == (3, 6)
+    np.testing.assert_allclose(out.numpy()[:, :2], 1.0)
+    np.testing.assert_allclose(out.numpy()[:, 2:], 0.0)
+
+
+def test_load_cache_and_input_validation(ext, tmp_path):
+    with pytest.raises(ValueError):
+        ext.custom_relu(paddle.to_tensor(np.ones(2, np.float32)),
+                        paddle.to_tensor(np.ones(2, np.float32)))
+    assert ext.op_names() == ['custom_cat2', 'custom_relu']
+
+
+def test_gradless_op_forward_ok_backward_errors(tmp_path):
+    # an op without a grad kernel must still run FORWARD on inputs that
+    # require grad; the error fires only when a gradient is pulled
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / 'sq.cc'
+    src.write_text(textwrap.dedent('''
+    #include "pd_extension.h"
+    static int sq(const PDTensor* ins, int n, PDTensor* outs, int m) {
+      const float* x = (const float*)ins[0].data;
+      float* y = (float*)outs[0].data;
+      for (int64_t i = 0; i < pd_numel(&ins[0]); i++) y[i] = x[i] * x[i];
+      return 0;
+    }
+    PD_BUILD_OP(custom_square, 1, 1, sq);
+    '''))
+    ext2 = load('sq_ext', [str(src)], build_directory=str(tmp_path))
+    x = paddle.to_tensor(np.asarray([2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    y = ext2.custom_square(x)
+    np.testing.assert_allclose(y.numpy(), [4.0, 9.0])
+    with pytest.raises(Exception):
+        y.sum().backward()
+
+
+def test_bad_grad_arity_rejected(tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / 'bad.cc'
+    src.write_text(textwrap.dedent('''
+    #include "pd_extension.h"
+    static int f(const PDTensor* ins, int n, PDTensor* outs, int m) {
+      return 0;
+    }
+    PD_BUILD_OP(custom_bad, 1, 1, f);
+    PD_BUILD_GRAD_OP(custom_bad, 3, 1, f);  // wrong: should be 2 inputs
+    '''))
+    with pytest.raises(RuntimeError, match='grad kernel'):
+        load('bad_ext', [str(src)], build_directory=str(tmp_path))

@@ -176,3 +176,35 @@ def test_ptq_hooks_removed_on_failure():
         ptq.quantize()
     for layer in model.sublayers(include_self=True):
         assert not layer._forward_pre_hooks, layer
+
+
+def test_shared_layer_single_wrapper_and_alias_types():
+    # a layer shared at two paths must get ONE wrapper so calibrated scales
+    # cover every call site; lowercase reference op names are accepted
+    class TwoPath(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            shared = nn.Linear(8, 8)
+            self.a = shared
+            self.b = shared
+
+        def forward(self, x):
+            return self.a(x) + self.b(x)
+
+    model = TwoPath()
+    rng = np.random.RandomState(0)
+    data = [paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+            for _ in range(2)]
+    ptq = PostTrainingQuantization(model=model, data_loader=data,
+                                   batch_nums=2, algo='abs_max',
+                                   quantizable_op_type=('linear',))
+    ptq.quantize()
+    assert model.a is model.b
+    assert isinstance(model.a, QuantedLinear)
+    assert float(model.a._act_quanter.scale.numpy()) > 0
+
+    with pytest.raises(ValueError):
+        PostTrainingQuantization(model=model, data_loader=data,
+                                 quantizable_op_type=('nope',))
+    with pytest.raises(NotImplementedError):
+        ImperativeQuantAware(weight_quantize_layer=object())

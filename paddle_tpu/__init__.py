@@ -57,6 +57,7 @@ from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import slim  # noqa: F401
 from . import utils  # noqa: F401
+from . import dataset  # noqa: F401
 from . import sysconfig  # noqa: F401
 
 from .nn.layer.layers import ParamAttr  # noqa: F401

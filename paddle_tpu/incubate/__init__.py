@@ -1,5 +1,6 @@
 """paddle.incubate parity: experimental features."""
 from ..distributed.fleet.utils import recompute  # noqa: F401
+from . import asp  # noqa: F401
 
 
 class nn:

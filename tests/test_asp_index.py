@@ -1,0 +1,131 @@
+"""ASP 2:4 sparsity + tree index tests (reference patterns:
+fluid/contrib/sparsity tests test_asp_*.py; index_dataset
+test_index_dataset.py / index_wrapper tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+def test_create_mask_1d_two_four():
+    rng = np.random.RandomState(0)
+    w = rng.standard_normal((16, 32)).astype(np.float32)
+    mask = asp.create_mask(w, 'mask_1d', n=2, m=4)
+    assert asp.check_mask_1d(w * mask, 2, 4)
+    assert asp.calculate_density(mask) == 0.5
+    # the kept entries are the largest-|w| two of each group of 4
+    groups_w = np.abs(w).reshape(-1, 4)
+    groups_m = mask.reshape(-1, 4)
+    for gw, gm in zip(groups_w, groups_m):
+        kept = set(np.flatnonzero(gm))
+        top2 = set(np.argsort(-gw)[:2])
+        assert kept == top2
+
+
+def test_create_mask_2d():
+    rng = np.random.RandomState(1)
+    w = rng.standard_normal((8, 8)).astype(np.float32)
+    mask = asp.create_mask(w, 'mask_2d_greedy', n=2, m=4)
+    assert asp.check_mask_2d(w * mask, 2, 4)
+    assert 0.3 <= asp.calculate_density(mask) <= 0.5
+
+
+def test_prune_model_and_decorated_step_preserves_sparsity():
+    paddle.seed(5)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert len(masks) == 2
+    for layer in (model[0], model[2]):
+        assert asp.check_mask_1d(np.asarray(layer.weight._data), 2, 4)
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 4, (8,)).astype(np.int64))
+    for _ in range(3):
+        loss = nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern must survive optimizer updates
+    for layer in (model[0], model[2]):
+        w = np.asarray(layer.weight._data)
+        assert asp.check_mask_1d(w, 2, 4)
+        assert np.count_nonzero(w) > 0
+
+
+def test_excluded_layers():
+    paddle.seed(6)
+    model = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    asp.set_excluded_layers(['0'])
+    try:
+        helper = asp.ASPHelper()
+        masks = helper.prune_model(model)
+        assert len(masks) == 1
+    finally:
+        asp.reset_excluded_layers()
+
+
+def test_tree_index_build_and_queries(tmp_path):
+    from paddle_tpu.distributed.index_dataset import TreeIndex, IndexWrapper
+    items = [100, 101, 102, 103, 104]
+    tree = TreeIndex.from_items(items, branch=2)
+    assert tree.height() == 4  # 8-leaf complete binary tree
+    assert sorted(tree.get_all_leafs()) == items
+    # travel path ends at root code 0
+    path = tree.get_travel_codes(103)
+    assert path[-1] == 0 and len(path) == tree.height()
+    # ancestors at level 1 are codes 1 or 2
+    anc = tree.get_ancestor_codes(items, 1)
+    assert set(anc) <= {1, 2}
+    pi = tree.get_pi_relation([100], 2)
+    assert 100 in pi
+
+    p = str(tmp_path / 'tree.npz')
+    tree.save(p)
+    wrapper = IndexWrapper()
+    wrapper.insert_tree_index('t', p)
+    t2 = wrapper.get_tree_index('t')
+    assert t2.total_node_nums() == tree.total_node_nums()
+    assert sorted(t2.get_all_leafs()) == items
+    with pytest.raises(KeyError):
+        wrapper.get_tree_index('nope')
+
+
+def test_layerwise_sampler_rows():
+    from paddle_tpu.distributed.index_dataset import (TreeIndex,
+                                                      LayerWiseSampler)
+    tree = TreeIndex.from_items(list(range(8)), branch=2)
+    sampler = LayerWiseSampler(tree, layer_sample_counts=[1, 2, 3], seed=0)
+    rows = sampler.sample([[7, 7]], [3])
+    pos = [r for r in rows if r[2] == 1]
+    neg = [r for r in rows if r[2] == 0]
+    # one positive per non-root travel level
+    assert len(pos) == tree.height() - 1
+    assert len(neg) >= len(pos)
+    # positives are the ancestors' ids of item 3
+    codes = tree.get_travel_codes(3)[:-1]
+    pos_ids = {r[1] for r in pos}
+    assert pos_ids == {tree._code_to_id[c] for c in codes}
+
+
+def test_beam_search_sampler_finds_best_leaf():
+    from paddle_tpu.distributed.index_dataset import (TreeIndex,
+                                                      BeamSearchSampler)
+    items = list(range(16))
+    tree = TreeIndex.from_items(items, branch=2)
+    target = 11
+
+    def score(user, nid):
+        # score favors nodes on the target's path: simulate a learned model
+        if nid == target:
+            return 10.0
+        path_ids = {tree._code_to_id[c]
+                    for c in tree.get_travel_codes(target)}
+        return 5.0 if nid in path_ids else float(-abs(hash(nid)) % 100) / 100
+    sampler = BeamSearchSampler(tree, beam_size=2)
+    result = sampler.sample([1, 2], score)
+    assert target in result

@@ -1,0 +1,260 @@
+"""Text dataset loader tests: build miniature archives in the reference's
+standard on-disk layouts, then drive the real parsers (zero-egress analog
+of the reference's download-then-parse tests)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+
+def _add_text(tf, name, text):
+    data = text.encode()
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture(scope='module')
+def imdb_tgz(tmp_path_factory):
+    d = tmp_path_factory.mktemp('imdb')
+    path = d / 'aclImdb_v1.tar.gz'
+    reviews = {
+        'train/pos/0_9.txt': 'a great great movie truly great',
+        'train/pos/1_8.txt': 'great fun and a great cast',
+        'train/neg/0_2.txt': 'a terrible terrible movie truly terrible',
+        'train/neg/1_1.txt': 'terrible plot and terrible acting',
+        'test/pos/0_10.txt': 'great stuff',
+        'test/neg/0_1.txt': 'terrible stuff',
+    }
+    with tarfile.open(path, 'w:gz') as tf:
+        for name, text in reviews.items():
+            _add_text(tf, 'aclImdb/' + name, text)
+    return str(path)
+
+
+def test_imdb_parsing_and_word_dict(imdb_tgz):
+    from paddle_tpu.text.datasets import Imdb
+    ds = Imdb(data_file=imdb_tgz, mode='train', cutoff=2)
+    # words with freq > 2 in train: 'great'(5), 'terrible'(5), 'a'(3)
+    assert set(ds.word_idx) == {'great', 'terrible', 'a', '<unk>'}
+    # ids ordered by (-freq, word): great/terrible (5) before a (3)
+    assert ds.word_idx['a'] == 2
+    assert len(ds) == 4
+    # first samples are pos (label 0), then neg (label 1)
+    labels = [int(ds[i][1]) for i in range(4)]
+    assert labels == [0, 0, 1, 1]
+    ids, label = ds[0]
+    assert ids.dtype == np.int64
+    test = Imdb(data_file=imdb_tgz, mode='test', cutoff=2)
+    assert len(test) == 2
+
+
+def test_uci_housing_and_legacy_reader(tmp_path):
+    rng = np.random.RandomState(0)
+    raw = np.hstack([rng.standard_normal((50, 13)),
+                     rng.uniform(10, 50, (50, 1))])
+    f = tmp_path / 'housing.data'
+    np.savetxt(f, raw)
+    from paddle_tpu.text.datasets import UCIHousing
+    tr = UCIHousing(data_file=str(f), mode='train')
+    te = UCIHousing(data_file=str(f), mode='test')
+    assert len(tr) == 40 and len(te) == 10
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+    from paddle_tpu import dataset as legacy
+    reader = legacy.uci_housing.train(data_file=str(f))
+    rows = list(reader())
+    assert len(rows) == 40 and rows[0][0].shape == (13,)
+
+
+@pytest.fixture(scope='module')
+def ml_zip(tmp_path_factory):
+    d = tmp_path_factory.mktemp('ml')
+    path = d / 'ml-1m.zip'
+    movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+              "2::Jumanji (1995)::Adventure\n")
+    users = ("1::M::25::12::55117\n"
+             "2::F::35::7::55105\n")
+    ratings = ("1::1::5::978300760\n"
+               "1::2::3::978302109\n"
+               "2::1::4::978301968\n"
+               "2::2::2::978300275\n")
+    with zipfile.ZipFile(path, 'w') as zf:
+        zf.writestr('ml-1m/movies.dat', movies)
+        zf.writestr('ml-1m/users.dat', users)
+        zf.writestr('ml-1m/ratings.dat', ratings)
+    return str(path)
+
+
+def test_movielens(ml_zip):
+    from paddle_tpu.text.datasets import Movielens
+    tr = Movielens(data_file=ml_zip, mode='train', test_ratio=0.25,
+                   rand_seed=1)
+    te = Movielens(data_file=ml_zip, mode='test', test_ratio=0.25,
+                   rand_seed=1)
+    assert len(tr) + len(te) == 4
+    row = (tr if len(tr) else te)[0]
+    # [uid, gender, age, job, mid, [categories], [title ids], rating]
+    assert isinstance(row[5], list) and isinstance(row[6], list)
+    assert isinstance(row[-1], float)
+
+
+@pytest.fixture(scope='module')
+def wmt14_tgz(tmp_path_factory):
+    d = tmp_path_factory.mktemp('wmt14')
+    path = d / 'wmt14.tgz'
+    with tarfile.open(path, 'w:gz') as tf:
+        _add_text(tf, 'wmt14/train/part0.src',
+                  'hello world\ngood morning\n')
+        _add_text(tf, 'wmt14/train/part0.trg',
+                  'bonjour monde\nbon matin\n')
+        _add_text(tf, 'wmt14/test/part0.src', 'hello\n')
+        _add_text(tf, 'wmt14/test/part0.trg', 'bonjour\n')
+        _add_text(tf, 'wmt14/train.dict.src',
+                  'hello\nworld\ngood\nmorning\n')
+        _add_text(tf, 'wmt14/train.dict.trg',
+                  'bonjour\nmonde\nbon\nmatin\n')
+    return str(path)
+
+
+def test_wmt14(wmt14_tgz):
+    from paddle_tpu.text.datasets import WMT14
+    ds = WMT14(data_file=wmt14_tgz, mode='train')
+    assert len(ds) == 2
+    src, trg, trg_next = ds[0]
+    assert ds.src_dict['<s>'] == 0 and ds.src_dict['<e>'] == 1
+    # trg starts with <s>, trg_next ends with <e>
+    assert trg[0] == ds.trg_dict['<s>']
+    assert trg_next[-1] == ds.trg_dict['<e>']
+    assert len(trg) == len(trg_next)
+    test = WMT14(data_file=wmt14_tgz, mode='test')
+    assert len(test) == 1
+
+
+@pytest.fixture(scope='module')
+def wmt16_tgz(tmp_path_factory):
+    d = tmp_path_factory.mktemp('wmt16')
+    path = d / 'wmt16.tar.gz'
+    with tarfile.open(path, 'w:gz') as tf:
+        _add_text(tf, 'wmt16/train',
+                  'a red house\tein rotes haus\n'
+                  'the cat\tdie katze\n')
+        _add_text(tf, 'wmt16/test', 'a house\tein haus\n')
+        _add_text(tf, 'wmt16/vocab_en.txt', 'a\nred\nhouse\nthe\ncat\n')
+        _add_text(tf, 'wmt16/vocab_de.txt',
+                  'ein\nrotes\nhaus\ndie\nkatze\n')
+    return str(path)
+
+
+def test_wmt16(wmt16_tgz):
+    from paddle_tpu.text.datasets import WMT16
+    ds = WMT16(data_file=wmt16_tgz, mode='train', lang='en')
+    assert len(ds) == 2
+    src, trg, trg_next = ds[1]
+    assert [int(i) for i in src] == [ds.src_dict['the'],
+                                     ds.src_dict['cat']]
+    assert int(trg[0]) == ds.trg_dict['<s>']
+    assert int(trg_next[-1]) == ds.trg_dict['<e>']
+
+
+@pytest.fixture(scope='module')
+def conll_tgz(tmp_path_factory):
+    d = tmp_path_factory.mktemp('conll')
+    path = d / 'conll05st-tests.tar.gz'
+    words = 'The\ncat\nsat\n\n'
+    props = '-\t*\n-\t*\nsat\t(V*)\n\n'
+    with tarfile.open(path, 'w:gz') as tf:
+        _add_text(tf, 'conll05st-release/test.wsj/words/test.wsj.words.gz',
+                  '')
+        _add_text(tf, 'conll05st-release/test.wsj/props/test.wsj.props.gz',
+                  '')
+    # rewrite with real gzipped members
+    with tarfile.open(path, 'w:gz') as tf:
+        for name, txt in (
+                ('conll05st-release/test.wsj/words/test.wsj.words.gz',
+                 words),
+                ('conll05st-release/test.wsj/props/test.wsj.props.gz',
+                 props)):
+            data = gzip.compress(txt.encode())
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def test_conll05(conll_tgz, tmp_path):
+    from paddle_tpu.text.datasets import Conll05st
+    wd = tmp_path / 'words.dict'
+    wd.write_text('the\ncat\nsat\n<unk>\n')
+    vd = tmp_path / 'verbs.dict'
+    vd.write_text('sat\n')
+    ld = tmp_path / 'labels.dict'
+    ld.write_text('O\nB-V\nI-V\n')
+    ds = Conll05st(data_file=conll_tgz, word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(ld))
+    assert len(ds) == 1
+    sample = ds[0]
+    word_ids = sample[0]
+    labels = sample[-1]
+    mark = sample[-2]
+    assert list(word_ids) == [0, 1, 2]
+    assert list(mark) == [0, 0, 1]       # predicate position
+    assert list(labels) == [0, 0, 1]     # O O B-V
+
+
+def test_missing_archive_raises():
+    from paddle_tpu.text.datasets import Imdb
+    with pytest.raises(FileNotFoundError):
+        Imdb(data_file='/nonexistent/imdb.tgz')
+
+
+def test_wmt16_lang_de_swaps_columns(wmt16_tgz):
+    from paddle_tpu.text.datasets import WMT16
+    ds = WMT16(data_file=wmt16_tgz, mode='train', lang='de')
+    # source must now be the GERMAN column against the German vocab
+    src, trg, trg_next = ds[1]
+    assert [int(i) for i in src] == [ds.src_dict['die'],
+                                     ds.src_dict['katze']]
+    assert [int(i) for i in trg[1:]] == [ds.trg_dict['the'],
+                                         ds.trg_dict['cat']]
+
+
+def test_conll05_lemma_predicate(tmp_path):
+    # props column 0 holds the LEMMA ('sit'), surface word is 'sat':
+    # the predicate position must come from the B-V label column
+    import tarfile as tl
+    path = tmp_path / 'conll05st-tests.tar.gz'
+    words = 'The\ncat\nsat\n\n'
+    props = '-\t*\n-\t*\nsit\t(V*)\n\n'
+    with tl.open(path, 'w:gz') as tf:
+        for name, txt in (
+                ('conll05st-release/test.wsj/words/test.wsj.words.gz',
+                 words),
+                ('conll05st-release/test.wsj/props/test.wsj.props.gz',
+                 props)):
+            data = gzip.compress(txt.encode())
+            info = tl.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    from paddle_tpu.text.datasets import Conll05st
+    ds = Conll05st(data_file=str(path))
+    sample = ds[0]
+    mark = sample[-2]
+    assert list(mark) == [0, 0, 1]  # position of B-V, not of the lemma
+    # no dict files: auto ids must be deterministic (first-seen order)
+    word_ids = sample[0]
+    assert list(word_ids) == [0, 1, 2]
+
+
+def test_legacy_imdb_honors_word_idx(imdb_tgz):
+    from paddle_tpu import dataset as legacy
+    custom = {'great': 7, 'terrible': 9, '<unk>': 0}
+    reader = legacy.imdb.train(custom, data_file=imdb_tgz)
+    rows = list(reader())
+    ids = np.concatenate([r[0] for r in rows])
+    assert set(np.unique(ids)) <= {0, 7, 9}

@@ -86,8 +86,8 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level='INFO'):
     _FLEET['initialized'] = True
 
 
-def _strategy_dict():
-    s = _FLEET['strategy'] or DistributedStrategy()
+def _strategy_dict(s=None):
+    s = s or _FLEET['strategy'] or DistributedStrategy()
     return {
         'zero_stage': s._zero_stage(),
         'tensor_parallel': s.tensor_parallel,
@@ -145,14 +145,62 @@ def _prepare_train_step():
 
 def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
     """Build the sharded jitted TrainStep for (model, loss, optimizer) under
-    the fleet strategy — the executable artifact of fleet.minimize."""
+    the fleet strategy — the executable artifact of fleet.minimize.
+
+    Strategy routing (reference meta-optimizer selection,
+    base/strategy_compiler.py): localsgd/dgc/fp16_allreduce need explicit
+    collectives, so they build the shard_map engine
+    (meta_optimizers.ShardMapDPStep) over the dp axis; everything else
+    (dp/mp/sharding/amp/recompute/gradient_merge) composes in the pjit
+    TrainStep. lars/lamb swap the optimizer first.
+    """
+    from . import meta_optimizers as mo
+
     hcg = hcg or _FLEET['hcg']
     if hcg is None:
         init(is_collective=True, strategy=strategy)
         hcg = _FLEET['hcg']
-    sdict = _strategy_dict()
-    if strategy is not None and isinstance(strategy, DistributedStrategy):
-        sdict['zero_stage'] = strategy._zero_stage()
+    s = strategy if isinstance(strategy, DistributedStrategy) \
+        else _FLEET['strategy'] or DistributedStrategy()
+    optimizer = mo.select_optimizer(optimizer, s)
+
+    # one strategy object governs BOTH the step build and the shardings —
+    # deriving them from different objects caused pytree mismatches
+    sdict = _strategy_dict(s)
+    gm_k = sdict['gradient_merge_k']
+    wants_explicit = s.localsgd or s.adaptive_localsgd or s.dgc or \
+        s.fp16_allreduce
+    if wants_explicit:
+        pure_dp = hcg.mesh.size == hcg.get_data_parallel_world_size()
+        if not pure_dp:
+            raise ValueError(
+                'localsgd/dgc/fp16_allreduce run on a pure data-parallel '
+                'mesh (mp/pp/sharding degree 1); got %s' % (hcg.mesh,))
+        adaptive = False
+        if s.localsgd or s.adaptive_localsgd:
+            mode = 'local'
+            if s.adaptive_localsgd:
+                adaptive = True
+                k = s.adaptive_localsgd_configs.get('init_k_steps', 1)
+            else:
+                k = s.localsgd_configs.get('k_steps', 1)
+        elif s.dgc:
+            mode = 'dgc'
+            k = 1
+        else:
+            mode = 'fp16'
+            k = 1
+        from jax.sharding import Mesh as _Mesh
+        import numpy as _np
+        dp_mesh = _Mesh(_np.asarray(hcg.mesh.devices).reshape(-1), ('dp',))
+        return mo.ShardMapDPStep(
+            model, loss_fn, optimizer, mesh=dp_mesh, axis='dp', mode=mode,
+            k_steps=k, gm_k_steps=gm_k, adaptive=adaptive,
+            momentum=s.dgc_configs.get('momentum', 0.9),
+            sparsity=s.dgc_configs.get('sparsity', 0.999),
+            rampup_begin_step=s.dgc_configs.get('rampup_begin_step', 0),
+            rampup_step=s.dgc_configs.get('rampup_step', 1))
+
     cfg = strategy_mod.build_shardings(model, optimizer, hcg.mesh, sdict)
     strategy_mod.place_params(model, cfg['param_shardings'])
     strategy_mod.place_opt_slots(model, optimizer, cfg['out_shardings'][2])
@@ -160,7 +208,10 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
         model, loss_fn, optimizer,
         out_shardings=cfg['out_shardings'],
         mesh=hcg.mesh,
-        batch_sharding=cfg['batch_sharding'])
+        batch_sharding=cfg['batch_sharding'],
+        k_steps=gm_k,
+        grad_merge_avg=s.gradient_merge_configs.get('avg', True)
+        if s.gradient_merge else True)
     return step
 
 

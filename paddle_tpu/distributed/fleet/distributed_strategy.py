@@ -51,9 +51,13 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = _Cfg(lars_coeff=0.001, lars_weight_decay=0.0005)
         self.dgc = False
+        self.dgc_configs = _Cfg(rampup_begin_step=0, rampup_step=1,
+                                sparsity=0.999, momentum=0.9)
         self.localsgd = False
         self.localsgd_configs = _Cfg(k_steps=1)
         self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = _Cfg(init_k_steps=1,
+                                              begin_step=1)
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.fuse_grad_size_in_MB = 32

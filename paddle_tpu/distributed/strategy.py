@@ -88,6 +88,11 @@ def build_shardings(model, optimizer, mesh, strategy=None):
         slots_shardings[name] = {k: slot_sharding_for(name, v)
                                  for k, v in slot.items()}
     opt_shardings = {'slots': slots_shardings, 'step': replicated}
+    if strategy.get('gradient_merge_k', 1) > 1:
+        # TrainStep's opt_state grows accumulators under gradient merge
+        opt_shardings['acc'] = {name: param_shardings[name]
+                                for name in pmap_t}
+        opt_shardings['micro'] = replicated
 
     batch_axes = ['dp']
     if 'sharding' in mesh.axis_names and mesh.shape.get('sharding', 1) > 1:

@@ -128,7 +128,8 @@ class TrainStep:
 
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  in_shardings=None, out_shardings=None, mesh=None,
-                 batch_sharding=None, grad_sync=None):
+                 batch_sharding=None, grad_sync=None, k_steps=1,
+                 grad_merge_avg=True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -139,6 +140,10 @@ class TrainStep:
         self._batch_sharding = batch_sharding
         self._grad_sync = grad_sync
         self._donate = donate
+        # gradient merge (reference GradientMergeOptimizer): accumulate
+        # k_steps micro-batch grads, apply the optimizer on the k-th
+        self._k_steps = int(k_steps)
+        self._grad_merge_avg = grad_merge_avg
         self._param_names = list(extract_params(model).keys())
         self._trainable = {name: not p.stop_gradient
                            for name, p in model.named_parameters()}
@@ -151,7 +156,16 @@ class TrainStep:
         for name in self._param_names:
             if self._trainable[name]:
                 slots[name] = dict(opt._get_slots(pmap[name]))
-        return {'slots': slots, 'step': jnp.asarray(opt._step_count, jnp.int32)}
+        state = {'slots': slots,
+                 'step': jnp.asarray(opt._step_count, jnp.int32)}
+        if self._k_steps > 1:
+            acc = getattr(self, '_gm_acc', None)
+            state['acc'] = acc if acc is not None else {
+                name: jnp.zeros_like(pmap[name]._data)
+                for name in slots}
+            state['micro'] = getattr(
+                self, '_gm_micro', jnp.zeros((), jnp.int32))
+        return state
 
     def _write_opt_state(self, state):
         opt = self.optimizer
@@ -159,6 +173,9 @@ class TrainStep:
         for name, s in state['slots'].items():
             opt._slots[id(pmap[name])] = dict(s)
         opt._step_count = int(state['step'])
+        if self._k_steps > 1:
+            self._gm_acc = state['acc']
+            self._gm_micro = state['micro']
 
     # -- the pure step ------------------------------------------------------
     def _build(self, sample_batch):
@@ -196,37 +213,71 @@ class TrainStep:
             # mirror Optimizer.step()'s full semantics in pure form:
             # grad clip -> (coupled) weight decay / regularizer ->
             # per-param lr -> update rule -> decoupled decay (AdamW)
-            if opt._grad_clip is not None:
-                names = list(grads.keys())
-                pg = [(pmeta[n], Tensor(grads[n])) for n in names]
-                clipped = opt._grad_clip(pg)
-                grads = {n: (g._data if isinstance(g, Tensor) else g)
-                         for n, (_, g) in zip(names, clipped)}
-            coeff = opt._decay_coeff()
-            decoupled = opt._apply_decoupled_decay()
-            decay_fun = getattr(opt, '_apply_decay_param_fun', None)
+            def apply_updates(gdict):
+                if opt._grad_clip is not None:
+                    names = list(gdict.keys())
+                    pg = [(pmeta[n], Tensor(gdict[n])) for n in names]
+                    clipped = opt._grad_clip(pg)
+                    gdict = {n: (g._data if isinstance(g, Tensor) else g)
+                             for n, (_, g) in zip(names, clipped)}
+                coeff = opt._decay_coeff()
+                decoupled = opt._apply_decoupled_decay()
+                decay_fun = getattr(opt, '_apply_decay_param_fun', None)
+                t = opt_state['step'] + 1
+                new_slots = {}
+                new_params = dict(params)
+                for name, g in gdict.items():
+                    p = params[name]
+                    g = g.astype(p.dtype)
+                    meta = pmeta[name]
+                    if coeff and not decoupled:
+                        g = g + coeff * p
+                    if meta.regularizer is not None:
+                        g = meta.regularizer._append(g, p)
+                    plr = lr * meta.optimize_attr.get('learning_rate', 1.0)
+                    if coeff and decoupled and \
+                            (decay_fun is None or decay_fun(meta.name)):
+                        p = p * (1.0 - plr * coeff)
+                    opt._apply_param_name = meta.name
+                    new_p, slots = opt._apply(p, g,
+                                              opt_state['slots'][name],
+                                              plr, t)
+                    new_params[name] = new_p
+                    new_slots[name] = slots
+                return new_params, new_slots, t
 
-            t = opt_state['step'] + 1
-            new_slots = {}
-            new_params = dict(params)
-            for name, g in grads.items():
-                p = params[name]
-                g = g.astype(p.dtype)
-                meta = pmeta[name]
-                if coeff and not decoupled:
-                    g = g + coeff * p
-                if meta.regularizer is not None:
-                    g = meta.regularizer._append(g, p)
-                plr = lr * meta.optimize_attr.get('learning_rate', 1.0)
-                if coeff and decoupled and \
-                        (decay_fun is None or decay_fun(meta.name)):
-                    p = p * (1.0 - plr * coeff)
-                new_p, slots = opt._apply(p, g, opt_state['slots'][name],
-                                          plr, t)
-                new_params[name] = new_p
-                new_slots[name] = slots
+            K = self._k_steps
+            if K == 1:
+                new_params, new_slots, t = apply_updates(grads)
+                return new_params, new_buffers, \
+                    {'slots': new_slots, 'step': t}, loss
+
+            # gradient merge: accumulate raw grads; clip/decay/update run
+            # only on the k-th micro step (lax.cond keeps one XLA program)
+            micro = opt_state['micro'] + 1
+            new_acc = {n: opt_state['acc'][n] + grads[n].astype(
+                opt_state['acc'][n].dtype) for n in grads}
+
+            def do_apply(_):
+                scale = 1.0 / K if self._grad_merge_avg else 1.0
+                eff = {n: (a * scale).astype(params[n].dtype)
+                       for n, a in new_acc.items()}
+                np_, ns_, t_ = apply_updates(eff)
+                return (np_, ns_, t_,
+                        {n: jnp.zeros_like(a) for n, a in new_acc.items()},
+                        jnp.zeros((), jnp.int32))
+
+            def skip(_):
+                return (dict(params),
+                        {n: dict(opt_state['slots'][n])
+                         for n in new_acc},
+                        opt_state['step'], new_acc, micro)
+
+            new_params, new_slots, t, acc_out, micro_out = jax.lax.cond(
+                micro >= K, do_apply, skip, None)
             return new_params, new_buffers, \
-                {'slots': new_slots, 'step': t}, loss
+                {'slots': new_slots, 'step': t, 'acc': acc_out,
+                 'micro': micro_out}, loss
 
         jit_kwargs = {}
         if self._donate:

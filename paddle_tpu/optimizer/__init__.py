@@ -1,3 +1,3 @@
 from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
-                         Adagrad, Adadelta, RMSProp, Lamb)
+                         Adagrad, Adadelta, RMSProp, Lamb, LarsMomentum)
 from . import lr  # noqa: F401

@@ -13,7 +13,7 @@ from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
 __all__ = ['Optimizer', 'SGD', 'Momentum', 'Adam', 'AdamW', 'Adamax',
-           'Adagrad', 'Adadelta', 'RMSProp', 'Lamb']
+           'Adagrad', 'Adadelta', 'RMSProp', 'Lamb', 'LarsMomentum']
 
 
 class Optimizer:
@@ -92,6 +92,9 @@ class Optimizer:
                 garr = p.regularizer._append(garr, p._data)
             plr = lr * p.optimize_attr.get('learning_rate', 1.0)
             slots = self._get_slots(p)
+            # name hint for rules with per-param behavior (e.g. LARS
+            # weight-decay exclusion); static at jit trace time
+            self._apply_param_name = getattr(p, 'name', None)
             new_p, new_slots = self._apply(p._data, garr, slots, plr,
                                            self._step_count)
             if coeff and self._apply_decoupled_decay() and \
@@ -337,3 +340,45 @@ class Lamb(Optimizer):
         u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         return p - lr * trust * update, {'moment1': m, 'moment2': v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (layer-wise adaptive rate scaling) momentum.
+
+    Parity: paddle/fluid/operators/optimizers/lars_momentum_op.cc +
+    fleet meta_optimizers/lars_optimizer.py. local_lr scales the update by
+    ||w|| / (||g|| + wd*||w||) per layer for large-batch stability.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+
+    def _init_slots(self, p):
+        return {'velocity': jnp.zeros_like(p._data)}
+
+    def _excluded(self):
+        name = getattr(self, '_apply_param_name', None) or ''
+        return any(tok in name for tok in self._exclude)
+
+    def _apply(self, p, g, slots, lr, t):
+        if self._excluded():
+            # excluded params (bn scales, biases): plain momentum, no
+            # LARS scaling or weight decay (reference lars_momentum_op)
+            v = self._momentum * slots['velocity'] + lr * g
+            return p - v, {'velocity': v}
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        wd = self._lars_wd
+        denom = g_norm + wd * w_norm + self._epsilon
+        local_lr = jnp.where(
+            (w_norm > 0) & (denom > 0),
+            lr * self._lars_coeff * w_norm / jnp.maximum(denom, 1e-30), lr)
+        v = self._momentum * slots['velocity'] + local_lr * (g + wd * p)
+        return p - v, {'velocity': v}

@@ -5,3 +5,8 @@ see embedding_service.py (in-proc + grpc-less socket RPC) and runtime.py
 from . import runtime  # noqa: F401
 from .embedding_service import (EmbeddingTable, EmbeddingServer,  # noqa: F401
                                 EmbeddingClient)
+from .tables import (DenseTable, BarrierTable, TensorTable,  # noqa: F401
+                     GeoSparseTable, SsdSparseTable)
+from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
+                           HalfAsyncCommunicator, SyncCommunicator,
+                           GeoCommunicator)

@@ -151,23 +151,56 @@ class _Handler(socketserver.BaseRequestHandler):
                 msg = _recv_msg(self.request)
             except (ConnectionError, OSError):
                 return
-            op = msg['op']
-            if op == 'pull':
-                out = server.table(msg['table']).pull(msg['ids'])
-                _send_msg(self.request, out)
-            elif op == 'push':
-                server.table(msg['table']).push(msg['ids'], msg['grads'])
-                _send_msg(self.request, b'ok')
-            elif op == 'save':
-                server.table(msg['table']).save(msg['path'])
-                _send_msg(self.request, b'ok')
-            elif op == 'load':
-                server.table(msg['table']).load(msg['path'])
-                _send_msg(self.request, b'ok')
-            elif op == 'stop':
-                _send_msg(self.request, b'ok')
-                self.server.shutdown()
-                return
+            try:
+                op = msg['op']
+                if op == 'pull':
+                    out = server.table(msg['table']).pull(msg['ids'])
+                    _send_msg(self.request, out)
+                elif op == 'push':
+                    server.table(msg['table']).push(msg['ids'],
+                                                    msg['grads'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'push_delta':
+                    server.table(msg['table']).push_delta(msg['ids'],
+                                                          msg['deltas'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'pull_dense':
+                    _send_msg(self.request,
+                              server.table(msg['table']).pull())
+                elif op == 'push_dense':
+                    server.table(msg['table']).push(msg['grad'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'set_dense':
+                    server.table(msg['table']).set(msg['value'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'barrier':
+                    server.table(msg['table']).barrier(
+                        msg.get('worker_id'), msg.get('timeout', 60.0))
+                    _send_msg(self.request, b'ok')
+                elif op == 'tensor':
+                    if msg['method'] not in ('set', 'get', 'increment'):
+                        raise ValueError('bad tensor method %r'
+                                         % msg['method'])
+                    tt = server.table(msg['table'])
+                    method = getattr(tt, msg['method'])
+                    _send_msg(self.request, method(*msg.get('args', ())))
+                elif op == 'save':
+                    server.table(msg['table']).save(msg['path'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'load':
+                    server.table(msg['table']).load(msg['path'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'stop':
+                    _send_msg(self.request, b'ok')
+                    self.server.shutdown()
+                    return
+                else:
+                    _send_msg(self.request, {'error': 'unknown op %r' % op})
+            except Exception as e:  # report instead of killing the server
+                try:
+                    _send_msg(self.request, {'error': repr(e)})
+                except OSError:
+                    return
 
 
 class EmbeddingServer:
@@ -182,8 +215,24 @@ class EmbeddingServer:
         self.port = self._srv.server_address[1]
         self._thread = None
 
-    def create_table(self, table_id, dim, **kwargs):
-        self._tables[table_id] = EmbeddingTable(dim, **kwargs)
+    def create_table(self, table_id, dim, table_class=None, **kwargs):
+        cls = table_class or EmbeddingTable
+        self._tables[table_id] = cls(dim, **kwargs)
+        return self._tables[table_id]
+
+    def create_dense_table(self, table_id, shape, **kwargs):
+        from .tables import DenseTable
+        self._tables[table_id] = DenseTable(shape, **kwargs)
+        return self._tables[table_id]
+
+    def create_barrier_table(self, table_id, trigger_count):
+        from .tables import BarrierTable
+        self._tables[table_id] = BarrierTable(trigger_count)
+        return self._tables[table_id]
+
+    def create_tensor_table(self, table_id):
+        from .tables import TensorTable
+        self._tables[table_id] = TensorTable()
         return self._tables[table_id]
 
     def table(self, table_id):
@@ -209,6 +258,7 @@ class EmbeddingClient:
     def __init__(self, endpoints=None, servers=None):
         self._local = servers  # in-proc mode: list of EmbeddingServer
         self._socks = None
+        self._endpoints = endpoints
         if endpoints and not servers:
             self._socks = []
             for ep in endpoints:
@@ -216,12 +266,37 @@ class EmbeddingClient:
                 s = socket.create_connection((host, int(port)))
                 self._socks.append(s)
         self._n = len(servers or endpoints)
-        self._lock = threading.Lock()
+        # one lock per server connection: a slow op against one shard
+        # must not serialize traffic to the others
+        self._locks = [threading.Lock() for _ in range(self._n)]
 
     def _shard(self, ids):
         ids = np.asarray(ids, np.int64)
         shard_idx = ids % self._n
         return ids, shard_idx
+
+    def _call(self, s, msg):
+        """Remote call to server s with error propagation."""
+        with self._locks[s]:
+            _send_msg(self._socks[s], msg)
+            out = _recv_msg(self._socks[s])
+        if isinstance(out, dict) and 'error' in out:
+            raise RuntimeError(out['error'])
+        return out
+
+    def _call_fresh(self, s, msg):
+        """Blocking RPC (e.g. barrier) over a NEW ephemeral connection so
+        the persistent per-server socket stays free for fast ops."""
+        host, port = self._endpoints[s].rsplit(':', 1)
+        sock = socket.create_connection((host, int(port)))
+        try:
+            _send_msg(sock, msg)
+            out = _recv_msg(sock)
+        finally:
+            sock.close()
+        if isinstance(out, dict) and 'error' in out:
+            raise RuntimeError(out['error'])
+        return out
 
     def pull(self, table_id, ids):
         ids, shard_idx = self._shard(ids)
@@ -234,11 +309,8 @@ class EmbeddingClient:
             if self._local is not None:
                 rows = self._local[s].table(table_id).pull(sub.tolist())
             else:
-                with self._lock:
-                    _send_msg(self._socks[s], {'op': 'pull',
-                                               'table': table_id,
-                                               'ids': sub.tolist()})
-                    rows = _recv_msg(self._socks[s])
+                rows = self._call(s, {'op': 'pull', 'table': table_id,
+                                      'ids': sub.tolist()})
             out[mask] = rows
         return out
 
@@ -253,22 +325,73 @@ class EmbeddingClient:
                 self._local[s].table(table_id).push(ids[mask].tolist(),
                                                     grads[mask])
             else:
-                with self._lock:
-                    _send_msg(self._socks[s], {'op': 'push',
-                                               'table': table_id,
-                                               'ids': ids[mask].tolist(),
-                                               'grads': grads[mask]})
-                    _recv_msg(self._socks[s])
+                self._call(s, {'op': 'push', 'table': table_id,
+                               'ids': ids[mask].tolist(),
+                               'grads': grads[mask]})
 
     def _dim(self, table_id):
         if self._local is not None:
             return self._local[0].table(table_id).dim
         # remote: pull a probe row
-        with self._lock:
-            _send_msg(self._socks[0], {'op': 'pull', 'table': table_id,
-                                       'ids': [0]})
-            row = _recv_msg(self._socks[0])
+        row = self._call(0, {'op': 'pull', 'table': table_id, 'ids': [0]})
         return row.shape[1]
+
+    def push_delta(self, table_id, ids, deltas):
+        """Geo-SGD path: add parameter deltas on the server."""
+        ids, shard_idx = self._shard(ids)
+        deltas = np.asarray(deltas, np.float32)
+        for s in range(self._n):
+            mask = shard_idx == s
+            if not mask.any():
+                continue
+            if self._local is not None:
+                self._local[s].table(table_id).push_delta(
+                    ids[mask].tolist(), deltas[mask])
+            else:
+                self._call(s, {'op': 'push_delta', 'table': table_id,
+                               'ids': ids[mask].tolist(),
+                               'deltas': deltas[mask]})
+
+    # -- dense / barrier / tensor tables (placed by table_id % n) -----------
+    def _owner(self, table_id):
+        return int(table_id) % self._n
+
+    def pull_dense(self, table_id):
+        s = self._owner(table_id)
+        if self._local is not None:
+            return self._local[s].table(table_id).pull()
+        return self._call(s, {'op': 'pull_dense', 'table': table_id})
+
+    def push_dense(self, table_id, grad):
+        s = self._owner(table_id)
+        if self._local is not None:
+            return self._local[s].table(table_id).push(grad)
+        self._call(s, {'op': 'push_dense', 'table': table_id,
+                       'grad': np.asarray(grad, np.float32)})
+
+    def set_dense(self, table_id, value):
+        s = self._owner(table_id)
+        if self._local is not None:
+            return self._local[s].table(table_id).set(value)
+        self._call(s, {'op': 'set_dense', 'table': table_id,
+                       'value': np.asarray(value, np.float32)})
+
+    def barrier(self, table_id, worker_id=None, timeout=60.0):
+        s = self._owner(table_id)
+        if self._local is not None:
+            return self._local[s].table(table_id).barrier(worker_id,
+                                                          timeout)
+        # ephemeral connection: a blocking barrier must not pin the shared
+        # per-server socket (other threads' pulls/pushes keep flowing)
+        self._call_fresh(s, {'op': 'barrier', 'table': table_id,
+                             'worker_id': worker_id, 'timeout': timeout})
+
+    def tensor(self, table_id, method, *args):
+        s = self._owner(table_id)
+        if self._local is not None:
+            return getattr(self._local[s].table(table_id), method)(*args)
+        return self._call(s, {'op': 'tensor', 'table': table_id,
+                              'method': method, 'args': args})
 
     def save(self, table_id, path):
         for s in range(self._n):
@@ -276,7 +399,4 @@ class EmbeddingClient:
             if self._local is not None:
                 self._local[s].table(table_id).save(p)
             else:
-                with self._lock:
-                    _send_msg(self._socks[s], {'op': 'save',
-                                               'table': table_id, 'path': p})
-                    _recv_msg(self._socks[s])
+                self._call(s, {'op': 'save', 'table': table_id, 'path': p})

@@ -1,0 +1,252 @@
+"""PS table + communicator tests (reference pattern:
+distributed/test/brpc_service_dense_sgd_test.cc, sparse_table_test.cc,
+barrier_table_test.cc — real server+client in one process)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (AsyncCommunicator, BarrierTable,
+                                       Communicator, DenseTable,
+                                       EmbeddingClient, EmbeddingServer,
+                                       GeoCommunicator, GeoSparseTable,
+                                       SsdSparseTable, SyncCommunicator,
+                                       TensorTable)
+from paddle_tpu.distributed.ps.communicator import _merge_by_id
+
+
+def test_dense_table_sgd_and_adam():
+    t = DenseTable((4,), optimizer='sgd', lr=0.1)
+    t.set(np.ones(4, np.float32))
+    t.push(np.full(4, 2.0, np.float32))
+    np.testing.assert_allclose(t.pull(), 0.8 * np.ones(4))
+
+    ta = DenseTable((2,), optimizer='adam', lr=0.01)
+    v0 = ta.pull()
+    for _ in range(3):
+        ta.push(np.ones(2, np.float32))
+    assert np.all(ta.pull() < v0)
+
+
+def test_barrier_table_blocks_until_full():
+    bt = BarrierTable(3)
+    arrived = []
+
+    def worker(i):
+        bt.barrier(i, timeout=5.0)
+        arrived.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    assert arrived == []          # 2 of 3: still blocked
+    bt.barrier(2, timeout=5.0)    # third arrival releases everyone
+    for t in threads:
+        t.join(timeout=5)
+    assert sorted(arrived) == [0, 1]
+    # reusable: next round works
+    round2 = [threading.Thread(target=worker, args=(i,)) for i in (7, 9)]
+    for t in round2:
+        t.start()
+    bt.barrier(8, timeout=5.0)
+    for t in round2:
+        t.join(timeout=5)
+    assert 7 in arrived and 9 in arrived
+
+
+def test_barrier_timeout():
+    bt = BarrierTable(2)
+    with pytest.raises(TimeoutError):
+        bt.barrier(0, timeout=0.2)
+
+
+def test_tensor_table():
+    tt = TensorTable()
+    tt.set('step', 0.0)
+    assert tt.increment('step', 1.0) == 1.0
+    assert tt.increment('step', 2.0) == 3.0
+    np.testing.assert_allclose(tt.get('step'), 3.0)
+    assert tt.get('missing') is None
+
+
+def test_geo_sparse_table_delta_semantics():
+    t = GeoSparseTable(4, initializer='zeros')
+    base = t.pull([1, 2])
+    t.push_delta([1], np.full((1, 4), 0.5, np.float32))
+    t.push_delta([1], np.full((1, 4), 0.25, np.float32))
+    out = t.pull([1])
+    np.testing.assert_allclose(out[0], base[0] + 0.75)
+
+
+def test_ssd_sparse_table_spills_and_promotes():
+    t = SsdSparseTable(8, max_mem_rows=10, initializer='uniform',
+                       optimizer='adagrad', lr=0.1, seed=1)
+    ids = list(range(25))
+    first = t.pull(ids)
+    assert t.mem_rows() <= 10
+    assert t.disk_rows() >= 15
+    assert len(t) == 25
+    # promoted rows keep their values
+    again = t.pull(ids[:5])
+    np.testing.assert_allclose(again, first[:5])
+    # push on a spilled row: promote, apply optimizer, value changes
+    g = np.ones((1, 8), np.float32)
+    before = t.pull([7]).copy()
+    t.push([7], g)
+    after = t.pull([7])
+    assert not np.allclose(before, after)
+    # optimizer slots survived the spill round trip: a second identical
+    # push with adagrad must move LESS than the first
+    d1 = np.abs(after - before).mean()
+    t.push([7], g)
+    final = t.pull([7])
+    d2 = np.abs(final - after).mean()
+    assert d2 < d1
+
+
+def test_merge_by_id():
+    ids = [3, 1, 3, 2, 1]
+    grads = np.ones((5, 2), np.float32)
+    uniq, merged = _merge_by_id(ids, grads)
+    np.testing.assert_array_equal(uniq, [1, 2, 3])
+    np.testing.assert_allclose(merged, [[2, 2], [1, 1], [2, 2]])
+
+
+def _local_cluster(dim=4, optimizer='sgd', lr=0.1, table_class=None):
+    servers = [EmbeddingServer() for _ in range(2)]
+    for s in servers:
+        s.create_table(0, dim, table_class=table_class,
+                       initializer='zeros', optimizer=optimizer, lr=lr)
+    client = EmbeddingClient(servers=servers)
+    return servers, client
+
+
+def test_sync_communicator_immediate():
+    servers, client = _local_cluster()
+    comm = SyncCommunicator(client)
+    comm.start()          # no-op in sync mode
+    rows0 = client.pull(0, [1, 2, 3])
+    comm.push_sparse_grad(0, [1, 1, 2], np.ones((3, 4), np.float32))
+    rows = client.pull(0, [1, 2, 3])
+    # sgd lr=0.1: id1 got merged grad 2 -> -0.2; id2 grad 1 -> -0.1
+    np.testing.assert_allclose(rows[0], rows0[0] - 0.2)
+    np.testing.assert_allclose(rows[1], rows0[1] - 0.1)
+    np.testing.assert_allclose(rows[2], rows0[2])
+
+
+def test_async_communicator_background_merge():
+    servers, client = _local_cluster()
+    comm = AsyncCommunicator(client, merge_size=4)
+    comm.start()
+    client.pull(0, [5])
+    for _ in range(8):
+        comm.push_sparse_grad(0, [5], np.ones((1, 4), np.float32))
+    comm.flush()
+    rows = client.pull(0, [5])
+    np.testing.assert_allclose(rows[0], -0.1 * 8 * np.ones(4), rtol=1e-5)
+    comm.stop()
+    assert not comm.is_running
+
+
+def test_geo_communicator_batches_deltas():
+    from paddle_tpu.distributed.ps.tables import GeoSparseTable
+    servers, client = _local_cluster(table_class=GeoSparseTable)
+    comm = GeoCommunicator(client, geo_need_push_nums=4)
+    base = client.pull(0, [1, 2])
+    comm.push_sparse_param(0, [1], np.full((1, 4), 0.5, np.float32))
+    comm.push_sparse_param(0, [2], np.full((1, 4), 0.5, np.float32))
+    # threshold (4) not reached: server unchanged
+    np.testing.assert_allclose(client.pull(0, [1, 2]), base)
+    comm.push_sparse_param(0, [1, 2], np.full((2, 4), 0.5, np.float32))
+    # 4 accumulated rows -> flushed: each id got 2 deltas of 0.5
+    np.testing.assert_allclose(client.pull(0, [1, 2]), base + 1.0)
+
+
+def test_remote_dense_barrier_tensor_ops():
+    servers = [EmbeddingServer() for _ in range(2)]
+    for s in servers:
+        s.start()
+    try:
+        servers[0].create_dense_table(0, (3,), optimizer='sgd', lr=0.5)
+        servers[1].create_tensor_table(1)
+        servers[0].create_barrier_table(2, trigger_count=2)
+        eps = ['127.0.0.1:%d' % s.port for s in servers]
+        c1 = EmbeddingClient(endpoints=eps)
+        c2 = EmbeddingClient(endpoints=eps)
+
+        c1.set_dense(0, np.asarray([1.0, 2.0, 3.0]))
+        c1.push_dense(0, np.ones(3, np.float32))
+        np.testing.assert_allclose(c2.pull_dense(0), [0.5, 1.5, 2.5])
+
+        c1.tensor(1, 'set', 'epoch', 5.0)
+        np.testing.assert_allclose(c2.tensor(1, 'increment', 'epoch', 1.0),
+                                   6.0)
+
+        # remote barrier across two clients
+        done = []
+
+        def wait():
+            c2.barrier(2, worker_id=1, timeout=5.0)
+            done.append(1)
+        th = threading.Thread(target=wait)
+        th.start()
+        time.sleep(0.1)
+        assert done == []
+        c1.barrier(2, worker_id=0, timeout=5.0)
+        th.join(timeout=5)
+        assert done == [1]
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_ssd_table_save_load_includes_cold_tier(tmp_path):
+    t = SsdSparseTable(4, max_mem_rows=5, initializer='uniform', seed=2)
+    ids = list(range(12))
+    orig = t.pull(ids).copy()
+    p = str(tmp_path / 'ssd_shard')
+    t.save(p)
+    t2 = SsdSparseTable(4, max_mem_rows=5, initializer='zeros', seed=3)
+    t2.load(p)
+    assert len(t2) == 12
+    np.testing.assert_allclose(t2.pull(ids), orig)
+
+
+def test_barrier_timeout_withdraws_arrival():
+    bt = BarrierTable(2)
+    with pytest.raises(TimeoutError):
+        bt.barrier(0, timeout=0.2)
+    # the failed arrival must NOT count toward the next round
+    with pytest.raises(TimeoutError):
+        bt.barrier(1, timeout=0.2)
+
+
+def test_remote_error_reply_and_concurrent_barrier():
+    server = EmbeddingServer()
+    server.create_table(0, 4, initializer='zeros')
+    server.create_barrier_table(9, trigger_count=2)
+    server.start()
+    try:
+        eps = ['127.0.0.1:%d' % server.port]
+        c = EmbeddingClient(endpoints=eps)
+        with pytest.raises(RuntimeError):
+            c.pull_dense(42)  # no such table: server must reply, not die
+        # connection still usable after the error
+        assert c.pull(0, [1]).shape == (1, 4)
+        # a blocking barrier on this client must not stall its pulls
+        done = []
+
+        def wait():
+            c.barrier(9, timeout=5.0)
+            done.append(1)
+        th = threading.Thread(target=wait)
+        th.start()
+        time.sleep(0.2)
+        assert c.pull(0, [2]).shape == (1, 4)  # not blocked by barrier
+        c.barrier(9, timeout=5.0)              # second arrival releases
+        th.join(timeout=5)
+        assert done == [1]
+    finally:
+        server.stop()

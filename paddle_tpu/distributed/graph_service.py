@@ -44,6 +44,9 @@ class _GraphHandler(socketserver.BaseRequestHandler):
                 elif op == 'add_nodes':
                     store.add_nodes(msg['ids'])
                     _send_msg(self.request, b'ok')
+                elif op == 'remove_nodes':
+                    _send_msg(self.request,
+                              store.remove_nodes(msg['ids']))
                 elif op == 'load_edge_file':
                     n = store.load_edge_file(msg['path'],
                                              msg.get('reversed', False))
@@ -132,6 +135,17 @@ class GraphPyClient:
             if len(sub):
                 self._call(s, {'op': 'add_nodes', 'etype': etype,
                                'ids': sub.tolist()})
+
+    def remove_graph_node(self, etype, ids):
+        ids, shard = self._shard(ids)
+        removed = 0
+        for s in range(self._n):
+            sub = ids[shard == s]
+            if len(sub):
+                removed += self._call(s, {'op': 'remove_nodes',
+                                          'etype': etype,
+                                          'ids': sub.tolist()})
+        return removed
 
     def add_edges(self, etype, src, dst, weight=None):
         src, shard = self._shard(src)

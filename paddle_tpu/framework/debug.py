@@ -1,0 +1,36 @@
+"""Numerical debugging utilities.
+
+Reference: FLAGS_check_nan_inf + framework/details/nan_inf_utils_detail.cc
+(per-op output scanning naming the offending var). TPU-native: the flag
+maps to jax_debug_nans (framework/flags.py); check_numerics gives the
+explicit per-tensor check for user code and tests.
+"""
+import jax.numpy as jnp
+
+from .core import Tensor
+
+__all__ = ['check_numerics', 'enable_check_nan_inf',
+           'disable_check_nan_inf']
+
+
+def check_numerics(x, name='tensor'):
+    """Raise FloatingPointError if x contains NaN/Inf; returns x so it can
+    be inserted inline in eager code."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n_nan = int(jnp.isnan(arr).sum())
+    n_inf = int(jnp.isinf(arr).sum())
+    if n_nan or n_inf:
+        raise FloatingPointError(
+            '%s contains %d NaN and %d Inf values (shape %s, dtype %s)'
+            % (name, n_nan, n_inf, tuple(arr.shape), arr.dtype))
+    return x
+
+
+def enable_check_nan_inf():
+    from . import flags
+    flags.set_flags({'FLAGS_check_nan_inf': True})
+
+
+def disable_check_nan_inf():
+    from . import flags
+    flags.set_flags({'FLAGS_check_nan_inf': False})

@@ -138,6 +138,23 @@ int64_t gs_add_nodes(void* h, const int64_t* ids, int64_t n) {
   return n;
 }
 
+// erase nodes and their outgoing edges (reference remove_graph_node)
+int64_t gs_remove_nodes(void* h, const int64_t* ids, int64_t n) {
+  auto* gs = static_cast<GraphStore*>(h);
+  int64_t removed = 0;
+  for (int64_t i = 0; i < n; i++) {
+    Shard& sh = gs->shard_of(ids[i]);
+    std::lock_guard<std::mutex> lk(sh.mu);
+    auto it = sh.nodes.find(ids[i]);
+    if (it != sh.nodes.end()) {
+      gs->edge_count -= (int64_t)it->second.nbrs.size();
+      sh.nodes.erase(it);
+      removed++;
+    }
+  }
+  return removed;
+}
+
 // text file: "src \t dst [\t weight]" per line (reference load_edges format)
 int64_t gs_load_edge_file(void* h, const char* path, int reversed) {
   FILE* f = fopen(path, "r");

@@ -27,6 +27,9 @@ class _NativeStore:
                                      ctypes.c_int64]
         lib.gs_add_nodes.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_int64]
+        lib.gs_remove_nodes.restype = ctypes.c_int64
+        lib.gs_remove_nodes.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_int64]
         lib.gs_load_edge_file.restype = ctypes.c_int64
         lib.gs_load_edge_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                           ctypes.c_int]
@@ -80,6 +83,11 @@ class _NativeStore:
     def add_nodes(self, ids):
         ids = self._i64(ids)
         return self._lib.gs_add_nodes(self._h, ids.ctypes.data, len(ids))
+
+    def remove_nodes(self, ids):
+        ids = self._i64(ids)
+        return self._lib.gs_remove_nodes(self._h, ids.ctypes.data,
+                                         len(ids))
 
     def load_edge_file(self, path, reversed=False):
         return self._lib.gs_load_edge_file(self._h, path.encode(),
@@ -165,6 +173,19 @@ class _PythonStore:
         for i in ids:
             self._nbrs.setdefault(int(i), [])
         return len(ids)
+
+    def remove_nodes(self, ids):
+        removed = 0
+        for i in ids:
+            k = int(i)
+            # a node may exist with only a feature (set_node_feat creates
+            # it in the native store) — treat either presence as a node
+            if k in self._nbrs or k in self._feat:
+                self._nbrs.pop(k, None)
+                self._weights.pop(k, None)
+                self._feat.pop(k, None)
+                removed += 1
+        return removed
 
     def load_edge_file(self, path, reversed=False):
         n = 0

@@ -11,7 +11,8 @@ import time
 import jax
 
 __all__ = ['RecordEvent', 'profiler', 'start_profiler', 'stop_profiler',
-           'Profiler', 'ProfilerTarget', 'ProfilerState']
+           'Profiler', 'ProfilerTarget', 'ProfilerState',
+           'export_chrome_tracing', 'load_profiler_result']
 
 
 class RecordEvent:
@@ -83,6 +84,7 @@ class Profiler:
                  log_dir='/tmp/paddle_tpu_profile'):
         self.log_dir = log_dir
         self.timer_only = timer_only
+        self._on_trace_ready = on_trace_ready
         self._times = []
         self._t0 = None
 
@@ -97,6 +99,10 @@ class Profiler:
     def start(self):
         self._t0 = time.time()
         if not self.timer_only:
+            # the handler may redirect log_dir (export_chrome_tracing),
+            # so it must run BEFORE the trace starts
+            if self._on_trace_ready is not None:
+                self._on_trace_ready(self)
             jax.profiler.start_trace(self.log_dir)
 
     def stop(self):
@@ -117,3 +123,26 @@ class Profiler:
 
     def summary(self, **kwargs):
         print(self.step_info())
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Reference tools/timeline.py output parity: jax traces are XPlane
+    protos consumable by TensorBoard/Perfetto; this returns an
+    on_trace_ready callback that redirects the profiler's output dir.
+    The Profiler invokes it at start(), before tracing begins, so the
+    trace files land under `dir_name` when the profiler stops."""
+    def handler(prof):
+        prof.log_dir = dir_name
+    return handler
+
+
+def load_profiler_result(path):
+    """List the trace artifacts produced under `path` (xplane.pb /
+    trace.json.gz per host), for tooling that post-processes traces."""
+    import os
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f.endswith(('.xplane.pb', '.trace.json.gz', '.json')):
+                out.append(os.path.join(root, f))
+    return sorted(out)

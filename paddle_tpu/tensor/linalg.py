@@ -12,6 +12,7 @@ __all__ = [
     'eigvals', 'eigvalsh', 'solve', 'triangular_solve', 'cholesky_solve',
     'lstsq', 'matrix_power', 'matrix_rank', 'pinv', 'cross', 'multi_dot',
     'histogram', 'bincount', 'corrcoef', 'cov', 'lu',
+    'inverse', 't',
 ]
 
 
@@ -200,3 +201,19 @@ def lu(x, pivot=True, get_infos=False, name=None):
     if get_infos:
         return outs + (wrap_out(jnp.zeros((), jnp.int32)),)
     return outs
+
+
+def inverse(x, name=None):
+    """Alias of inv (reference paddle.inverse)."""
+    return inv(x, name=name)
+
+
+def t(input, name=None):
+    """Transpose a 0/1/2-D tensor (reference paddle.t)."""
+    x = ensure_tensor(input)
+    if x.ndim > 2:
+        raise ValueError('paddle.t only supports ndim <= 2, got %d'
+                         % x.ndim)
+    if x.ndim < 2:
+        return run_op('t', lambda a: a, x)
+    return run_op('t', lambda a: a.T, x)

@@ -16,7 +16,7 @@ __all__ = [
     'index_select', 'index_sample', 'take_along_axis', 'put_along_axis',
     'tensordot', 'moveaxis', 'rot90', 'as_complex', 'as_real', 'repeat_interleave',
     'tolist', 'crop', 'fill_diagonal_', 'unbind', 'atleast_1d', 'atleast_2d', 'atleast_3d',
-]
+ 'shard_index',]
 
 
 def _identity_op(x):
@@ -460,3 +460,24 @@ def atleast_2d(*inputs, name=None):
 def atleast_3d(*inputs, name=None):
     outs = [run_op('atleast_3d', jnp.atleast_3d, ensure_tensor(t)) for t in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Recompute index ids for a sharded embedding table (reference
+    operators/shard_index_op.cc): ids owned by shard_id map to a local
+    index, all others become ignore_value."""
+    x = ensure_tensor(input)
+    if not (0 <= shard_id < nshards):
+        raise ValueError('shard_id %d out of range [0, %d)'
+                         % (shard_id, nshards))
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        # ids outside [0, index_num) are invalid (the reference op
+        # enforces this); map them to ignore_value instead of silently
+        # aliasing a valid local row
+        in_shard = (a >= lo) & (a < hi) & (a >= 0) & (a < index_num)
+        return jnp.where(in_shard, a - lo, ignore_value)
+    return run_op('shard_index', fn, x)

@@ -312,3 +312,17 @@ def take(x, index, mode='raise', name=None):
             i = jnp.clip(i, 0, flat.shape[0] - 1)
         return flat[i]
     return run_op('take', fn, x)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors elementwise (reference sum_op / add_n)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    tensors = [ensure_tensor(t) for t in inputs]
+
+    def fn(*arrays):
+        out = arrays[0]
+        for a in arrays[1:]:
+            out = out + a
+        return out
+    return run_op('add_n', fn, *tensors)

@@ -104,3 +104,28 @@ def test_graph_service_restart_cycle():
         client.add_edges('default', [1], [2])
         assert client.get_degree('default', [1])[0] == 1
         svc.stop()
+
+
+def test_remove_nodes_native_and_python():
+    from paddle_tpu.native.graph_store import GraphStore
+    for force in (False, True):
+        gs = GraphStore(force_python=force)
+        gs.add_edges([1, 1, 2], [10, 11, 12])
+        gs.set_node_feat(1, [1.0, 2.0])
+        assert gs.remove_nodes([1, 99]) == 1
+        np.testing.assert_array_equal(gs.degree([1, 2]), [0, 1])
+        # removed node's feature is gone too
+        np.testing.assert_allclose(gs.get_node_feat([1], 2), [[0.0, 0.0]])
+
+
+def test_service_remove_graph_node():
+    from paddle_tpu.distributed.graph_service import GraphPyService
+    svc = GraphPyService()
+    client = svc.set_up(num_servers=2)
+    try:
+        client.add_edges('default', [1, 2, 3], [10, 20, 30])
+        assert client.remove_graph_node('default', [2, 77]) == 1
+        deg = client.get_degree('default', [1, 2, 3])
+        np.testing.assert_array_equal(deg, [1, 0, 1])
+    finally:
+        svc.stop()

@@ -222,3 +222,40 @@ def test_cumsum_clip_cast():
     np.testing.assert_allclose(paddle.clip(x, 1.5, 3.5).numpy(),
                                [[1.5, 2.], [3., 3.5]])
     assert paddle.cast(x, 'int32').dtype == 'int32'
+
+
+def test_add_n_inverse_t_shard_index():
+    a = paddle.to_tensor(np.eye(3, dtype=np.float32) * 4)
+    np.testing.assert_allclose(paddle.inverse(a).numpy(),
+                               np.linalg.inv(np.asarray(a.numpy())))
+    m = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(paddle.t(m).numpy(), m.numpy().T)
+    v = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    np.testing.assert_allclose(paddle.t(v).numpy(), [1.0, 2.0])
+
+    s = paddle.add_n([m, m, m])
+    np.testing.assert_allclose(s.numpy(), 3 * m.numpy())
+    # add_n gradient flows to every addend
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    paddle.add_n([x, y]).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((2, 2)))
+    np.testing.assert_allclose(y.grad.numpy(), np.ones((2, 2)))
+
+    ids = paddle.to_tensor(np.asarray([0, 7, 8, 15], np.int64))
+    out = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(out.numpy(), [0, 7, -1, -1])
+    out1 = paddle.shard_index(ids, index_num=16, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(out1.numpy(), [-1, -1, 0, 7])
+
+
+def test_check_numerics_and_profiler_utils(tmp_path):
+    import pytest
+    from paddle_tpu.framework.debug import check_numerics
+    check_numerics(paddle.to_tensor([1.0]), 'x')
+    with pytest.raises(FloatingPointError, match='1 NaN'):
+        check_numerics(paddle.to_tensor([float('nan')]), 'x')
+    from paddle_tpu import profiler
+    with profiler.RecordEvent('unit_test_span'):
+        pass
+    assert profiler.load_profiler_result(str(tmp_path)) == []

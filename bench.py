@@ -1,27 +1,55 @@
 """Benchmark: BERT-base-equivalent causal-LM training throughput on 1 chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Metric: samples/sec/chip on a BERT-base-sized (110M-param-class) transformer
-training step (fwd+bwd+AdamW), seq 512, bf16 activations — BASELINE.json
+training step (fwd+bwd+AdamW), seq 512, bf16 compute — BASELINE.json
 config-3 family. vs_baseline is measured MFU vs the 50% north-star target
 (reference publishes no absolute numbers; BASELINE.md).
+
+Robustness contract (VERDICT r1 item 1): this script NEVER exits non-zero
+and ALWAYS prints a JSON line. Every backend touch happens in a child
+process with a hard timeout, so a TPU backend-init crash OR HANG cannot
+take down the parent; the parent probes with staged backoff, then falls
+back to a CPU run tagged {"degraded": true}.
+
+Note: the CPU fallback selects the platform via
+jax.config.update('jax_platforms', 'cpu') INSIDE the child — the
+JAX_PLATFORMS env var routes through the axon backend shim and can hang.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+_CHILD_ENV = 'PADDLE_TPU_BENCH_CHILD'       # '1' => run the measurement
+_PLATFORM_ENV = 'PADDLE_TPU_BENCH_PLATFORM'  # 'cpu' => force CPU backend
+
+_PROBE_SRC = (
+    "import jax\n"
+    "print('PLATFORM=' + jax.devices()[0].platform)\n"
+)
 
 
-def main():
+def _run_measurement():
+    """Child-process body: the actual benchmark. Prints one JSON line."""
     import jax
+    if os.environ.get(_PLATFORM_ENV):
+        jax.config.update('jax_platforms', os.environ[_PLATFORM_ENV])
+
+    import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
     from paddle_tpu.framework import functional as func_mod
 
     paddle.seed(0)
-    on_tpu = jax.devices()[0].platform == 'tpu'
+    platform = jax.devices()[0].platform
+    on_tpu = platform == 'tpu'
     seq = 512
     if on_tpu:
+        # fail loudly if the Pallas flash kernel cannot run on the chip:
+        # a silent jnp fallback would invalidate the number (VERDICT item 4)
+        os.environ.setdefault('PADDLE_TPU_FLASH_STRICT', '1')
         cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
                         num_heads=12, max_position_embeddings=seq,
                         dropout=0.0)
@@ -74,8 +102,115 @@ def main():
         'value': round(samples_per_sec, 3),
         'unit': 'samples/sec/chip',
         'vs_baseline': round(mfu / 0.50, 4),
+        'mfu': round(mfu, 4),
+        'platform': platform,
+        'degraded': not on_tpu,
     }))
+
+
+def _probe_backend(timeout=None):
+    """Ask a child what the default backend is; bounded by `timeout`."""
+    if timeout is None:
+        timeout = int(os.environ.get('PADDLE_TPU_BENCH_PROBE_TIMEOUT', 240))
+    try:
+        proc = subprocess.run([sys.executable, '-c', _PROBE_SRC],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, 'backend probe hung (>%ds)' % timeout
+    for line in proc.stdout.splitlines():
+        if line.startswith('PLATFORM='):
+            return line.split('=', 1)[1].strip(), None
+    return None, 'probe rc=%d: %s' % (proc.returncode,
+                                      (proc.stderr or '')[-500:])
+
+
+def _spawn_child(extra_env=None, timeout=1500):
+    """Run the measurement in a child; return (json dict | None, err)."""
+    env = dict(os.environ)
+    env[_CHILD_ENV] = '1'
+    if extra_env:
+        env.update(extra_env)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, env=env, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, 'child timed out after %ds' % timeout
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                return json.loads(line), None
+            except (json.JSONDecodeError, ValueError):
+                continue
+    tail = (proc.stderr or proc.stdout or '')[-800:]
+    return None, 'child rc=%d: %s' % (proc.returncode, tail)
+
+
+def _fallback_json(errors):
+    print(json.dumps({
+        'metric': 'bert_base_lm_train_samples_per_sec_per_chip',
+        'value': 0.0,
+        'unit': 'samples/sec/chip',
+        'vs_baseline': 0.0,
+        'degraded': True,
+        'error': '; '.join(errors)[-2000:],
+    }))
+
+
+def main():
+    if os.environ.get(_CHILD_ENV) == '1':
+        _run_measurement()
+        return
+
+    errors = []
+    try:
+        _orchestrate(errors)
+    except BaseException as e:  # the contract: ALWAYS print a JSON line
+        errors.append('orchestrator: %r' % (e,))
+        _fallback_json(errors)
+
+
+def _orchestrate(errors):
+    # 1) bounded backend probes with staged backoff (axon TPU tunnels can
+    #    flake or hang on first contact; a later attempt often succeeds)
+    platform = None
+    if os.environ.get('PADDLE_TPU_BENCH_FAST_PROBE') == '1':
+        delays = (0,)
+    else:
+        delays = (0, 10, 30)
+    for attempt, delay in enumerate(delays):
+        if delay:
+            time.sleep(delay)
+        platform, err = _probe_backend()
+        if platform is not None:
+            break
+        errors.append('probe %d: %s' % (attempt, err))
+
+    # 2) measured run on the probed (real) backend, one retry
+    if platform is not None:
+        for attempt in range(2):
+            result, err = _spawn_child()
+            if result is not None:
+                print(json.dumps(result))
+                return
+            errors.append('run %d: %s' % (attempt, err))
+
+    # 3) CPU fallback — a degraded number beats no number
+    result, err = _spawn_child(extra_env={_PLATFORM_ENV: 'cpu'},
+                               timeout=900)
+    if result is not None:
+        result['degraded'] = True
+        result['error'] = '; '.join(errors)[-1500:]
+        print(json.dumps(result))
+        return
+    errors.append('cpu fallback: %s' % err)
+
+    # 4) last resort: still emit a JSON line, never exit non-zero
+    _fallback_json(errors)
 
 
 if __name__ == '__main__':
     main()
+    sys.exit(0)

@@ -201,6 +201,57 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
             rampup_begin_step=s.dgc_configs.get('rampup_begin_step', 0),
             rampup_step=s.dgc_configs.get('rampup_step', 1))
 
+    # sequence parallel -> sp attention routing over the 'sp' mesh axis
+    # (ring by default; SURVEY §5.7 beyond-reference capability). The state
+    # is scoped to the TrainStep (sp_scope) so eval/generation calls
+    # between steps keep ordinary attention.
+    sp_state = None
+    sp_deg = hcg.get_sequence_parallel_world_size()
+    if sdict['sequence_parallel'] and sp_deg > 1:
+        from .. import sp as sp_mod
+        shape = dict(hcg.mesh.shape)
+        batch_axes = tuple(a for a in ('dp', 'sharding')
+                           if shape.get(a, 1) > 1)
+        sp_state = sp_mod.make_sp_state(
+            hcg.mesh, axis='sp',
+            mode=s.sequence_parallel_configs.get('mode', 'ring'),
+            batch_axes=batch_axes,
+            head_axis='mp' if shape.get('mp', 1) > 1 else None)
+
+    # amp -> O2 compute-dtype policy inside the step (reference fleet
+    # AMPOptimizer); bf16 is TPU-native, fp16 only on explicit request
+    amp_dtype = None
+    if sdict['amp']:
+        pure_fp16 = s.amp_configs.get('use_pure_fp16', False) and \
+            not s.amp_configs.get('use_bf16', True)
+        amp_dtype = 'float16' if pure_fp16 else 'bfloat16'
+        if s.amp_configs.get('custom_white_list') or \
+                s.amp_configs.get('custom_black_list'):
+            import warnings
+            warnings.warn(
+                'fleet amp runs the O2 pure-%s policy inside the jitted '
+                'step; custom_white_list/custom_black_list apply only to '
+                'the eager paddle.amp.auto_cast path and are ignored here'
+                % amp_dtype)
+    sdict['amp_dtype'] = amp_dtype
+
+    if sp_state is not None and getattr(getattr(model, 'config', None),
+                                        'dropout', 0):
+        raise ValueError(
+            'sequence_parallel requires dropout=0 in the model config '
+            '(attention-prob dropout would need sp-aware RNG); got '
+            'dropout=%r' % model.config.dropout)
+
+    # recompute -> per-block remat when the model declares segments
+    # (enable_recompute), else whole-forward remat in the step. Always set
+    # two-way: a True left by an earlier fleet_train_step on the same
+    # model must not leak into a recompute=False build.
+    remat = False
+    if hasattr(model, 'enable_recompute'):
+        model.enable_recompute(bool(sdict['recompute']))
+    elif sdict['recompute']:
+        remat = True
+
     cfg = strategy_mod.build_shardings(model, optimizer, hcg.mesh, sdict)
     strategy_mod.place_params(model, cfg['param_shardings'])
     strategy_mod.place_opt_slots(model, optimizer, cfg['out_shardings'][2])
@@ -211,7 +262,12 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
         batch_sharding=cfg['batch_sharding'],
         k_steps=gm_k,
         grad_merge_avg=s.gradient_merge_configs.get('avg', True)
-        if s.gradient_merge else True)
+        if s.gradient_merge else True,
+        amp_dtype=amp_dtype,
+        remat=remat,
+        sp_state=sp_state,
+        init_loss_scaling=s.amp_configs.get('init_loss_scaling', 65536.0),
+        ls_growth_interval=s.amp_configs.get('incr_every_n_steps', 2000))
     return step
 
 

@@ -137,6 +137,11 @@ class ShardMapDPStep:
         self.n_dev = mesh.shape[axis]
         self._trainable = {name: not p.stop_gradient
                            for name, p in model.named_parameters()}
+        # state-dict key -> Parameter.name: _apply hints (e.g. LARS
+        # exclude_from_weight_decay) match on the Parameter's .name, same
+        # as TrainStep's engine
+        self._pname = {name: p.name
+                       for name, p in model.named_parameters()}
         self._micro = 0          # host-side micro-batch counter
         self._step = 0           # host-side applied-step counter
         self._state = None       # stacked device state
@@ -258,7 +263,7 @@ class ShardMapDPStep:
                 new_params = dict(params)
                 new_slots = dict(state['slots'])
                 for n, g in grads.items():
-                    opt._apply_param_name = n
+                    opt._apply_param_name = self._pname[n]
                     p, s = opt._apply(params[n], g.astype(params[n].dtype),
                                       state['slots'][n], lr, t)
                     new_params[n] = p

@@ -8,11 +8,62 @@ __all__ = ['recompute', 'LocalFS', 'HDFSClient']
 def recompute(function, *args, **kwargs):
     """Activation recomputation (reference: fleet/utils/recompute.py:63
     RecomputeFunction). TPU-native: jax.checkpoint(remat) — XLA rematerializes
-    in backward, RNG handled by jax's per-trace key plumbing."""
+    the segment in backward instead of saving its activations.
+
+    When `function` is a Layer, its parameters are passed as EXPLICIT vjp
+    inputs (run_op only flows gradients to explicit inputs — closing over
+    them would silently drop param grads in eager mode)."""
     import jax
     from ...framework.core import Tensor, run_op
-    preserve = kwargs.pop('preserve_rng_state', True)
+    kwargs.pop('preserve_rng_state', True)
     tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    if hasattr(function, 'named_parameters'):
+        from ...framework import functional as func_mod
+        named = list(function.named_parameters())
+        pnames = [n for n, _ in named]
+        ptensors = [p for _, p in named]
+        buffers = func_mod.extract_buffers(function)
+        bnames = list(buffers.keys())
+        n_p = len(pnames)
+
+        def layer_fn(*arrays):
+            params = dict(zip(pnames, arrays[:n_p]))
+            it = iter(arrays[n_p:])
+            call_args = [Tensor(next(it), stop_gradient=False)
+                         if isinstance(a, Tensor) else a for a in args]
+            out, new_buf = func_mod.functional_call(
+                function, params, buffers, args=call_args, kwargs=kwargs)
+            outs = out if isinstance(out, tuple) else (out,)
+            # buffer updates (BN running stats) ride along as extra
+            # outputs; the caller writes them back into the live layer
+            return tuple(outs) + tuple(new_buf[n] for n in bnames)
+
+        def split_outs(flat):
+            outs = flat[:len(flat) - len(bnames)]
+            bmap = dict(function.named_buffers())
+            for name, arr in zip(bnames, flat[len(flat) - len(bnames):]):
+                arr = arr._data if isinstance(arr, Tensor) else arr
+                if bmap.get(name) is not None:
+                    bmap[name]._data = arr
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        all_inputs = list(ptensors) + tensor_args
+        if any(isinstance(t._data, jax.core.Tracer) for t in all_inputs):
+            # inside an outer jax trace (TrainStep value_and_grad): call the
+            # checkpointed fn DIRECTLY so the outer AD sees the remat
+            # primitive — routing through run_op would jax.vjp it eagerly,
+            # partial-evaluating the checkpoint into a plain
+            # save-activations program (no memory win)
+            flat = jax.checkpoint(layer_fn)(*[t._data for t in all_inputs])
+            return split_outs(tuple(Tensor(o, stop_gradient=False)
+                                    for o in flat))
+
+        flat = run_op('recompute', jax.checkpoint(layer_fn),
+                      *ptensors, *tensor_args)
+        if not isinstance(flat, tuple):
+            flat = (flat,)
+        return split_outs(flat)
 
     def fn(*arrays):
         it = iter(arrays)
@@ -23,8 +74,7 @@ def recompute(function, *args, **kwargs):
             return tuple(o._data if isinstance(o, Tensor) else o for o in out)
         return out._data if isinstance(out, Tensor) else out
 
-    remat_fn = jax.checkpoint(fn)
-    return run_op('recompute', remat_fn, *tensor_args)
+    return run_op('recompute', jax.checkpoint(fn), *tensor_args)
 
 
 class LocalFS:

@@ -40,6 +40,7 @@ class Communicator:
         self._running = False
         self._pending = 0
         self._pending_cv = threading.Condition()
+        self._send_error = None  # first error from the send loop
         # geo state: local deltas accumulated per table
         self._geo_acc = {}
         self._geo_count = 0
@@ -56,12 +57,16 @@ class Communicator:
         self._thread.start()
 
     def stop(self):
-        self.flush()
-        self._running = False
-        if self._thread is not None:
-            self._queue.put(None)       # wake the loop
-            self._thread.join(timeout=5)
-            self._thread = None
+        try:
+            self.flush()
+        finally:
+            # shut the thread down even when flush surfaces a deferred
+            # send error — stop() must leave no live background thread
+            self._running = False
+            if self._thread is not None:
+                self._queue.put(None)   # wake the loop
+                self._thread.join(timeout=5)
+                self._thread = None
 
     is_running = property(lambda self: self._running)
 
@@ -83,7 +88,11 @@ class Communicator:
         """Geo mode: accumulate local param deltas; every
         geo_need_push_nums accumulated rows, push the merged deltas."""
         if self.mode != 'geo':
-            return self.push_sparse_grad(table_id, ids, deltas)
+            # mirror geo mode's hard error for the converse misuse: deltas
+            # are NOT gradients — the server would lr-scale and sign-flip
+            raise RuntimeError('push_sparse_param pushes parameter deltas '
+                               'and is geo-mode only; use push_sparse_grad '
+                               'for %r communicators' % self.mode)
         with self._geo_lock:
             acc = self._geo_acc.setdefault(table_id, {})
             for key, d in zip(np.asarray(ids, np.int64),
@@ -130,6 +139,9 @@ class Communicator:
                     uniq, merged = _merge_by_id(np.concatenate(id_list),
                                                 np.concatenate(g_list))
                     self.client.push(table_id, uniq, merged)
+            except Exception as e:  # keep the loop alive on transient RPC
+                if self._send_error is None:  # errors; surface via flush()
+                    self._send_error = e
             finally:
                 with self._pending_cv:
                     self._pending -= len(batch)
@@ -145,9 +157,13 @@ class Communicator:
         with self._pending_cv:
             ok = self._pending_cv.wait_for(lambda: self._pending == 0,
                                            timeout=timeout)
-            if not ok:
-                raise TimeoutError('communicator flush timed out '
-                                   '(%d sends pending)' % self._pending)
+        if self._send_error is not None:
+            err, self._send_error = self._send_error, None
+            raise RuntimeError('communicator send loop failed; gradients '
+                               'were dropped') from err
+        if not ok:
+            raise TimeoutError('communicator flush timed out '
+                               '(%d sends pending)' % self._pending)
 
     barrier = flush
 

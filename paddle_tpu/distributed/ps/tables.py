@@ -136,6 +136,11 @@ class GeoSparseTable(EmbeddingTable):
                 row = self._rows.get(key)
                 if row is None:
                     row = self._new_row()
+                    nslots = self._opt.slot_count()
+                    if nslots:  # mirror pull(): a later grad push on this
+                        # key must find initialized optimizer slots
+                        self._slots[key] = [np.zeros(self.dim, np.float32)
+                                            for _ in range(nslots)]
                 self._rows[key] = row + d
 
     def pull_geo(self, ids):
@@ -226,30 +231,54 @@ class SsdSparseTable(EmbeddingTable):
             self._demote_if_needed()
 
     def save(self, path):
-        """Persist BOTH tiers (the inherited save would silently drop
-        every spilled row)."""
+        """Persist BOTH tiers, values AND optimizer slots (dropping slots
+        across a checkpoint would reset adagrad/adam state — and break a
+        later push on a loaded row)."""
         os.makedirs(path, exist_ok=True)
+        nslots = self._opt.slot_count()
+        empty = np.zeros(nslots * self.dim, np.float32)
         with self._lock:
             keys = list(self._rows.keys())
-            vals = list(self._rows.values())
+            vals = [v.copy() for v in self._rows.values()]
+            slots = []
+            for k in keys:
+                s = self._slots.get(k)
+                slots.append(np.concatenate([x.ravel() for x in s])
+                             if s else empty.copy())
             with self._db_lock:
-                for kid, blob, _ in self._db.execute(
+                for kid, blob, sblob in self._db.execute(
                         'SELECT id, val, slots FROM rows'):
                     keys.append(int(kid))
                     vals.append(np.frombuffer(blob, np.float32))
+                    slots.append(np.frombuffer(sblob, np.float32)
+                                 if sblob else empty.copy())
         np.savez(os.path.join(path, 'shard.npz'),
                  keys=np.asarray(keys, np.int64),
                  vals=np.stack(vals) if vals else
-                 np.zeros((0, self.dim), np.float32))
+                 np.zeros((0, self.dim), np.float32),
+                 slots=np.stack(slots) if slots else
+                 np.zeros((0, nslots * self.dim), np.float32))
 
     def load(self, path):
         data = np.load(os.path.join(path, 'shard.npz'))
+        nslots = self._opt.slot_count()
         with self._lock:
             with self._db_lock:
                 self._db.execute('DELETE FROM rows')
             self._rows = {int(k): v.copy()
                           for k, v in zip(data['keys'], data['vals'])}
             self._slots = {}
+            if nslots:
+                saved = data['slots'] if 'slots' in data else None
+                for i, k in enumerate(data['keys']):
+                    if saved is not None and saved.shape[0] > i and \
+                            saved.shape[1] == nslots * self.dim:
+                        flat = saved[i].copy()
+                    else:  # legacy checkpoint without slots: re-init zeros
+                        flat = np.zeros(nslots * self.dim, np.float32)
+                    self._slots[int(k)] = [
+                        flat[j * self.dim:(j + 1) * self.dim]
+                        for j in range(nslots)]
             self._demote_if_needed()
 
     def mem_rows(self):

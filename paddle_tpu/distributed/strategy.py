@@ -88,6 +88,10 @@ def build_shardings(model, optimizer, mesh, strategy=None):
         slots_shardings[name] = {k: slot_sharding_for(name, v)
                                  for k, v in slot.items()}
     opt_shardings = {'slots': slots_shardings, 'step': replicated}
+    if strategy.get('amp_dtype') == 'float16':
+        # fp16 engages dynamic loss scaling: scalar state rides along
+        opt_shardings['loss_scale'] = replicated
+        opt_shardings['growth'] = replicated
     if strategy.get('gradient_merge_k', 1) > 1:
         # TrainStep's opt_state grows accumulators under gradient merge
         opt_shardings['acc'] = {name: param_shardings[name]
@@ -98,6 +102,11 @@ def build_shardings(model, optimizer, mesh, strategy=None):
     if 'sharding' in mesh.axis_names and mesh.shape.get('sharding', 1) > 1:
         # ZeRO composes with dp over the batch: flatten both axes onto batch
         batch_axes = [('dp', 'sharding')]
+    if strategy.get('sequence_parallel') and \
+            mesh.shape.get('sp', 1) > 1:
+        # long-context: dim 1 (sequence) sharded over 'sp'; attention
+        # runs as ring/Ulysses via the sp context (distributed/sp.py)
+        batch_axes = batch_axes + ['sp']
     batch_spec = P(*batch_axes)
     batch_sharding = ns(batch_spec)
     scalar = replicated

@@ -129,7 +129,9 @@ class TrainStep:
     def __init__(self, model, loss_fn, optimizer, donate=True,
                  in_shardings=None, out_shardings=None, mesh=None,
                  batch_sharding=None, grad_sync=None, k_steps=1,
-                 grad_merge_avg=True):
+                 grad_merge_avg=True, amp_dtype=None, remat=False,
+                 sp_state=None, init_loss_scaling=65536.0,
+                 ls_growth_interval=2000):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -140,6 +142,29 @@ class TrainStep:
         self._batch_sharding = batch_sharding
         self._grad_sync = grad_sync
         self._donate = donate
+        # AMP O2-style compute policy (reference fleet AMPOptimizer /
+        # pure-fp16): params+float inputs cast to `amp_dtype` for fwd/bwd,
+        # fp32 master params live in the optimizer update. fp16 engages
+        # dynamic loss scaling (reference check_finite_and_unscale +
+        # update_loss_scaling ops); bf16 has fp32's range and needs none.
+        self._amp_dtype = (jnp.bfloat16 if amp_dtype in (True, 'bfloat16')
+                           else jnp.float16 if amp_dtype == 'float16'
+                           else amp_dtype)
+        self._loss_scaling = self._amp_dtype == jnp.float16
+        self._init_loss_scaling = float(init_loss_scaling)
+        self._ls_growth_interval = int(ls_growth_interval)
+        if self._loss_scaling and int(k_steps) > 1:
+            raise NotImplementedError(
+                'fp16 loss scaling is not composed with gradient merge '
+                'yet; use bf16 amp (the TPU-native dtype) with '
+                'gradient_merge')
+        # global activation recompute (reference RecomputeOptimizer):
+        # jax.checkpoint over the whole fwd — backward recomputes
+        # activations instead of saving them
+        self._remat = bool(remat)
+        # sequence-parallel routing state, active only inside this step's
+        # trace/execution (distributed/sp.py sp_scope)
+        self._sp_state = sp_state
         # gradient merge (reference GradientMergeOptimizer): accumulate
         # k_steps micro-batch grads, apply the optimizer on the k-th
         self._k_steps = int(k_steps)
@@ -165,6 +190,12 @@ class TrainStep:
                 for name in slots}
             state['micro'] = getattr(
                 self, '_gm_micro', jnp.zeros((), jnp.int32))
+        if self._loss_scaling:
+            state['loss_scale'] = getattr(
+                self, '_ls_scale',
+                jnp.asarray(self._init_loss_scaling, jnp.float32))
+            state['growth'] = getattr(
+                self, '_ls_growth', jnp.zeros((), jnp.int32))
         return state
 
     def _write_opt_state(self, state):
@@ -176,6 +207,9 @@ class TrainStep:
         if self._k_steps > 1:
             self._gm_acc = state['acc']
             self._gm_micro = state['micro']
+        if self._loss_scaling:
+            self._ls_scale = state['loss_scale']
+            self._ls_growth = state['growth']
 
     # -- the pure step ------------------------------------------------------
     def _build(self, sample_batch):
@@ -184,29 +218,67 @@ class TrainStep:
         grad_sync = self._grad_sync
         pmeta = dict(model.named_parameters())  # metadata: need_clip, lr, reg
 
+        amp_dtype = self._amp_dtype
+        loss_scaling = self._loss_scaling
+
+        def _amp_cast(tree):
+            return {k: (v.astype(amp_dtype)
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in tree.items()}
+
         def pure_step(params, buffers, opt_state, batch, lr, key):
             inputs, labels = batch
 
             def compute_loss(train_params):
                 all_params = dict(params)
                 all_params.update(train_params)
+                call_buffers = buffers
+                call_inputs = inputs
+                if amp_dtype is not None:
+                    all_params = _amp_cast(all_params)
+                    call_buffers = _amp_cast(buffers)
+                    call_inputs = tuple(
+                        a.astype(amp_dtype)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a
+                        for a in inputs)
                 gen = rng_mod.default_generator()
                 saved_key = gen._key
                 gen._key = key
                 try:
-                    out, new_buf = functional_call(model, all_params, buffers,
-                                                   args=inputs, training=True)
+                    out, new_buf = functional_call(model, all_params,
+                                                   call_buffers,
+                                                   args=call_inputs,
+                                                   training=True)
                     outs = out if isinstance(out, tuple) else (out,)
                     t_outs = [Tensor(o, stop_gradient=False) for o in outs]
                     t_labels = [Tensor(l) for l in labels]
                     loss_t = loss_fn(*t_outs, *t_labels)
                 finally:
                     gen._key = saved_key
-                return loss_t._data, new_buf
+                loss_val = loss_t._data
+                if amp_dtype is not None:
+                    loss_val = loss_val.astype(jnp.float32)
+                new_buf = {k: v.astype(buffers[k].dtype)
+                           if hasattr(v, 'astype') and k in buffers else v
+                           for k, v in new_buf.items()}
+                if loss_scaling:
+                    # differentiate the SCALED loss so fp16 cotangents stay
+                    # above the fp16 underflow floor; report the raw loss
+                    return loss_val * opt_state['loss_scale'], \
+                        (new_buf, loss_val)
+                return loss_val, new_buf
 
+            if self._remat:
+                compute_loss = jax.checkpoint(compute_loss)
             train_params = {k: v for k, v in params.items() if trainable[k]}
-            (loss, new_buffers), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(train_params)
+            if loss_scaling:
+                new_buffers, loss = aux
+                grads = {n: g / opt_state['loss_scale']
+                         for n, g in grads.items()}
+            else:
+                new_buffers = aux
             if grad_sync is not None:
                 grads = grad_sync(grads)
 
@@ -248,9 +320,41 @@ class TrainStep:
 
             K = self._k_steps
             if K == 1:
-                new_params, new_slots, t = apply_updates(grads)
+                if not loss_scaling:
+                    new_params, new_slots, t = apply_updates(grads)
+                    return new_params, new_buffers, \
+                        {'slots': new_slots, 'step': t}, loss
+
+                # dynamic loss scaling (reference operators/amp/
+                # check_finite_and_unscale + update_loss_scaling): skip the
+                # update on overflow, halve the scale; grow it after
+                # `growth_interval` consecutive finite steps
+                finite = jnp.asarray(True)
+                for g in grads.values():
+                    finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+
+                def do_apply(_):
+                    return apply_updates(grads)
+
+                def skip_apply(_):
+                    return (dict(params),
+                            {n: dict(opt_state['slots'][n]) for n in grads},
+                            opt_state['step'])
+
+                new_params, new_slots, t = jax.lax.cond(
+                    finite, do_apply, skip_apply, None)
+                scale = opt_state['loss_scale']
+                growth = opt_state['growth']
+                grown = growth + 1 >= self._ls_growth_interval
+                new_scale = jnp.where(
+                    finite,
+                    jnp.where(grown, jnp.minimum(scale * 2.0, 2.0 ** 24),
+                              scale),
+                    jnp.maximum(scale * 0.5, 1.0))
+                new_growth = jnp.where(finite & ~grown, growth + 1, 0)
                 return new_params, new_buffers, \
-                    {'slots': new_slots, 'step': t}, loss
+                    {'slots': new_slots, 'step': t,
+                     'loss_scale': new_scale, 'growth': new_growth}, loss
 
             # gradient merge: accumulate raw grads; clip/decay/update run
             # only on the k-th micro step (lax.cond keeps one XLA program)
@@ -286,10 +390,11 @@ class TrainStep:
             jit_kwargs['in_shardings'] = self._in_shardings
         if self._out_shardings is not None:
             jit_kwargs['out_shardings'] = self._out_shardings
+        self._pure_step = pure_step
         return jax.jit(pure_step, **jit_kwargs)
 
-    def __call__(self, inputs, labels):
-        """One step; returns the loss as a Tensor."""
+    def _step_args(self, inputs, labels):
+        """Normalize a host batch into pure_step's argument tuple."""
         if not isinstance(inputs, (list, tuple)):
             inputs = (inputs,)
         if not isinstance(labels, (list, tuple)):
@@ -298,20 +403,50 @@ class TrainStep:
                           for a in inputs)
         lab_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
                            for a in labels)
+        return in_arrays, lab_arrays
+
+    def _sp_scope(self):
+        from ..distributed.sp import sp_scope
+        return sp_scope(self._sp_state)
+
+    def trace_jaxpr(self, inputs, labels):
+        """str(jaxpr) of the pure step on this batch — lets tests assert a
+        strategy flag actually transformed the program (the reference's
+        program-transform assertions, test_fleet_*_meta_optimizer.py)."""
+        in_arrays, lab_arrays = self._step_args(inputs, labels)
+        with self._sp_scope():
+            if self._jitted is None:
+                self._jitted = self._build((in_arrays, lab_arrays))
+            params = extract_params(self.model)
+            buffers = extract_buffers(self.model)
+            opt_state = self._opt_state()
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            # make_jaxpr never executes the program: a peek at the current
+            # key suffices (advancing the stream here would desync a
+            # parity run that traces between steps)
+            key = rng_mod.default_generator()._key
+            jaxpr = jax.make_jaxpr(self._pure_step)(
+                params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)
+        return str(jaxpr)
+
+    def __call__(self, inputs, labels):
+        """One step; returns the loss as a Tensor."""
+        in_arrays, lab_arrays = self._step_args(inputs, labels)
         if self._batch_sharding is not None:
             in_arrays = tuple(jax.device_put(a, self._batch_sharding)
                               for a in in_arrays)
             lab_arrays = tuple(jax.device_put(a, self._batch_sharding)
                                for a in lab_arrays)
-        if self._jitted is None:
-            self._jitted = self._build((in_arrays, lab_arrays))
-        params = extract_params(self.model)
-        buffers = extract_buffers(self.model)
-        opt_state = self._opt_state()
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        key = rng_mod.next_key()
-        new_params, new_buffers, new_opt_state, loss = self._jitted(
-            params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)
+        with self._sp_scope():
+            if self._jitted is None:
+                self._jitted = self._build((in_arrays, lab_arrays))
+            params = extract_params(self.model)
+            buffers = extract_buffers(self.model)
+            opt_state = self._opt_state()
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            key = rng_mod.next_key()
+            new_params, new_buffers, new_opt_state, loss = self._jitted(
+                params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)
         write_back_params(self.model, new_params)
         write_back_buffers(self.model, new_buffers)
         self._write_opt_state(new_opt_state)

@@ -40,6 +40,28 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     v = ensure_tensor(value)
     scale = 1.0 / math.sqrt(q.shape[-1])
 
+    # sequence-parallel routing: when the fleet strategy activated the sp
+    # context, attention is the one op that mixes tokens across the
+    # sequence shards — run it as ring/Ulysses over the 'sp' mesh axis
+    try:
+        from ...distributed.sp import sequence_parallel_state, sp_attention
+        sp_state = sequence_parallel_state()
+    except ImportError:
+        sp_state = None
+    if sp_state is not None and q._data.ndim == 4:
+        if attn_mask is not None:
+            raise ValueError('sequence-parallel attention supports causal/'
+                             'full masks only (attn_mask must be None)')
+        if dropout_p:
+            raise ValueError('sequence-parallel attention requires '
+                             'dropout_p=0 (attention-prob dropout would '
+                             'need sp-aware RNG)')
+
+        def fn(qq, kk, vv):
+            return sp_attention(qq, kk, vv, causal=is_causal, scale=scale,
+                                state=sp_state)
+        return run_op('sp_attention', fn, q, k, v)
+
     use_flash = False
     try:
         from ...ops import flash_attention as fa

@@ -109,7 +109,17 @@ def _flash_fwd_impl(q, k, v, causal, scale):
     )(q, k, v)
 
 
+def strict_mode():
+    """PADDLE_TPU_FLASH_STRICT=1 (set by bench/TPU tests): a Pallas
+    failure must surface, not silently fall back to the jnp reference —
+    a fallback would invalidate any reported TPU number."""
+    import os
+    return os.environ.get('PADDLE_TPU_FLASH_STRICT', '0') == '1'
+
+
 def _flash_fwd(q, k, v, causal, scale):
+    if strict_mode():
+        return _flash_fwd_impl(q, k, v, causal, scale)
     try:
         return _flash_fwd_impl(q, k, v, causal, scale)
     except Exception:

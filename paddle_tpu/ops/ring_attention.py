@@ -95,21 +95,19 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
     b, n_loc, h, d = q.shape
     assert h % n_dev == 0, 'ulysses needs heads %% sp == 0'
 
+    # tiled all_to_all: split one dim over the axis, concatenate shards
+    # along another — dev-major ordering on both sides keeps head index
+    # = dev*h_loc + local consistent between the two swaps. (The untiled
+    # form mislowers inside shard_map when the mesh carries extra axes.)
     def seq2head(x):
         # [B, N/sp, H, D] -> [B, N, H/sp, D]
-        x = x.reshape(b, n_loc, n_dev, h // n_dev, d)
-        x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                           tiled=False)
-        return x.reshape(b, n_loc * n_dev, h // n_dev, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
 
     def head2seq(x):
-        n = x.shape[1]
-        x = x.reshape(b, n_dev, n // n_dev, h // n_dev, d)
-        # concat the incoming device axis BEFORE the local-head axis so the
-        # flattened head index is dev*h_loc+local, matching seq2head's split
-        x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                           tiled=False)
-        return x.reshape(b, n // n_dev, h, d)
+        # [B, N, H/sp, D] -> [B, N/sp, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
 
     qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
     if attn_fn is None:

@@ -22,7 +22,8 @@ class GPTConfig:
                  num_heads=12, intermediate_size=None,
                  max_position_embeddings=1024, dropout=0.1,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
-                 use_rmsnorm=False, tie_word_embeddings=True):
+                 use_rmsnorm=False, tie_word_embeddings=True,
+                 recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -34,6 +35,7 @@ class GPTConfig:
         self.initializer_range = initializer_range
         self.use_rmsnorm = use_rmsnorm
         self.tie_word_embeddings = tie_word_embeddings
+        self.recompute = recompute
 
     @staticmethod
     def gpt2_small():
@@ -128,14 +130,25 @@ class GPTModel(nn.Layer):
         Norm = nn.RMSNorm if config.use_rmsnorm else nn.LayerNorm
         self.ln_f = Norm(config.hidden_size, config.layer_norm_epsilon)
         self.wte.weight.placement = ('mp', None)
+        self._recompute = config.recompute
+
+    def enable_recompute(self, flag=True):
+        """Per-block activation recompute (reference RecomputeOptimizer
+        checkpoint segments = transformer blocks)."""
+        self._recompute = flag
 
     def forward(self, input_ids, position_ids=None):
         n = input_ids.shape[1]
         if position_ids is None:
             position_ids = Tensor(jnp.arange(n, dtype=jnp.int64)[None, :])
         x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
-        for block in self.h:
-            x = block(x)
+        if self._recompute and self.training:
+            from ...distributed.fleet.utils import recompute as _remat
+            for block in self.h:
+                x = _remat(block, x)
+        else:
+            for block in self.h:
+                x = block(x)
         return self.ln_f(x)
 
 
@@ -159,6 +172,9 @@ class GPTForCausalLM(nn.Layer):
         else:
             logits = self.lm_head(hidden)
         return logits
+
+    def enable_recompute(self, flag=True):
+        self.gpt.enable_recompute(flag)
 
     def loss(self, logits, labels):
         b, n, v = logits.shape

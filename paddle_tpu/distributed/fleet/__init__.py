@@ -218,6 +218,19 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
             batch_axes=batch_axes,
             head_axis='mp' if shape.get('mp', 1) > 1 else None)
 
+    # pipeline parallel -> GPipe schedule over the 'pp' mesh axis
+    # (distributed/pipeline.py), scoped to the step like sp
+    pp_state = None
+    pp_deg = hcg.get_pipe_parallel_world_size()
+    if pp_deg > 1:
+        from .. import pipeline as pp_mod
+        n_micro = max(pp_deg,
+                      s.pipeline_configs.get('accumulate_steps', 1)
+                      if s.pipeline else 1)
+        pp_state = pp_mod.make_pp_state(hcg.mesh, n_stages=pp_deg,
+                                        n_micro=n_micro,
+                                        remat=bool(sdict['recompute']))
+
     # amp -> O2 compute-dtype policy inside the step (reference fleet
     # AMPOptimizer); bf16 is TPU-native, fp16 only on explicit request
     amp_dtype = None
@@ -266,6 +279,7 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
         amp_dtype=amp_dtype,
         remat=remat,
         sp_state=sp_state,
+        pp_state=pp_state,
         init_loss_scaling=s.amp_configs.get('init_loss_scaling', 65536.0),
         ls_growth_interval=s.amp_configs.get('incr_every_n_steps', 2000))
     return step

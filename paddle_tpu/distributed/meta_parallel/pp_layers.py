@@ -131,6 +131,11 @@ class PipelineLayer(nn.Layer):
         return fns
 
     def forward(self, x):
+        from .. import pipeline as pp_mod
+        pp_state = pp_mod.pipeline_state()
+        if pp_state is not None and self._num_stages > 1 and self.training:
+            return pp_mod.pipeline_stage_fns(self.get_stage_fns(), x,
+                                             pp_state)
         for f in self.run_function:
             x = f(x)
         return x

@@ -1,12 +1,29 @@
 """Pipeline-parallel engine (reference: framework/section_worker.cc:104
-micro-batch 1F1B loop + fleet/meta_parallel/pipeline_parallel.py).
+micro-batch 1F1B loop + fleet/meta_parallel/pipeline_parallel.py:109
+train_batch, pp_layers.py:76 stage partition).
 
 TPU-native (SURVEY.md §7.4 hard-part #2): no executor schedules stages —
-the schedule is a jax program. Stage params live sharded on the 'pp' mesh
-axis; a lax.scan over microbatches rotates activations between stages with
-ppermute inside shard_map (GPipe-style; every stage computes every scan
-step, bubble = pp-1 steps at fill+drain, matching 1F1B's steady state
-utilization for activations-limited regimes when combined with remat).
+the schedule IS a jax program. A GPipe loop runs inside `jax.shard_map`
+manual over the 'pp' mesh axis only (`axis_names={'pp'}`): each tick every
+stage applies its segment and the activations rotate forward with
+ppermute over ICI; dp/mp/sharding stay auto-sharded by XLA inside the
+region, so pipeline composes with the other axes without manual
+collectives. Two stage forms:
+
+  pipeline_blocks     — homogeneous block lists (transformer): per-stage
+                        params are STACKED [pp, layers/pp, ...] and
+                        pp-sharded, so each device stores and computes
+                        only its stage's layers (the memory win).
+  pipeline_stage_fns  — heterogeneous declarative PipelineLayer segments:
+                        a lax.switch picks this rank's segment; params are
+                        closure-captured (schedule-real, memory-neutral),
+                        which also makes SharedLayerDesc tied weights
+                        work for free (same traced array in two stages).
+
+Like sp (distributed/sp.py), the pp state is scoped to a TrainStep so
+eval/generation calls between steps run the plain sequential forward.
+Backward is jax AD through scan+ppermute (GPipe: all microbatches forward,
+then reverse); combine with recompute for the activation-memory win.
 """
 import functools
 
@@ -14,82 +31,226 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..framework import functional as func_mod
 from ..framework.core import Tensor
 
-__all__ = ['PipelineEngine', 'pipeline_spmd_step']
+__all__ = ['PipelineEngine', 'make_pp_state', 'pp_scope', 'pipeline_state',
+           'pipeline_blocks', 'pipeline_stage_fns']
+
+_STATE = {'active': None}
 
 
-def _stack_stage_params(stage_params):
-    """[{name: arr}, ...] per stage -> {name: stacked [pp, ...]} requires
-    homogeneous stages (same structure per stage — the transformer case)."""
-    keys = stage_params[0].keys()
-    return {k: jnp.stack([sp[k] for sp in stage_params]) for k in keys}
+def make_pp_state(mesh, n_stages, n_micro=None, axis='pp', remat=False):
+    """Build (without activating) a pipeline routing state.
 
-
-def pipeline_spmd_step(stage_fn, n_stages, n_micro, axis_name='pp'):
-    """Build a shard_map-able function: each pp rank applies stage_fn with
-    its own params; activations ppermute forward each tick.
-
-    stage_fn(params_slice, x) -> y ; all stages must map like-shaped
-    activations (transformer blocks). Returns fn(stacked_params, microbatches)
-    -> final-stage outputs stacked [n_micro, ...].
+    n_micro: microbatches per step (reference PipelineConfig
+    accumulate_steps); defaults to n_stages (minimum that fills the pipe).
+    remat: checkpoint each layer application inside the stage scan.
     """
+    return {'mesh': mesh, 'axis': axis, 'n_stages': int(n_stages),
+            'n_micro': int(n_micro or n_stages), 'remat': bool(remat)}
 
-    def per_stage(params, micro_in):
-        # params: this rank's slice (leading pp axis stripped by shard_map)
-        # micro_in: [n_micro, mb, ...] (replicated input; stage0 consumes)
-        stage_id = lax.axis_index(axis_name)
-        n_ticks = n_micro + n_stages - 1
-        mb_shape = micro_in.shape[1:]
 
-        def tick(carry, t):
-            buf = carry  # activation arriving at this stage this tick
-            # stage 0 ingests microbatch t (if in range)
-            idx = jnp.clip(t, 0, n_micro - 1)
-            injected = jnp.where(stage_id == 0,
-                                 micro_in[idx],
-                                 buf)
-            out = stage_fn(params, injected)
-            # pass to next stage
-            perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-            nxt = lax.ppermute(out, axis_name, perm)
-            # last stage's output at tick t corresponds to microbatch
-            # t - (n_stages - 1)
-            return nxt, out
+def pipeline_state():
+    return _STATE['active']
 
-        _, outs = lax.scan(tick, jnp.zeros(mb_shape, micro_in.dtype),
-                           jnp.arange(n_ticks))
-        # collect the last stage's valid outputs
-        valid = outs[n_stages - 1:]
-        return valid
 
-    return per_stage
+class pp_scope:
+    """Activate a pp state only around a step's trace/execution."""
+
+    def __init__(self, state):
+        self._state = state
+
+    def __enter__(self):
+        self._saved = _STATE['active']
+        if self._state is not None:
+            _STATE['active'] = self._state
+        return self
+
+    def __exit__(self, *exc):
+        _STATE['active'] = self._saved
+        return False
+
+
+def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like):
+    """The schedule: n_micro + n_stages - 1 ticks; stage 0 ingests
+    microbatch t, every stage applies its segment, ppermute rotates
+    activations forward; the last stage's outputs are psum-broadcast so
+    the (replicated-over-pp) loss/head code downstream sees all of them.
+
+    stage_apply(x_array, stage_id) -> y_array, like-shaped with x.
+    micro: [n_micro, mb, ...]; returns [n_micro, mb, ...].
+    """
+    stage = lax.axis_index(axis)
+    n_ticks = n_micro + n_stages - 1
+    mb_shape = micro.shape[1:]
+
+    def tick(buf, t):
+        idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jnp.where(stage == 0, micro[idx], buf)
+        y = stage_apply(inject, stage)
+        nxt = lax.ppermute(y, axis,
+                           [(i, (i + 1) % n_stages)
+                            for i in range(n_stages)])
+        return nxt, y
+
+    _, outs = lax.scan(tick, jnp.zeros(mb_shape, dtype_like),
+                       jnp.arange(n_ticks))
+    valid = outs[n_stages - 1:]  # meaningful on the last stage only
+    # broadcast in f32: psum over a partial-manual region check-fails in
+    # the XLA CPU backend on bf16 operands ("invalid binary opcode copy")
+    out = lax.psum(
+        jnp.where(stage == n_stages - 1, valid.astype(jnp.float32),
+                  jnp.zeros(valid.shape, jnp.float32)),
+        axis)
+    return out.astype(valid.dtype)
+
+
+def _split_micro(x, n_micro):
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError('batch %d not divisible by n_micro=%d'
+                         % (b, n_micro))
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def pipeline_blocks(blocks, x, state):
+    """Run a homogeneous Layer list through the GPipe schedule with
+    per-stage params stacked [pp, layers/pp, ...] and pp-sharded.
+
+    blocks: structurally identical Layers (e.g. GPTBlock list); their
+    activations must be like-shaped (transformer residual stream).
+    x: Tensor [B, ...]. Returns Tensor [B, ...].
+
+    Note: inside the stage lax.scan all layers of a stage share one
+    dropout key draw (the body traces once) — use dropout=0 under pp for
+    exact parity with the sequential forward.
+    """
+    st = state
+    n_stages, n_micro, axis = st['n_stages'], st['n_micro'], st['axis']
+    blocks = list(blocks)
+    n_layers = len(blocks)
+    if n_layers % n_stages:
+        raise ValueError('n_layers %d %% pp %d != 0'
+                         % (n_layers, n_stages))
+    per = n_layers // n_stages
+    template = blocks[0]
+    if any(b is not None for _, b in template.named_buffers()):
+        raise NotImplementedError(
+            'pipeline_blocks requires buffer-free blocks (running-stat '
+            'layers inside a pipelined stage are not supported)')
+    pnames = [n for n, _ in template.named_parameters()]
+
+    # stack per-layer params: {name: [pp, per, ...]}. The storage params
+    # stay ordinary named entries (optimizer/shardings unchanged); the
+    # stack happens in-graph, and its transpose un-stacks the grads.
+    stacked = {}
+    for n in pnames:
+        arrs = [dict(b.named_parameters())[n]._data for b in blocks]
+        a = jnp.stack(arrs)
+        stacked[n] = a.reshape((n_stages, per) + a.shape[1:])
+
+    remat = st['remat']
+
+    def apply_layer(xb, layer_params):
+        out, _ = func_mod.functional_call(
+            template, layer_params, {},
+            args=(Tensor(xb, stop_gradient=False),))
+        return out
+
+    def stage_apply(xb, stage_id):
+        # params for THIS rank's stage arrive with the pp dim localized
+        def body(c, lp):
+            f = apply_layer
+            if remat:
+                f = jax.checkpoint(apply_layer)
+            return f(c, lp), None
+        y, _ = lax.scan(body, xb, stage_apply.params)
+        return y
+
+    def pp_body(stacked_local, micro):
+        local = {n: a[0] for n, a in stacked_local.items()}  # strip pp dim
+        stage_apply.params = local
+        return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
+                           micro.dtype)
+
+    in_specs = ({n: P(axis) for n in stacked}, P())
+    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=in_specs,
+                       out_specs=P(), axis_names={axis}, check_vma=False)
+    x_arr = x._data if isinstance(x, Tensor) else x
+    micro = _split_micro(x_arr, n_micro)
+    out = fn(stacked, micro)
+    out = out.reshape(x_arr.shape[:1] + out.shape[2:])
+    return Tensor(out, stop_gradient=False)
+
+
+def pipeline_stage_fns(stage_fns, x, state):
+    """GPipe over heterogeneous per-stage callables (PipelineLayer
+    segments): lax.switch picks this rank's segment each tick. Segment
+    boundaries must be like-shaped (switch/ppermute need one aval).
+    Params are closure-captured: every rank holds all params (replicated)
+    — the schedule and comm pattern are real, the per-stage memory win
+    needs the homogeneous pipeline_blocks form."""
+    st = state
+    n_stages, n_micro, axis = st['n_stages'], st['n_micro'], st['axis']
+    if len(stage_fns) != n_stages:
+        raise ValueError('%d stage fns != pp degree %d'
+                         % (len(stage_fns), n_stages))
+
+    def wrap(fn):
+        def g(arr):
+            out = fn(Tensor(arr, stop_gradient=False))
+            return out._data if isinstance(out, Tensor) else out
+        return g
+
+    branches = [wrap(f) for f in stage_fns]
+
+    def stage_apply(xb, stage_id):
+        return lax.switch(stage_id, branches, xb)
+
+    def pp_body(micro):
+        return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
+                           micro.dtype)
+
+    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=P(),
+                       out_specs=P(), axis_names={axis}, check_vma=False)
+    x_arr = x._data if isinstance(x, Tensor) else x
+    out = fn(_split_micro(x_arr, n_micro))
+    out = out.reshape(x_arr.shape[:1] + out.shape[2:])
+    return Tensor(out, stop_gradient=False)
 
 
 class PipelineEngine:
-    """Executes PipelineLayer models: microbatch split + scan schedule +
-    grads + optimizer, jitted once."""
+    """Executes PipelineLayer models: microbatch split + GPipe schedule +
+    grads + optimizer, jitted once (reference SectionWorker TrainFiles +
+    PipelineParallel.train_batch)."""
 
     def __init__(self, pipeline_layer, optimizer, hcg, n_micro=None):
         self.layer = pipeline_layer
         self.optimizer = optimizer
         self.hcg = hcg
-        self.n_micro = n_micro or max(hcg.get_pipe_parallel_world_size(), 1)
+        pp = max(hcg.get_pipe_parallel_world_size(), 1)
+        self.n_micro = n_micro or max(pp, 1)
         self._step = None
+        self._pp_state = None
+        if pp > 1:
+            self._pp_state = make_pp_state(hcg.mesh, n_stages=pp,
+                                           n_micro=self.n_micro)
 
-    def step(self, inputs, labels):
-        # Round-1 semantics: run the declarative model (correctness path).
-        # The scan/ppermute schedule is exercised via pipeline_spmd_step in
-        # tests; full fusion of arbitrary PipelineLayers lands with the
-        # dryrun harness.
+    def _build(self):
         model = self.layer
         loss_fn = model._loss_fn
-        out = model(inputs)
-        loss = loss_fn(out, labels)
-        loss.backward()
-        self.optimizer.step()
-        self.optimizer.clear_grad()
-        return loss
+
+        def step_loss(out, labels):
+            return loss_fn(out, labels)
+
+        self._step = func_mod.TrainStep(model, step_loss, self.optimizer,
+                                        mesh=self.hcg.mesh,
+                                        pp_state=self._pp_state)
+
+    def step(self, inputs, labels):
+        if self._step is None:
+            self._build()
+        return self._step(inputs, labels)

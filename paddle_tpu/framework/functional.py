@@ -130,7 +130,7 @@ class TrainStep:
                  in_shardings=None, out_shardings=None, mesh=None,
                  batch_sharding=None, grad_sync=None, k_steps=1,
                  grad_merge_avg=True, amp_dtype=None, remat=False,
-                 sp_state=None, init_loss_scaling=65536.0,
+                 sp_state=None, pp_state=None, init_loss_scaling=65536.0,
                  ls_growth_interval=2000):
         self.model = model
         self.loss_fn = loss_fn
@@ -162,9 +162,11 @@ class TrainStep:
         # jax.checkpoint over the whole fwd — backward recomputes
         # activations instead of saving them
         self._remat = bool(remat)
-        # sequence-parallel routing state, active only inside this step's
-        # trace/execution (distributed/sp.py sp_scope)
+        # sequence/pipeline-parallel routing states, active only inside
+        # this step's trace/execution (distributed/sp.py sp_scope,
+        # distributed/pipeline.py pp_scope)
         self._sp_state = sp_state
+        self._pp_state = pp_state
         # gradient merge (reference GradientMergeOptimizer): accumulate
         # k_steps micro-batch grads, apply the optimizer on the k-th
         self._k_steps = int(k_steps)
@@ -406,8 +408,15 @@ class TrainStep:
         return in_arrays, lab_arrays
 
     def _sp_scope(self):
-        from ..distributed.sp import sp_scope
-        return sp_scope(self._sp_state)
+        import contextlib
+        stack = contextlib.ExitStack()
+        if self._sp_state is not None:
+            from ..distributed.sp import sp_scope
+            stack.enter_context(sp_scope(self._sp_state))
+        if self._pp_state is not None:
+            from ..distributed.pipeline import pp_scope
+            stack.enter_context(pp_scope(self._pp_state))
+        return stack
 
     def trace_jaxpr(self, inputs, labels):
         """str(jaxpr) of the pure step on this batch — lets tests assert a

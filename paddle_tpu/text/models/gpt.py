@@ -142,7 +142,14 @@ class GPTModel(nn.Layer):
         if position_ids is None:
             position_ids = Tensor(jnp.arange(n, dtype=jnp.int64)[None, :])
         x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
-        if self._recompute and self.training:
+        from ...distributed import pipeline as pp_mod
+        pp_state = pp_mod.pipeline_state()
+        if pp_state is not None and self.training:
+            # GPipe over the 'pp' mesh axis: embeddings above and ln_f/head
+            # below stay replicated over pp; the block stack is the
+            # pipelined region (stage params pp-sharded, ppermute rotation)
+            x = pp_mod.pipeline_blocks(self.h, x, pp_state)
+        elif self._recompute and self.training:
             from ...distributed.fleet.utils import recompute as _remat
             for block in self.h:
                 x = _remat(block, x)

@@ -134,8 +134,32 @@ class PipelineLayer(nn.Layer):
         from .. import pipeline as pp_mod
         pp_state = pp_mod.pipeline_state()
         if pp_state is not None and self._num_stages > 1 and self.training:
+            # thread this container's params AND buffers through the pp
+            # shard_map as explicit replicated inputs (see
+            # pipeline_stage_fns doc) — a closure-captured outer tracer
+            # (e.g. a mask buffer) would recreate the Auto-mesh aval
+            # failure. Buffers are read-only inside a pipelined stage
+            # (running-stat mutation doesn't survive the restore, same
+            # stance as pipeline_blocks' buffer guard).
+            tmap = dict(self.named_parameters())
+            for n, b in self.named_buffers():
+                if b is not None:
+                    tmap.setdefault(n, b)
+            params = {n: t._data for n, t in tmap.items()}
+
+            def rebind(params_in):
+                saved = [(tmap[n], tmap[n]._data) for n in params_in]
+                for n, arr in params_in.items():
+                    tmap[n]._data = arr
+
+                def restore():
+                    for t, arr in saved:
+                        t._data = arr
+                return restore
+
             return pp_mod.pipeline_stage_fns(self.get_stage_fns(), x,
-                                             pp_state)
+                                             pp_state, params=params,
+                                             rebind=rebind)
         for f in self.run_function:
             x = f(x)
         return x

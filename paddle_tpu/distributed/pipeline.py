@@ -42,6 +42,18 @@ __all__ = ['PipelineEngine', 'make_pp_state', 'pp_scope', 'pipeline_state',
 _STATE = {'active': None}
 
 
+def _cpu_mesh(mesh):
+    """True when the pp mesh runs on the XLA CPU backend, whose
+    AllReducePromotion pass aborts on bf16 all-reduces once the SPMD
+    partitioner has inserted a copy into the reduction region. All f32
+    boundary casts in this module are gated on this — TPU keeps bf16
+    collectives (half the ICI bytes)."""
+    try:
+        return mesh.devices.flat[0].platform == 'cpu'
+    except Exception:
+        return False
+
+
 def make_pp_state(mesh, n_stages, n_micro=None, axis='pp', remat=False):
     """Build (without activating) a pipeline routing state.
 
@@ -74,7 +86,8 @@ class pp_scope:
         return False
 
 
-def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like):
+def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
+                wire_dtype=None):
     """The schedule: n_micro + n_stages - 1 ticks; stage 0 ingests
     microbatch t, every stage applies its segment, ppermute rotates
     activations forward; the last stage's outputs are psum-broadcast so
@@ -85,25 +98,27 @@ def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like):
     """
     stage = lax.axis_index(axis)
     n_ticks = n_micro + n_stages - 1
-    mb_shape = micro.shape[1:]
+    # wire_dtype: what collectives (ppermute/psum) carry. f32 on the CPU
+    # backend — bf16 collectives there abort in AllReducePromotion once
+    # the SPMD partitioner inserts a copy into the reduction region (see
+    # _cpu_mesh); on TPU it equals the compute dtype (half the ICI bytes)
+    wire = wire_dtype or dtype_like
 
     def tick(buf, t):
         idx = jnp.clip(t, 0, n_micro - 1)
-        inject = jnp.where(stage == 0, micro[idx], buf)
+        inject = jnp.where(stage == 0, micro[idx], buf).astype(dtype_like)
         y = stage_apply(inject, stage)
-        nxt = lax.ppermute(y, axis,
+        nxt = lax.ppermute(y.astype(wire), axis,
                            [(i, (i + 1) % n_stages)
                             for i in range(n_stages)])
         return nxt, y
 
-    _, outs = lax.scan(tick, jnp.zeros(mb_shape, dtype_like),
+    _, outs = lax.scan(tick, jnp.zeros(micro.shape[1:], wire),
                        jnp.arange(n_ticks))
     valid = outs[n_stages - 1:]  # meaningful on the last stage only
-    # broadcast in f32: psum over a partial-manual region check-fails in
-    # the XLA CPU backend on bf16 operands ("invalid binary opcode copy")
     out = lax.psum(
-        jnp.where(stage == n_stages - 1, valid.astype(jnp.float32),
-                  jnp.zeros(valid.shape, jnp.float32)),
+        jnp.where(stage == n_stages - 1, valid.astype(wire),
+                  jnp.zeros(valid.shape, wire)),
         axis)
     return out.astype(valid.dtype)
 
@@ -170,29 +185,44 @@ def pipeline_blocks(blocks, x, state):
         y, _ = lax.scan(body, xb, stage_apply.params)
         return y
 
+    x_arr = x._data if isinstance(x, Tensor) else x
+    dtype_like = x_arr.dtype
+    wire = jnp.float32 if _cpu_mesh(st['mesh']) else dtype_like
+
     def pp_body(stacked_local, micro):
         local = {n: a[0] for n, a in stacked_local.items()}  # strip pp dim
         stage_apply.params = local
         return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
-                           micro.dtype)
+                           dtype_like, wire)
 
     in_specs = ({n: P(axis) for n in stacked}, P())
     fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=in_specs,
                        out_specs=P(), axis_names={axis}, check_vma=False)
-    x_arr = x._data if isinstance(x, Tensor) else x
-    micro = _split_micro(x_arr, n_micro)
+    # the replicated micro operand crosses the boundary in the wire dtype:
+    # its transpose is a psum over pp (f32 on CPU, see _cpu_mesh; the
+    # stacked params are pp-sharded so their transpose needs no psum)
+    micro = _split_micro(x_arr, n_micro).astype(wire)
     out = fn(stacked, micro)
-    out = out.reshape(x_arr.shape[:1] + out.shape[2:])
+    out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 
 
-def pipeline_stage_fns(stage_fns, x, state):
+def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
     """GPipe over heterogeneous per-stage callables (PipelineLayer
     segments): lax.switch picks this rank's segment each tick. Segment
     boundaries must be like-shaped (switch/ppermute need one aval).
-    Params are closure-captured: every rank holds all params (replicated)
-    — the schedule and comm pattern are real, the per-stage memory win
-    needs the homogeneous pipeline_blocks form."""
+
+    params/rebind thread the stage fns' parameter arrays through the
+    shard_map boundary as explicit replicated inputs instead of closure
+    captures: `params` is a {name: array} dict and `rebind(params)` swaps
+    the (inner-tracer) arrays into the live layers, returning a restore
+    thunk. Closure-captured outer tracers would otherwise carry
+    Auto-mesh avals into the Manual pp region, which the scan transpose
+    rejects (zeros_like on a mismatched context mesh). Every rank holds
+    all params (replicated) — the schedule and comm pattern are real,
+    the per-stage memory win needs the homogeneous pipeline_blocks
+    form. Tied weights (SharedLayerDesc) are one dict entry used by two
+    stages: their cotangents sum, which is exactly the tied-grad rule."""
     st = state
     n_stages, n_micro, axis = st['n_stages'], st['n_micro'], st['axis']
     if len(stage_fns) != n_stages:
@@ -210,15 +240,35 @@ def pipeline_stage_fns(stage_fns, x, state):
     def stage_apply(xb, stage_id):
         return lax.switch(stage_id, branches, xb)
 
-    def pp_body(micro):
-        return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
-                           micro.dtype)
-
-    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=P(),
-                       out_specs=P(), axis_names={axis}, check_vma=False)
     x_arr = x._data if isinstance(x, Tensor) else x
-    out = fn(_split_micro(x_arr, n_micro))
-    out = out.reshape(x_arr.shape[:1] + out.shape[2:])
+    dtype_like = x_arr.dtype
+    cpu = _cpu_mesh(st['mesh'])
+    wire = jnp.float32 if cpu else dtype_like
+    params = params or {}
+    # on CPU the threaded params cross the boundary in f32 too (their
+    # transpose is also a psum over pp) and are cast back to their real
+    # dtype inside the region before rebinding
+    pdtypes = {n: a.dtype for n, a in params.items()}
+    boundary = ({n: a.astype(jnp.float32) for n, a in params.items()}
+                if cpu else params)
+
+    def pp_body(params_in, micro):
+        if cpu:
+            params_in = {n: a.astype(pdtypes[n])
+                         for n, a in params_in.items()}
+        restore = rebind(params_in) if rebind is not None else None
+        try:
+            return _gpipe_loop(stage_apply, micro, n_stages, n_micro,
+                               axis, dtype_like, wire)
+        finally:
+            if restore is not None:
+                restore()
+
+    fn = jax.shard_map(pp_body, mesh=st['mesh'],
+                       in_specs=({n: P() for n in params}, P()),
+                       out_specs=P(), axis_names={axis}, check_vma=False)
+    out = fn(boundary, _split_micro(x_arr, n_micro).astype(wire))
+    out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 
 

@@ -478,6 +478,12 @@ _amp_cast_hook = [None]
 # used by jit.to_static to discover closed-over params of plain functions
 _param_recorder = [None]
 
+# when set to a callable(fn, in_tensors, out_tensors), run_op reports every
+# op it executes — static.program_guard records the build into a Program so
+# Executor.run can replay fetches from fresh feeds (the reference's
+# ProgramDesc+Executor contract, without the protobuf IR)
+_fwd_recorder = [None]
+
 
 def run_op(name, fn, *inputs, n_outputs=None):
     """Run op `fn` over Tensor `inputs`; record VJP on the tape when needed.
@@ -498,9 +504,11 @@ def run_op(name, fn, *inputs, n_outputs=None):
 
     if not needs_grad:
         out = fn(*arrays)
-        if isinstance(out, tuple):
-            return tuple(wrap_out(o) for o in out)
-        return wrap_out(out)
+        multi = isinstance(out, tuple)
+        wrapped = [wrap_out(o) for o in (out if multi else (out,))]
+        if _fwd_recorder[0] is not None:
+            _fwd_recorder[0](fn, tensors, wrapped)
+        return tuple(wrapped) if multi else wrapped[0]
 
     out, vjp_fn = jax.vjp(fn, *arrays)
     multi = isinstance(out, tuple)
@@ -513,6 +521,8 @@ def run_op(name, fn, *inputs, n_outputs=None):
         t._node_out_idx = i
         node.out_refs.append(weakref.ref(t))
         wrapped.append(t)
+    if _fwd_recorder[0] is not None:
+        _fwd_recorder[0](fn, tensors, wrapped)
     return tuple(wrapped) if multi else wrapped[0]
 
 

@@ -205,7 +205,10 @@ class TrainStep:
         pmap = dict(self.model.named_parameters())
         for name, s in state['slots'].items():
             opt._slots[id(pmap[name])] = dict(s)
-        opt._step_count = int(state['step'])
+        # keep the step counter device-side: int(...) would block the host
+        # on the step's completion, serializing the dispatch pipeline
+        # (one forced round-trip per step through the TPU tunnel)
+        opt._step_count = state['step']
         if self._k_steps > 1:
             self._gm_acc = state['acc']
             self._gm_micro = state['micro']
@@ -395,6 +398,15 @@ class TrainStep:
         self._pure_step = pure_step
         return jax.jit(pure_step, **jit_kwargs)
 
+    def _lr_array(self):
+        """Device-resident lr, re-uploaded only when the python value
+        changes (a scheduler step) — not once per train step."""
+        lr = self.optimizer.get_lr()
+        cached = getattr(self, '_lr_cache', None)
+        if cached is None or cached[0] != lr:
+            self._lr_cache = (lr, jnp.asarray(lr, jnp.float32))
+        return self._lr_cache[1]
+
     def _step_args(self, inputs, labels):
         """Normalize a host batch into pure_step's argument tuple."""
         if not isinstance(inputs, (list, tuple)):
@@ -429,7 +441,7 @@ class TrainStep:
             params = extract_params(self.model)
             buffers = extract_buffers(self.model)
             opt_state = self._opt_state()
-            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            lr = self._lr_array()
             # make_jaxpr never executes the program: a peek at the current
             # key suffices (advancing the stream here would desync a
             # parity run that traces between steps)
@@ -452,7 +464,7 @@ class TrainStep:
             params = extract_params(self.model)
             buffers = extract_buffers(self.model)
             opt_state = self._opt_state()
-            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            lr = self._lr_array()
             key = rng_mod.next_key()
             new_params, new_buffers, new_opt_state, loss = self._jitted(
                 params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)

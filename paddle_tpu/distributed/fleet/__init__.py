@@ -224,12 +224,27 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
     pp_deg = hcg.get_pipe_parallel_world_size()
     if pp_deg > 1:
         from .. import pipeline as pp_mod
-        n_micro = max(pp_deg,
-                      s.pipeline_configs.get('accumulate_steps', 1)
-                      if s.pipeline else 1)
+        # strategy.pipeline=True engages pipeline_configs: accumulate_steps
+        # and schedule_mode ('1F1B' -> interleaved schedule with loss in
+        # the last stage, 'F-then-B' -> GPipe). Without the flag the
+        # default GPipe schedule with n_micro=pp runs (hybrid_configs only).
+        schedule = 'gpipe'
+        acc = 1
+        if s.pipeline:
+            acc = s.pipeline_configs.get('accumulate_steps', 1)
+            mode = s.pipeline_configs.get('schedule_mode', '1F1B')
+            schedule = '1f1b' if str(mode).upper() == '1F1B' else 'gpipe'
+        # an explicit accumulate_steps is honored as-is (>= pp); the
+        # 2*pp floor applies only as the 1F1B DEFAULT (the regime where
+        # its O(pp) stash wins)
+        if acc > 1:
+            n_micro = max(pp_deg, acc)
+        else:
+            n_micro = 2 * pp_deg if schedule == '1f1b' else pp_deg
         pp_state = pp_mod.make_pp_state(hcg.mesh, n_stages=pp_deg,
                                         n_micro=n_micro,
-                                        remat=bool(sdict['recompute']))
+                                        remat=bool(sdict['recompute']),
+                                        schedule=schedule)
 
     # amp -> O2 compute-dtype policy inside the step (reference fleet
     # AMPOptimizer); bf16 is TPU-native, fp16 only on explicit request

@@ -54,15 +54,27 @@ def _cpu_mesh(mesh):
         return False
 
 
-def make_pp_state(mesh, n_stages, n_micro=None, axis='pp', remat=False):
+def make_pp_state(mesh, n_stages, n_micro=None, axis='pp', remat=False,
+                  schedule='gpipe'):
     """Build (without activating) a pipeline routing state.
 
     n_micro: microbatches per step (reference PipelineConfig
-    accumulate_steps); defaults to n_stages (minimum that fills the pipe).
+    accumulate_steps); defaults to n_stages for GPipe (minimum that fills
+    the pipe) and 2*n_stages for 1F1B (the regime where its O(pp) stash
+    beats GPipe's O(n_micro)).
     remat: checkpoint each layer application inside the stage scan.
+    schedule: 'gpipe' (this module) or '1f1b' (pipeline_1f1b.py —
+    interleaved fwd/bwd, loss inside the last stage).
     """
+    schedule = schedule.lower().replace('-', '')
+    if schedule not in ('gpipe', '1f1b', 'fthenb'):
+        raise ValueError('unknown pipeline schedule %r' % schedule)
+    if schedule == 'fthenb':
+        schedule = 'gpipe'
+    default_micro = 2 * n_stages if schedule == '1f1b' else n_stages
     return {'mesh': mesh, 'axis': axis, 'n_stages': int(n_stages),
-            'n_micro': int(n_micro or n_stages), 'remat': bool(remat)}
+            'n_micro': int(n_micro or default_micro), 'remat': bool(remat),
+            'schedule': schedule}
 
 
 def pipeline_state():

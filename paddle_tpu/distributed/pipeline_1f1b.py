@@ -1,0 +1,324 @@
+"""1F1B pipeline schedule (reference: framework/section_worker.cc:104-143
+micro-batch loop RunForward/RunBackward/RunUpdate;
+fleet/meta_parallel/pipeline_parallel.py:109 train_batch).
+
+TPU-native 1F1B: the schedule is ONE lax.scan inside a shard_map over the
+'pp' mesh axis, where every tick each stage runs (a) the forward of the
+incoming microbatch and (b) the backward of the microbatch whose cotangent
+just arrived — forwards and backwards interleave exactly as in the
+reference's steady state, so the stash of saved stage inputs is a circular
+buffer of size O(pp), NOT O(n_micro) (the GPipe scan in pipeline.py keeps
+O(n_micro + pp)). Backward recomputes the stage from its stashed input
+(recompute is inherent to the schedule, as in SectionWorker).
+
+Because micro-level loss must live INSIDE the pipelined region (a backward
+can only start once ITS loss exists — with loss outside, reverse-mode AD
+degenerates to GPipe), the model provides a 3-way decomposition via
+`pp_decompose()`: pre (embedding...), blocks (homogeneous stack), post
+(head + loss). Tied weights (e.g. wte reused by the head) are ONE param
+entry used by both pre and post; their per-rank grads sum in the vjp and
+the psum over pp adds the rank-0 (embedding) and last-rank (head)
+contributions — the SharedLayerDesc tied-grad rule for free.
+
+The whole schedule runs in the PRIMAL computation and emits grads; a
+custom_vjp hands those precomputed grads to the outer jax.grad, scaled by
+the incoming loss cotangent. Timeline (rank r, microbatch i):
+  forward  at tick r + i
+  backward at tick 2(pp-1) - r + i
+  => in-flight stash span = 2(pp-1-r), total ticks = n_micro + 2(pp-1).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework import functional as func_mod
+from ..framework.core import Tensor
+from .pipeline import _cpu_mesh
+
+__all__ = ['one_f_one_b_loss', 'supports_1f1b']
+
+
+def supports_1f1b(model):
+    return hasattr(model, 'pp_decompose')
+
+
+def _check_no_dropout(model):
+    """The schedule's scan body traces once, so a dropout draw would bake
+    one mask for every tick/step (and the RNG key would be an outer
+    tracer crossing the Manual region). Refuse rather than silently
+    degrade training."""
+    from .. import nn
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, nn.Dropout) and getattr(layer, 'p', 0):
+            raise NotImplementedError(
+                '1F1B pipeline does not support dropout yet (a scan-traced '
+                'mask would repeat every step) — set dropout=0 or use '
+                'schedule_mode="F-then-B"')
+
+
+def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
+    """Scalar loss array; d(loss)/d(params) flows through a custom_vjp
+    whose backward returns the grads the interleaved schedule computed.
+
+    params: {name: array} covering every model parameter (the arrays may
+    be outer-jit tracers). inputs/labels: int arrays [B, ...]. loss_fn
+    (logits Tensor, labels Tensor) -> scalar Tensor is forwarded to
+    pp_decompose so the user's objective is honored inside the last stage.
+    """
+    mesh = state['mesh']
+    axis = state['axis']
+    pp = state['n_stages']
+    n_micro = state['n_micro']
+    _check_no_dropout(model)
+    try:
+        pre_fn, blocks, post_fn = model.pp_decompose(loss_fn)
+    except TypeError:
+        if loss_fn is not None:
+            import warnings
+            warnings.warn(
+                '%s.pp_decompose() takes no loss_fn — the 1F1B schedule '
+                'uses the loss baked into its post stage, NOT the loss_fn '
+                'passed to the train step' % type(model).__name__)
+        pre_fn, blocks, post_fn = model.pp_decompose()
+    blocks = list(blocks)
+    if len(blocks) % pp:
+        raise ValueError('n_layers %d %% pp %d != 0' % (len(blocks), pp))
+    per = len(blocks) // pp
+    template = blocks[0]
+    block_pnames = {}  # stacked name -> [per-layer full names]
+    tmpl_names = [n for n, _ in template.named_parameters()]
+    blk_maps = [dict(b.named_parameters()) for b in blocks]
+    full_names = {n: [None] * len(blocks) for n in tmpl_names}
+    pmap_all = dict(model.named_parameters())
+    rev = {id(p): n for n, p in pmap_all.items()}
+    for li, bm in enumerate(blk_maps):
+        for n in tmpl_names:
+            full_names[n][li] = rev[id(bm[n])]
+    block_param_names = {fn2 for ns in full_names.values() for fn2 in ns}
+    outer_names = [n for n in params if n not in block_param_names]
+
+    cpu = _cpu_mesh(mesh)
+
+    b = inputs.shape[0]
+    if b % n_micro:
+        raise ValueError('batch %d %% n_micro %d != 0' % (b, n_micro))
+    mb = b // n_micro
+    micro_ids = inputs.reshape((n_micro, mb) + inputs.shape[1:])
+    micro_lbl = labels.reshape((n_micro, mb) + labels.shape[1:])
+
+    # probe shapes eagerly (abstract eval only) to size the rotating bufs
+    x_shape_dtype = jax.eval_shape(
+        lambda ids: _call_pre(model, pre_fn, params, ids), micro_ids[0])
+
+    def stacked_of(pdict):
+        out = {}
+        for n in tmpl_names:
+            arrs = [pdict[fn2] for fn2 in full_names[n]]
+            a = jnp.stack(arrs)
+            out[n] = a.reshape((pp, per) + a.shape[1:])
+        return out
+
+    def unstack_grads(stacked_grads):
+        out = {}
+        for n, a in stacked_grads.items():
+            flat = a.reshape((pp * per,) + a.shape[2:])
+            for li, fn2 in enumerate(full_names[n]):
+                out[fn2] = flat[li]
+        return out
+
+    @jax.custom_vjp
+    def pp_loss(p):
+        loss, _ = _run(p)
+        return loss
+
+    def _fwd(p):
+        return _run(p)
+
+    def _bwd(grads, g):
+        return (jax.tree_util.tree_map(lambda a: a * g, grads),)
+
+    pp_loss.defvjp(_fwd, lambda res, g: _bwd(res, g))
+
+    def _run(p):
+        stacked = stacked_of(p)
+        outer = {n: p[n] for n in outer_names}
+        pdtypes = {n: a.dtype for n, a in outer.items()}
+        if cpu:
+            # f32 across the boundary: replicated operands' grad psums over
+            # pp abort XLA:CPU's AllReducePromotion in bf16 (see pipeline.py)
+            outer_in = {n: a.astype(jnp.float32) for n, a in outer.items()}
+        else:
+            outer_in = outer
+
+        wire = jnp.float32 if cpu else jnp.dtype(x_shape_dtype.dtype)
+
+        def body(stacked_local, outer_p, ids_all, lbl_all):
+            if cpu:
+                outer_p = {n: a.astype(pdtypes[n])
+                           for n, a in outer_p.items()}
+            local = {n: a[0] for n, a in stacked_local.items()}
+            r = lax.axis_index(axis)
+            last = pp - 1
+            T = n_micro + 2 * (pp - 1)
+            S = 2 * pp
+            x_shape = (mb,) + tuple(x_shape_dtype.shape[1:])
+            x_dtype = jnp.dtype(x_shape_dtype.dtype)
+
+            def tick_fn(x_in, outer_params, local_blocks, i_mb):
+                """One stage application: (y, mb_loss). pre and post run
+                under lax.cond on the pp rank: only stage 0 pays the
+                embedding lookup and only the last stage pays the
+                vocab-size head matmul + loss (branching on the rank is
+                SPMD-safe here — all devices sharing a pp coordinate take
+                the same branch, so any auto-axis collectives inside a
+                branch stay consistent)."""
+                ids_mb = ids_all[i_mb]
+                lbl_mb = lbl_all[i_mb]
+                x0 = lax.cond(
+                    r == 0,
+                    lambda xi: _call_pre(model, pre_fn, outer_params,
+                                         ids_mb).astype(x_dtype),
+                    lambda xi: xi,
+                    x_in.astype(x_dtype))
+
+                def layer(c, lp):
+                    out, _ = func_mod.functional_call(
+                        template, lp, {},
+                        args=(Tensor(c, stop_gradient=False),))
+                    return out, None
+                y, _ = lax.scan(layer, x0, local_blocks)
+                mb_loss = lax.cond(
+                    r == last,
+                    lambda yy: _call_post(model, post_fn, outer_params,
+                                          yy, lbl_mb).astype(jnp.float32),
+                    lambda yy: jnp.zeros((), jnp.float32),
+                    y)
+                return y, mb_loss
+
+            zero_outer = {n: jnp.zeros(a.shape, jnp.float32)
+                          for n, a in outer_p.items()}
+            zero_blocks = {n: jnp.zeros(a.shape, jnp.float32)
+                           for n, a in local.items()}
+            carry0 = dict(
+                fwd_buf=jnp.zeros(x_shape, wire),
+                bwd_buf=jnp.zeros(x_shape, jnp.float32),
+                stash=jnp.zeros((S,) + x_shape, wire),
+                g_outer=zero_outer,
+                g_blocks=zero_blocks,
+                loss=jnp.zeros((), jnp.float32),
+            )
+            fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+            bwd_perm = [(i, (i - 1) % pp) for i in range(pp)]
+
+            def tick(carry, t):
+                i_f = t - r
+                f_valid = jnp.logical_and(i_f >= 0, i_f < n_micro)
+                i_f_c = jnp.clip(i_f, 0, n_micro - 1)
+                x_in = carry['fwd_buf'].astype(x_dtype)
+
+                y, mb_loss = tick_fn(x_in, outer_p, local, i_f_c)
+                loss = carry['loss'] + jnp.where(f_valid, mb_loss, 0.0)
+                stash = carry['stash'].at[i_f_c % S].set(
+                    jnp.where(f_valid, carry['fwd_buf'],
+                              carry['stash'][i_f_c % S]))
+
+                i_b = t - (2 * (pp - 1) - r)
+                b_valid = jnp.logical_and(i_b >= 0, i_b < n_micro)
+                i_b_c = jnp.clip(i_b, 0, n_micro - 1)
+                x_st = stash[i_b_c % S].astype(x_dtype)
+
+                _, vjp_fn = jax.vjp(
+                    lambda x, op, lb: tick_fn(x, op, lb, i_b_c),
+                    x_st, outer_p, local)
+                cot_y = jnp.where(r == last,
+                                  jnp.zeros(x_shape, x_dtype),
+                                  carry['bwd_buf'].astype(x_dtype))
+                cot_loss = jnp.where(r == last, 1.0 / n_micro, 0.0)
+                cot_loss = jnp.where(b_valid, cot_loss, 0.0)
+                cot_y = jnp.where(b_valid, cot_y,
+                                  jnp.zeros(x_shape, x_dtype))
+                dx, d_outer, d_blocks = vjp_fn(
+                    (cot_y, cot_loss.astype(jnp.float32)))
+
+                bmask = b_valid.astype(jnp.float32)
+                g_outer = jax.tree_util.tree_map(
+                    lambda acc, d2: acc + d2.astype(jnp.float32) * bmask,
+                    carry['g_outer'], d_outer)
+                g_blocks = jax.tree_util.tree_map(
+                    lambda acc, d2: acc + d2.astype(jnp.float32) * bmask,
+                    carry['g_blocks'], d_blocks)
+
+                fwd_buf = lax.ppermute(y.astype(wire), axis, fwd_perm)
+                bwd_buf = lax.ppermute(
+                    (dx.astype(jnp.float32) * bmask), axis, bwd_perm)
+                return dict(fwd_buf=fwd_buf, bwd_buf=bwd_buf, stash=stash,
+                            g_outer=g_outer, g_blocks=g_blocks,
+                            loss=loss), None
+
+            carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+            loss = lax.psum(carry['loss'], axis) / n_micro
+            g_outer = {n: lax.psum(a, axis)
+                       for n, a in carry['g_outer'].items()}
+            g_blocks = {n: a[None] for n, a in carry['g_blocks'].items()}
+            return loss, g_outer, g_blocks
+
+        in_specs = ({n: P(axis) for n in stacked},
+                    {n: P() for n in outer_in}, P(), P())
+        out_specs = (P(), {n: P() for n in outer_in},
+                     {n: P(axis) for n in stacked})
+        fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, axis_names={axis},
+                           check_vma=False)
+        loss, g_outer, g_blocks = fn(stacked, outer_in, micro_ids, micro_lbl)
+        grads = {}
+        for n, a in g_outer.items():
+            grads[n] = a.astype(params[n].dtype)
+        for n, a in unstack_grads(g_blocks).items():
+            grads[n] = a.astype(params[n].dtype)
+        # params not touched by the pipeline (none normally) get zeros
+        for n in params:
+            if n not in grads:
+                grads[n] = jnp.zeros_like(params[n])
+        return loss, grads
+
+    return pp_loss(params)
+
+
+def _call_pre(model, pre_fn, pdict, ids_arr):
+    """Run pre_fn with pdict bound into the live layers; returns array."""
+    saved = _bind(model, pdict)
+    try:
+        out = pre_fn(Tensor(ids_arr))
+        return out._data if isinstance(out, Tensor) else out
+    finally:
+        _restore(saved)
+
+
+def _call_post(model, post_fn, pdict, x_arr, lbl_arr):
+    saved = _bind(model, pdict)
+    try:
+        out = post_fn(Tensor(x_arr, stop_gradient=False), Tensor(lbl_arr))
+        return out._data if isinstance(out, Tensor) else out
+    finally:
+        _restore(saved)
+
+
+def _bind(model, pdict):
+    pmap = dict(model.named_parameters())
+    saved = []
+    for n, arr in pdict.items():
+        t = pmap.get(n)
+        if t is None:
+            continue
+        saved.append((t, t._data))
+        t._data = arr
+    return saved
+
+
+def _restore(saved):
+    for t, arr in saved:
+        t._data = arr

@@ -231,6 +231,23 @@ class TrainStep:
                         if jnp.issubdtype(v.dtype, jnp.floating) else v)
                     for k, v in tree.items()}
 
+        pp_state = self._pp_state
+        use_1f1b = False
+        if pp_state is not None and pp_state.get('schedule') == '1f1b':
+            from ..distributed.pipeline_1f1b import supports_1f1b
+            if supports_1f1b(model):
+                use_1f1b = True
+            else:
+                # models without a pre/blocks/post split keep training —
+                # GPipe is the schedule the generic pipeline path runs
+                import warnings
+                warnings.warn(
+                    'pipeline schedule_mode=1F1B needs %s.pp_decompose() '
+                    '(pre/blocks/post split); falling back to the GPipe '
+                    'schedule' % type(model).__name__)
+                self._pp_state = pp_state = dict(pp_state,
+                                                 schedule='gpipe')
+
         def pure_step(params, buffers, opt_state, batch, lr, key):
             inputs, labels = batch
 
@@ -246,6 +263,18 @@ class TrainStep:
                         a.astype(amp_dtype)
                         if jnp.issubdtype(a.dtype, jnp.floating) else a
                         for a in inputs)
+                if use_1f1b:
+                    # micro-level loss lives inside the pipelined region
+                    # (pipeline_1f1b.py); loss_fn is forwarded into the
+                    # model's pp_decompose post stage
+                    from ..distributed.pipeline_1f1b import one_f_one_b_loss
+                    loss_val = one_f_one_b_loss(
+                        model, all_params, call_inputs[0], labels[0],
+                        self._pp_state, loss_fn=loss_fn).astype(jnp.float32)
+                    if loss_scaling:
+                        return loss_val * opt_state['loss_scale'], \
+                            ({}, loss_val)
+                    return loss_val, {}
                 gen = rng_mod.default_generator()
                 saved_key = gen._key
                 gen._key = key

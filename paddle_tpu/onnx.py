@@ -139,8 +139,6 @@ class _Converter:
         self.const_vals = {}       # var name -> known numpy value
         self.names = {}            # jaxpr Var -> onnx name
         self.counter = 0
-        # parallel semantic graph for the export-time self-check evaluator
-        self.sem_nodes = []        # (op_type, inputs, outputs, attrs)
 
     def fresh(self, hint='t'):
         self.counter += 1
@@ -167,8 +165,6 @@ class _Converter:
     def emit(self, op_type, inputs, outputs, attrs=None):
         self.nodes.append(_node(op_type, inputs, outputs, attrs,
                                 name=self.fresh(op_type)))
-        self.sem_nodes.append((op_type, list(inputs), list(outputs),
-                               dict(attrs or {})))
 
     def is_known(self, names):
         return all(n in self.const_vals for n in names)
@@ -466,9 +462,14 @@ class _Converter:
         c = self.fresh('cast')
         self.emit('Cast', [i[0]], [c], {'to': 6})
         r = self.fresh('red')
-        ax = self.fresh('axes')
-        self.add_const(ax, np.asarray(e.params['axes'], np.int64))
-        self.emit('ReduceMin', [c, ax], [r], {'keepdims': 0})
+        if self.opset >= 18:
+            ax = self.fresh('axes')
+            self.add_const(ax, np.asarray(e.params['axes'], np.int64))
+            self.emit('ReduceMin', [c, ax], [r], {'keepdims': 0})
+        else:  # axes-as-input only exists from opset 18
+            self.emit('ReduceMin', [c], [r],
+                      {'keepdims': 0,
+                       'axes': [int(a) for a in e.params['axes']]})
         self.emit('Cast', [r], o, {'to': 9})
 
     def _p_argmax(self, e, i, o):

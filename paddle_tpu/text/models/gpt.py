@@ -183,6 +183,34 @@ class GPTForCausalLM(nn.Layer):
     def enable_recompute(self, flag=True):
         self.gpt.enable_recompute(flag)
 
+    def pp_decompose(self, loss_fn=None):
+        """(pre, blocks, post) split for the 1F1B pipeline schedule
+        (distributed/pipeline_1f1b.py): pre = embeddings (stage 0),
+        blocks = the homogeneous transformer stack (pp-sharded), post =
+        ln_f + tied/untied head + token loss (last stage). Mirrors the
+        reference PipelineTrainer program split where the loss lives in
+        the last section (section_worker.cc). The tied wte weight appears
+        in both pre and post — its grads combine via the schedule's psum.
+        loss_fn(logits, labels) overrides self.loss so the train step's
+        objective is honored."""
+        gpt = self.gpt
+        loss_fn = loss_fn or self.loss
+
+        def pre(ids):
+            n = ids.shape[1]
+            pos = Tensor(jnp.arange(n, dtype=jnp.int32)[None, :])
+            return gpt.drop(gpt.wte(ids) + gpt.wpe(pos))
+
+        def post(x, labels):
+            h = gpt.ln_f(x)
+            if self.lm_head is None:
+                logits = F.linear(h, M.transpose(gpt.wte.weight, [1, 0]))
+            else:
+                logits = self.lm_head(h)
+            return loss_fn(logits, labels)
+
+        return pre, gpt.h, post
+
     def loss(self, logits, labels):
         b, n, v = logits.shape
         return F.cross_entropy(M.reshape(logits, [b * n, v]),

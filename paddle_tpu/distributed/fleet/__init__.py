@@ -245,6 +245,8 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
                                         n_micro=n_micro,
                                         remat=bool(sdict['recompute']),
                                         schedule=schedule)
+        # lets the GPipe fallback (TrainStep) undo the 1F1B default
+        pp_state['n_micro_defaulted'] = acc <= 1
 
     # amp -> O2 compute-dtype policy inside the step (reference fleet
     # AMPOptimizer); bf16 is TPU-native, fp16 only on explicit request

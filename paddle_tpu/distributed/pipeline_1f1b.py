@@ -74,9 +74,16 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
     pp = state['n_stages']
     n_micro = state['n_micro']
     _check_no_dropout(model)
+    import inspect
+    takes_loss = True
     try:
+        sig = inspect.signature(model.pp_decompose)
+        takes_loss = bool(sig.parameters)
+    except (TypeError, ValueError):
+        pass
+    if takes_loss:
         pre_fn, blocks, post_fn = model.pp_decompose(loss_fn)
-    except TypeError:
+    else:
         if loss_fn is not None:
             import warnings
             warnings.warn(

@@ -10,3 +10,6 @@ from .tables import (DenseTable, BarrierTable, TensorTable,  # noqa: F401
 from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            HalfAsyncCommunicator, SyncCommunicator,
                            GeoCommunicator)
+from .dataset import MultiSlotDataset  # noqa: F401
+from .trainer import DownpourTrainer  # noqa: F401
+from .heter import HeterEmbedding  # noqa: F401

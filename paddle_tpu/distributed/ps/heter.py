@@ -1,0 +1,96 @@
+"""Heterogeneous embedding: host-resident table + device-resident dense
+half (reference: framework/fleet/heter_ps/heter_comm.h:50,
+ps_gpu_wrapper.h:50, trainer.h:180 HeterXpuTrainer — the CPU<->accelerator
+split that backs the "100 billion features" capability).
+
+TPU-native shape: the table lives in the embedding service (host RAM,
+optionally SSD-tiered via tables.SsdSparseTable) and NEVER enters the XLA
+program; the jitted step exchanges only the batch's rows per step:
+
+  forward : jax.pure_callback pulls rows for the ids        (host -> TPU)
+  backward: io_callback pushes the rows' gradients back     (TPU -> host)
+
+so device memory is O(batch x dim) regardless of table size — the same
+activations/grads-over-the-wire contract as the reference's HeterWorker,
+with XLA's host-callback machinery instead of a brpc channel. The server
+applies its optimizer to pushed grads, so the layer exposes no trainable
+row Parameters to the device optimizer — only a scalar `push_token`
+Parameter that anchors the layer into the backward pass (ids are
+integers; without a float input on the grad path, reverse-mode AD would
+never traverse the lookup and the push would not fire).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, Parameter, run_op
+from ... import nn
+
+__all__ = ['HeterEmbedding']
+
+
+class HeterEmbedding(nn.Layer):
+    """Embedding lookup whose table lives host-side in the embedding
+    service. Drop-in for nn.Embedding in jitted training steps."""
+
+    def __init__(self, client, table_id, embedding_dim, communicator=None,
+                 name=None):
+        super().__init__()
+        self.client = client
+        self.table_id = int(table_id)
+        self.dim = int(embedding_dim)
+        self.comm = communicator
+        from ...nn import initializer as init_mod
+        self.push_token = self.create_parameter(
+            shape=[1], default_initializer=init_mod.Constant(0.0))
+
+    def _pull_host(self, idsf):
+        ids = np.asarray(idsf).view(np.int32).reshape(-1).astype(np.int64)
+        rows = self.client.pull(self.table_id, ids)
+        return rows.astype(np.float32)
+
+    def _push_host(self, idsf, grads):
+        ids = np.asarray(idsf).view(np.int32).reshape(-1).astype(np.int64)
+        grads = np.asarray(grads).reshape(len(ids), self.dim)
+        if self.comm is not None:
+            self.comm.push_sparse_grad(self.table_id, ids, grads)
+        else:
+            self.client.push(self.table_id, ids, grads)
+
+    def forward(self, ids):
+        dim = self.dim
+        pull = self._pull_host
+        push = self._push_host
+        try:
+            from jax.experimental import io_callback
+        except ImportError:  # older layouts
+            from jax.experimental.io_callback import io_callback
+
+        @jax.custom_vjp
+        def lookup(idsf, token):
+            flat_n = int(np.prod(idsf.shape))
+            out = jax.pure_callback(
+                pull,
+                jax.ShapeDtypeStruct((flat_n, dim), jnp.float32),
+                idsf)
+            return out.reshape(idsf.shape + (dim,))
+
+        def fwd(idsf, token):
+            return lookup(idsf, token), idsf
+
+        def bwd(idsf, g):
+            io_callback(push, None, idsf, g.astype(jnp.float32),
+                        ordered=True)
+            return (jnp.zeros(idsf.shape, jnp.float32),
+                    jnp.zeros((1,), jnp.float32))
+
+        lookup.defvjp(fwd, bwd)
+
+        ids_t = ids if isinstance(ids, Tensor) else Tensor(ids)
+        # ids ride BITCAST to float32 (exact — a value cast would corrupt
+        # ids >= 2^24) so the custom bwd's cotangent types line up; the
+        # host side views the bits back as int32. In-process ids are
+        # int32 anyway (jax x64 disabled); the service keys are int64.
+        idsf = Tensor(jax.lax.bitcast_convert_type(
+            ids_t._data.astype(jnp.int32), jnp.float32))
+        return run_op('heter_embedding', lookup, idsf, self.push_token)

@@ -1,0 +1,153 @@
+"""Dataset-driven PS trainer (reference: framework/executor.cc:152
+Executor::RunFromDataset -> trainer.h:102 MultiTrainer ->
+device_worker.h:244 HogwildWorker / :275 DownpourWorker TrainFiles).
+
+TPU-native division of labor: worker threads drain the dataset channel;
+per batch they PULL the unique sparse ids' rows from the embedding
+service, run the dense half as ONE jitted fwd+bwd program (the device
+part — XLA replaces the per-op Hogwild loop), PUSH sparse grads through
+the communicator (async/half_async/sync/geo), and update the shared dense
+params Hogwild-style (lock-free, as HogwildWorker does). The model is a
+pooled-embedding CTR net: per-slot mean-pooled embeddings -> MLP ->
+sigmoid logloss (the reference's ctr_dnn fleet example shape).
+"""
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['DownpourTrainer']
+
+
+def _segment_mean_matrix(offsets, n_ids):
+    """[B, n_ids] CSR mean-pool matrix (host-built, tiny)."""
+    b = len(offsets) - 1
+    m = np.zeros((b, n_ids), np.float32)
+    for i in range(b):
+        lo, hi = offsets[i], offsets[i + 1]
+        if hi > lo:
+            m[i, lo:hi] = 1.0 / (hi - lo)
+    return m
+
+
+class DownpourTrainer:
+    """CTR trainer over sparse PS slots + local dense MLP.
+
+    client: EmbeddingClient (rows live host-side, maybe SSD-backed)
+    communicator: ps.communicator.Communicator (push mode semantics)
+    slots: sparse slot names (each has a table on the PS)
+    tables: {slot_name: table_id}
+    """
+
+    def __init__(self, client, communicator, slots, tables, emb_dim,
+                 hidden=32, lr=0.05, n_threads=2, seed=0,
+                 label_slot='label'):
+        self.client = client
+        self.comm = communicator
+        self.slots = list(slots)
+        self.tables = dict(tables)
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.n_threads = max(int(n_threads), 1)
+        self.label_slot = label_slot
+        rng = np.random.RandomState(seed)
+        d_in = emb_dim * len(self.slots)
+        # shared Hogwild dense params (numpy: lock-free in-place updates)
+        self.dense = {
+            'w1': rng.randn(d_in, hidden).astype(np.float32) * 0.1,
+            'b1': np.zeros(hidden, np.float32),
+            'w2': rng.randn(hidden, 1).astype(np.float32) * 0.1,
+            'b2': np.zeros(1, np.float32),
+        }
+        self._step = jax.jit(self._make_step())
+        self._losses = []
+        self._loss_lock = threading.Lock()
+
+    def _make_step(self):
+        n_slots = len(self.slots)
+        dim = self.emb_dim
+
+        def step(dense, pooled, labels):
+            """pooled: [B, n_slots, dim]; returns loss, d_pooled, d_dense."""
+            def loss_fn(dense, pooled):
+                x = pooled.reshape(pooled.shape[0], n_slots * dim)
+                h = jnp.tanh(x @ dense['w1'] + dense['b1'])
+                logit = (h @ dense['w2'] + dense['b2'])[:, 0]
+                # sigmoid cross-entropy (logloss)
+                return jnp.mean(jnp.maximum(logit, 0) - logit * labels +
+                                jnp.log1p(jnp.exp(-jnp.abs(logit))))
+            loss, (d_dense, d_pooled) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(dense, pooled)
+            return loss, d_pooled, d_dense
+        return step
+
+    def _train_one_batch(self, batch):
+        bsz = batch['__size__']
+        pooled = np.zeros((bsz, len(self.slots), self.emb_dim), np.float32)
+        slot_ctx = []
+        for s, name in enumerate(self.slots):
+            ids, offs = batch[name]
+            uniq, inv = np.unique(ids, return_inverse=True)
+            rows = self.client.pull(self.tables[name], uniq)  # [U, dim]
+            # mean-pool per instance
+            for i in range(bsz):
+                lo, hi = offs[i], offs[i + 1]
+                if hi > lo:
+                    pooled[i, s] = rows[inv[lo:hi]].mean(axis=0)
+            slot_ctx.append((ids, offs))
+
+        labels = batch[self.label_slot]
+        loss, d_pooled, d_dense = self._step(
+            {k: jnp.asarray(v) for k, v in self.dense.items()},
+            jnp.asarray(pooled), jnp.asarray(labels))
+        d_pooled = np.asarray(d_pooled)
+
+        # sparse push: distribute each instance's pooled grad to its ids
+        for s, name in enumerate(self.slots):
+            pos_ids, offs = slot_ctx[s]
+            n_pos = offs[-1]
+            if n_pos == 0:
+                continue
+            pos_grads = np.zeros((n_pos, self.emb_dim), np.float32)
+            for i in range(len(offs) - 1):
+                lo, hi = offs[i], offs[i + 1]
+                if hi > lo:
+                    pos_grads[lo:hi] = d_pooled[i, s] / (hi - lo)
+            if self.comm.mode == 'geo':
+                self.comm.push_sparse_param(self.tables[name], pos_ids,
+                                            -self.lr * pos_grads)
+            else:
+                self.comm.push_sparse_grad(self.tables[name], pos_ids,
+                                           pos_grads)
+
+        # Hogwild dense update (lock-free, HogwildWorker semantics)
+        for k, g in d_dense.items():
+            self.dense[k] -= self.lr * np.asarray(g)
+        return float(loss)
+
+    def train_from_dataset(self, dataset, epochs=1, debug=False):
+        """The Executor::RunFromDataset analog: drain the dataset channel
+        with n_threads workers; returns per-batch losses (in completion
+        order)."""
+        channel = dataset.start_channel(epochs=epochs)
+        self._losses = []
+
+        def worker():
+            while True:
+                item = channel.get()
+                if item is None:
+                    channel.put(None)  # wake siblings
+                    return
+                loss = self._train_one_batch(item)
+                with self._loss_lock:
+                    self._losses.append(loss)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(self.n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.comm.flush()
+        return list(self._losses)

@@ -247,6 +247,11 @@ class TrainStep:
                     'schedule' % type(model).__name__)
                 self._pp_state = pp_state = dict(pp_state,
                                                  schedule='gpipe')
+                if pp_state.get('n_micro_defaulted'):
+                    # undo the 1F1B-only 2*pp default: GPipe's minimum
+                    # n_micro is pp, and keeping 2*pp would tighten the
+                    # batch divisibility constraint for no benefit
+                    pp_state['n_micro'] = pp_state['n_stages']
 
         def pure_step(params, buffers, opt_state, batch, lr, key):
             inputs, labels = batch

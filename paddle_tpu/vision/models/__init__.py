@@ -4,3 +4,4 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (MobileNetV1, MobileNetV2, mobilenet_v1,  # noqa: F401
                         mobilenet_v2)
+from .yolo import PPYOLOv2, ppyolov2  # noqa: F401

@@ -54,15 +54,32 @@ def _from_saveable(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=_PROTOCOL, **configs):
+    """configs: encryption_key=... writes an AES-GCM (or HMAC-CTR
+    fallback) PTCRYPT1 container (reference framework/io/crypto
+    encrypted save)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    key = configs.get('encryption_key')
+    payload = pickle.dumps(_to_saveable(obj), protocol=protocol)
+    if key is not None:
+        from . import crypto
+        payload = crypto.encrypt(payload, key)
     with open(path, 'wb') as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+        f.write(payload)
 
 
 def load(path, **configs):
     return_numpy = configs.get('return_numpy', False)
+    key = configs.get('encryption_key')
     with open(path, 'rb') as f:
-        obj = pickle.load(f)
+        payload = f.read()
+    from . import crypto
+    if payload.startswith(crypto._MAGIC):
+        if key is None:
+            raise ValueError(
+                '%s is encrypted — pass encryption_key= to paddle.load'
+                % path)
+        payload = crypto.decrypt(payload, key)
+    obj = pickle.loads(payload)
     return _from_saveable(obj, return_numpy)

@@ -1,3 +1,5 @@
 from .optimizers import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
-                         Adagrad, Adadelta, RMSProp, Lamb, LarsMomentum)
+                         Adagrad, Adadelta, RMSProp, Lamb, LarsMomentum,
+                         Ftrl, Dpsgd, ProximalGD, ProximalAdagrad,
+                         SparseAdam)
 from . import lr  # noqa: F401

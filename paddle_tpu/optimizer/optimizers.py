@@ -13,7 +13,8 @@ from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
 __all__ = ['Optimizer', 'SGD', 'Momentum', 'Adam', 'AdamW', 'Adamax',
-           'Adagrad', 'Adadelta', 'RMSProp', 'Lamb', 'LarsMomentum']
+           'Adagrad', 'Adadelta', 'RMSProp', 'Lamb', 'LarsMomentum',
+           'Ftrl', 'Dpsgd', 'ProximalGD', 'ProximalAdagrad', 'SparseAdam']
 
 
 class Optimizer:
@@ -382,3 +383,120 @@ class LarsMomentum(Optimizer):
             lr * self._lars_coeff * w_norm / jnp.maximum(denom, 1e-30), lr)
         v = self._momentum * slots['velocity'] + local_lr * (g + wd * p)
         return p - v, {'velocity': v}
+
+
+class Ftrl(Optimizer):
+    """FTRL-Proximal (reference: operators/optimizers/ftrl_op.cc).
+    Accumulates squared grads (n) and a linear term (z); the closed-form
+    per-coordinate update applies L1/L2 shrinkage."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+        self._lr_power = float(lr_power)
+
+    def _init_slots(self, p):
+        return {'squared': jnp.zeros_like(p._data),
+                'linear': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        n, z = slots['squared'], slots['linear']
+        n_new = n + g * g
+        pw = -self._lr_power
+        sigma = (n_new ** pw - n ** pw) / lr
+        z_new = z + g - sigma * p
+        new_p = jnp.where(
+            jnp.abs(z_new) <= self._l1,
+            jnp.zeros_like(p),
+            (jnp.sign(z_new) * self._l1 - z_new) /
+            (n_new ** pw / lr + 2.0 * self._l2))
+        return new_p, {'squared': n_new, 'linear': z_new}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference: operators/optimizers/
+    dpsgd_op.cc): per-update L2 clipping + calibrated gaussian noise."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, weight_decay=None,
+                 grad_clip=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._clip = float(clip)
+        self._batch = float(batch_size)
+        self._sigma = float(sigma)
+        self._seed = int(seed)
+
+    def _apply(self, p, g, slots, lr, t):
+        import jax
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.minimum(1.0, self._clip / jnp.maximum(g_norm, 1e-30))
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed),
+            jnp.asarray(t, jnp.int32).astype(jnp.uint32))
+        noise = jax.random.normal(key, g.shape, g.dtype) * \
+            (self._sigma * self._clip)
+        g_priv = (g * scale + noise / self._batch)
+        return p - lr * g_priv, {}
+
+
+class ProximalGD(Optimizer):
+    """Proximal gradient descent with L1/L2 (reference:
+    operators/optimizers/proximal_gd_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1 = float(l1)
+        self._l2 = float(l2)
+
+    def _prox(self, w, lr):
+        shrunk = jnp.sign(w) * jnp.maximum(
+            jnp.abs(w) - lr * self._l1, 0.0)
+        return shrunk / (1.0 + lr * self._l2)
+
+    def _apply(self, p, g, slots, lr, t):
+        return self._prox(p - lr * g, lr), {}
+
+
+class ProximalAdagrad(ProximalGD):
+    """Adagrad step + proximal L1/L2 shrinkage (reference:
+    operators/optimizers/proximal_adagrad_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, l1, l2, parameters, weight_decay,
+                         grad_clip)
+        self._epsilon = float(epsilon)
+
+    def _init_slots(self, p):
+        return {'moment': jnp.zeros_like(p._data)}
+
+    def _apply(self, p, g, slots, lr, t):
+        mom = slots['moment'] + g * g
+        eff = lr / (jnp.sqrt(mom) + self._epsilon)
+        return self._prox(p - eff * g, lr), {'moment': mom}
+
+
+class SparseAdam(Adam):
+    """Row-sparse-aware Adam (reference: adam_op.cc lazy_mode): moments
+    update only where the grad is nonzero, so untouched embedding rows
+    keep their state frozen instead of decaying every step."""
+
+    def _apply(self, p, g, slots, lr, t):
+        touched = jnp.any(g != 0, axis=tuple(range(1, g.ndim)),
+                          keepdims=True) if g.ndim > 1 else (g != 0)
+        b1 = self._beta1() if callable(self._beta1) else self._beta1
+        b2 = self._beta2() if callable(self._beta2) else self._beta2
+        m = jnp.where(touched, b1 * slots['moment1'] + (1 - b1) * g,
+                      slots['moment1'])
+        v = jnp.where(touched, b2 * slots['moment2'] + (1 - b2) * g * g,
+                      slots['moment2'])
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p - jnp.where(touched, upd, 0.0), \
+            {'moment1': m, 'moment2': v}

@@ -8,12 +8,12 @@ pull rows for the batch's unique ids, device computes dense grads, workers
 push grads back and the server applies the optimizer server-side (same
 division of labor as the reference's DownpourWorker + CommonSparseTable).
 
-Transport: in-process for single-host; TCP socket protocol (pickle frames)
-for multi-host — brpc's role, without the dependency. Server-side optimizer
+Transport: in-process for single-host; TCP socket protocol with a typed
+binary codec (wire.py — no pickle on the socket, closed type set) for
+multi-host — brpc+protobuf's role, without the dependency. Server-side optimizer
 appliers mirror table/depends/sparse.h (sgd/adagrad/adam).
 """
 import os
-import pickle
 import socket
 import socketserver
 import struct
@@ -122,7 +122,10 @@ class EmbeddingTable:
 # -- socket RPC (multi-host path) ------------------------------------------
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
+    # typed wire codec, NOT pickle: unpickling peer bytes would be
+    # remote code execution by design (see wire.py)
+    from . import wire
+    payload = wire.encode(obj)
     sock.sendall(struct.pack('>Q', len(payload)) + payload)
 
 
@@ -140,7 +143,8 @@ def _recv_msg(sock):
         if not chunk:
             raise ConnectionError('peer closed')
         buf.extend(chunk)
-    return pickle.loads(bytes(buf))
+    from . import wire
+    return wire.decode(bytes(buf))
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -213,6 +217,7 @@ class EmbeddingServer:
         self._srv.daemon_threads = True
         self._srv.embedding_server = self
         self.port = self._srv.server_address[1]
+        self.endpoint = '%s:%d' % (host, self.port)
         self._thread = None
 
     def create_table(self, table_id, dim, table_class=None, **kwargs):

@@ -77,7 +77,8 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level='INFO'):
             mp_degree=hc.get('mp_degree', 1),
             pp_degree=hc.get('pp_degree', 1),
             sharding_degree=hc.get('sharding_degree', 1),
-            sp_degree=hc.get('sp_degree', 1))
+            sp_degree=hc.get('sp_degree', 1),
+            ep_degree=hc.get('ep_degree', 1))
     except ValueError:
         # degrees don't match the device count: fall back to pure DP
         hcg = HybridCommunicateGroup(dp_degree=-1)

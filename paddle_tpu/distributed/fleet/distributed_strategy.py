@@ -45,7 +45,8 @@ class DistributedStrategy:
         self.sequence_parallel_configs = _Cfg(sequence_parallel_degree=1,
                                               mode='ring')
         self.hybrid_configs = _Cfg(dp_degree=-1, mp_degree=1, pp_degree=1,
-                                   sharding_degree=1, sp_degree=1)
+                                   sharding_degree=1, sp_degree=1,
+                                   ep_degree=1)
         self.lamb = False
         self.lamb_configs = _Cfg(lamb_weight_decay=0.01)
         self.lars = False

@@ -54,9 +54,15 @@ def build_shardings(model, optimizer, mesh, strategy=None):
     param_shardings = {}
     for name, arr in params.items():
         placement = getattr(pmap[name], 'placement', None)
-        has_mp = 'mp' in mesh.axis_names and mesh.shape.get('mp', 1) > 1
-        if placement and not has_mp:
-            placement = None
+        if placement:
+            # keep only axes the mesh actually parallelizes (mp for TP
+            # layers, ep for expert-stacked MoE params, ...)
+            placement = tuple(
+                ax if (ax in mesh.axis_names
+                       and mesh.shape.get(ax, 1) > 1) else None
+                for ax in placement)
+            if not any(placement):
+                placement = None
         spec = _param_spec(placement, arr.ndim, strategy, name)
         # avoid sharding axes not divisible
         dims = []

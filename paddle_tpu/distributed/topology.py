@@ -3,8 +3,9 @@ CommunicateTopology + :117 HybridCommunicateGroup, 4-D [data, pipe, sharding,
 model] mesh).
 
 TPU-native: the topology IS a jax.sharding.Mesh. Axes (outer->inner):
-  dp (data), pp (pipeline), sharding (ZeRO), mp (tensor), sp (sequence).
-sp is beyond-reference (SURVEY.md §5.7 requires it). Axis order puts mp/sp
+  dp (data), pp (pipeline), sharding (ZeRO), ep (experts), mp (tensor),
+  sp (sequence). sp and ep are beyond-reference (SURVEY.md §5.7 and §2.2
+  note their absence; the capability bar includes them). Axis order puts mp/sp
 innermost so tensor/sequence collectives ride the fastest ICI links.
 """
 import collections
@@ -14,7 +15,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
-_AXES = ('dp', 'pp', 'sharding', 'mp', 'sp')
+_AXES = ('dp', 'pp', 'sharding', 'ep', 'mp', 'sp')
 
 
 class CommunicateTopology:
@@ -48,18 +49,16 @@ class HybridCommunicateGroup:
     compiler."""
 
     def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
-                 sharding_degree=1, sp_degree=1, devices=None):
+                 sharding_degree=1, sp_degree=1, ep_degree=1, devices=None):
         devices = devices if devices is not None else jax.devices()
         n = len(devices)
         degrees = {'dp': dp_degree, 'pp': pp_degree,
                    'sharding': sharding_degree, 'mp': mp_degree,
-                   'sp': sp_degree}
+                   'sp': sp_degree, 'ep': ep_degree}
         specified = int(np.prod([max(1, d) for d in degrees.values()]))
         if dp_degree in (0, -1, None):
-            degrees['dp'] = n // (specified // max(1, dp_degree or 1)) \
-                if specified else n
             rest = int(np.prod([max(1, degrees[a]) for a in
-                                ('pp', 'sharding', 'mp', 'sp')]))
+                                ('pp', 'sharding', 'ep', 'mp', 'sp')]))
             degrees['dp'] = max(1, n // rest)
         total = int(np.prod([max(1, degrees[a]) for a in _AXES]))
         if total != n:
@@ -88,6 +87,12 @@ class HybridCommunicateGroup:
 
     def get_sequence_parallel_world_size(self):
         return self._degrees['sp']
+
+    def get_expert_parallel_world_size(self):
+        return self._degrees['ep']
+
+    def get_expert_parallel_group(self):
+        return Group('ep', self._degrees['ep'])
 
     def get_data_parallel_rank(self):
         return 0

@@ -1,0 +1,104 @@
+"""Expert-parallel Mixture-of-Experts (beyond-reference: SURVEY.md §2.2
+notes MoE/expert parallelism is absent from the reference snapshot but in
+the capability bar; later paddle grew incubate.distributed.models.moe).
+
+TPU-native shape (Switch Transformer style): expert FFN params are STACKED
+[E, ...] and placed on the 'ep' mesh axis; token dispatch/combine are
+einsums against a [tokens, E, capacity] one-hot, so XLA's SPMD partitioner
+inserts the all_to_alls when the token dim resharding meets the
+expert-sharded weights — no hand-written collectives (SURVEY §7.1: let the
+compiler place comm). Capacity overflow drops tokens (residual passthrough
+keeps them alive), and the Switch load-balancing aux loss is recorded on
+the layer for the model loss to pick up.
+"""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.core import Tensor, run_op
+from ..nn import functional as F
+
+__all__ = ['SwitchMoE']
+
+
+class SwitchMoE(nn.Layer):
+    """Top-1 routed MoE FFN block: y = combine(expert_ffn(dispatch(x))).
+
+    hidden_size -> ffn_size -> hidden_size per expert; num_experts experts
+    sharded over the 'ep' mesh axis when present (placement hints consumed
+    by distributed/strategy.py).
+    """
+
+    def __init__(self, hidden_size, ffn_size=None, num_experts=4,
+                 capacity_factor=1.5, aux_loss_weight=0.01, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.ffn_size = ffn_size or 4 * hidden_size
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        self.aux_loss_weight = float(aux_loss_weight)
+        self.gate = nn.Linear(hidden_size, num_experts)
+        e, h, f = num_experts, hidden_size, self.ffn_size
+        from ..nn import initializer as init_mod
+        self.w1 = self.create_parameter(
+            [e, h, f],
+            default_initializer=init_mod.Normal(std=1.0 / math.sqrt(h)))
+        self.b1 = self.create_parameter([e, f], is_bias=True)
+        self.w2 = self.create_parameter(
+            [e, f, h],
+            default_initializer=init_mod.Normal(std=1.0 / math.sqrt(f)))
+        self.b2 = self.create_parameter([e, h], is_bias=True)
+        # expert dim rides the 'ep' mesh axis
+        self.w1.placement = ('ep', None, None)
+        self.b1.placement = ('ep', None)
+        self.w2.placement = ('ep', None, None)
+        self.b2.placement = ('ep', None)
+        self.aux_loss = None
+
+    def forward(self, x):
+        """x [B, S, H] (or [T, H]) -> same shape."""
+        e = self.num_experts
+        gate_logits = self.gate(x)  # [..., E]
+
+        def fn(xa, ga, w1, b1, w2, b2):
+            shape = xa.shape
+            xt = xa.reshape(-1, shape[-1])            # [T, H]
+            gl = ga.reshape(-1, e)                    # [T, E]
+            t = xt.shape[0]
+            cap = max(1, int(self.capacity_factor * t / e))
+
+            probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
+            top_p = jnp.max(probs, axis=-1)           # [T]
+            top_e = jnp.argmax(probs, axis=-1)        # [T]
+
+            onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)  # [T,E]
+            pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # [T,E]
+            in_cap = (pos < cap) & (pos >= 0)
+            pos_cl = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+            cap_oh = jax.nn.one_hot(pos_cl, cap, dtype=jnp.float32)
+            dispatch = cap_oh * in_cap[..., None]     # [T, E, C]
+            combine = dispatch * top_p[:, None, None]
+
+            xin = jnp.einsum('tec,th->ech', dispatch,
+                             xt.astype(jnp.float32))
+            h1 = jax.nn.gelu(
+                jnp.einsum('ech,ehf->ecf', xin, w1.astype(jnp.float32))
+                + b1.astype(jnp.float32)[:, None])
+            out_e = jnp.einsum('ecf,efh->ech', h1,
+                               w2.astype(jnp.float32)) \
+                + b2.astype(jnp.float32)[:, None]
+            y = jnp.einsum('tec,ech->th', combine, out_e)
+
+            # Switch aux loss: E * sum_e frac_tokens_e * mean_prob_e
+            frac = jnp.mean(onehot, axis=0)
+            mean_p = jnp.mean(probs, axis=0)
+            aux = e * jnp.sum(frac * mean_p)
+            return y.reshape(shape).astype(xa.dtype), aux
+
+        y, aux = run_op('switch_moe', fn, x, gate_logits,
+                        self.w1, self.b1, self.w2, self.b2)
+        self.aux_loss = aux
+        return y

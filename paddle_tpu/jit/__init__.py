@@ -22,6 +22,10 @@ from ..static.input_spec import InputSpec
 __all__ = ['to_static', 'save', 'load', 'TranslatedLayer', 'not_to_static',
            'ignore_module']
 
+# bump the MAJOR on breaking artifact-layout changes; loads refuse a
+# newer major and warn on an older one (forward-compat contract)
+_FORMAT_VERSION = (1, 0)
+
 
 class StaticFunction:
     """Wraps a function/method: first call traces+compiles, later calls hit
@@ -207,7 +211,15 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + '.pdiparams', 'wb') as f:
         pickle.dump(state, f, protocol=4)
 
-    meta = {'input_spec': None, 'stablehlo': None}
+    # artifact versioning (reference: framework/op_version_registry.h +
+    # framework/version.cc — saved programs carry versions and loads check
+    # compatibility)
+    import jax as _jax
+    from .. import __version__ as _fw_version
+    meta = {'input_spec': None, 'stablehlo': None,
+            'format_version': _FORMAT_VERSION,
+            'framework_version': _fw_version,
+            'jax_version': _jax.__version__}
     if input_spec:
         specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
                  for s in input_spec]
@@ -302,6 +314,17 @@ def load(path, **configs):
         state = pickle.load(f)
     with open(path + '.pdmodel', 'rb') as f:
         model_payload = pickle.load(f)
+    fmt = (model_payload.get('meta') or {}).get('format_version')
+    if fmt is not None and tuple(fmt)[0] > _FORMAT_VERSION[0]:
+        raise RuntimeError(
+            'artifact %s was saved by a NEWER framework (format %s, this '
+            'build reads %s) — upgrade paddle_tpu to load it'
+            % (path, tuple(fmt), _FORMAT_VERSION))
+    if fmt is not None and tuple(fmt)[0] < _FORMAT_VERSION[0]:
+        import warnings
+        warnings.warn('artifact %s uses the older format %s (current %s); '
+                      'loading with best-effort compatibility'
+                      % (path, tuple(fmt), _FORMAT_VERSION))
     layer = None
     if model_payload.get('arch') is not None:
         layer = pickle.loads(model_payload['arch'])

@@ -23,7 +23,7 @@ class GPTConfig:
                  max_position_embeddings=1024, dropout=0.1,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_rmsnorm=False, tie_word_embeddings=True,
-                 recompute=False):
+                 recompute=False, num_experts=0, moe_capacity_factor=1.5):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -36,6 +36,10 @@ class GPTConfig:
         self.use_rmsnorm = use_rmsnorm
         self.tie_word_embeddings = tie_word_embeddings
         self.recompute = recompute
+        # num_experts > 0 swaps each block's MLP for an expert-parallel
+        # SwitchMoE (incubate/moe.py) routed over the 'ep' mesh axis
+        self.num_experts = num_experts
+        self.moe_capacity_factor = moe_capacity_factor
 
     @staticmethod
     def gpt2_small():
@@ -108,7 +112,14 @@ class GPTBlock(nn.Layer):
         self.ln_1 = Norm(config.hidden_size, config.layer_norm_epsilon)
         self.attn = GPTAttention(config)
         self.ln_2 = Norm(config.hidden_size, config.layer_norm_epsilon)
-        self.mlp = GPTMLP(config)
+        if getattr(config, 'num_experts', 0):
+            from ...incubate.moe import SwitchMoE
+            self.mlp = SwitchMoE(
+                config.hidden_size, config.intermediate_size,
+                num_experts=config.num_experts,
+                capacity_factor=config.moe_capacity_factor)
+        else:
+            self.mlp = GPTMLP(config)
 
     def forward(self, x):
         x = x + self.attn(self.ln_1(x))
@@ -144,6 +155,14 @@ class GPTModel(nn.Layer):
         x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
         from ...distributed import pipeline as pp_mod
         pp_state = pp_mod.pipeline_state()
+        moe = getattr(self.config, 'num_experts', 0) > 0
+        if moe and self.training and (pp_state is not None
+                                      or self._recompute):
+            # the aux-loss tracer would escape the checkpoint/shard_map
+            # trace it was created in
+            raise NotImplementedError(
+                'MoE blocks do not compose with recompute or pipeline '
+                'parallelism yet (aux-loss routing) — disable one of them')
         if pp_state is not None and self.training:
             # GPipe over the 'pp' mesh axis: embeddings above and ln_f/head
             # below stay replicated over pp; the block stack is the
@@ -156,6 +175,16 @@ class GPTModel(nn.Layer):
         else:
             for block in self.h:
                 x = block(x)
+        # collect MoE load-balancing aux losses for GPTForCausalLM.loss
+        # (training only: eval perplexity must not carry the balance term)
+        self._moe_aux = None
+        if self.training:
+            for block in self.h:
+                aux = getattr(block.mlp, 'aux_loss', None)
+                if aux is not None:
+                    term = aux * block.mlp.aux_loss_weight
+                    self._moe_aux = term if self._moe_aux is None \
+                        else self._moe_aux + term
         return self.ln_f(x)
 
 
@@ -213,8 +242,13 @@ class GPTForCausalLM(nn.Layer):
 
     def loss(self, logits, labels):
         b, n, v = logits.shape
-        return F.cross_entropy(M.reshape(logits, [b * n, v]),
-                               M.reshape(labels, [b * n]))
+        ce = F.cross_entropy(M.reshape(logits, [b * n, v]),
+                             M.reshape(labels, [b * n]))
+        aux = getattr(self.gpt, '_moe_aux', None)
+        self.gpt._moe_aux = None  # consume once — never stale across calls
+        if aux is not None:
+            ce = ce + aux
+        return ce
 
     def num_params(self):
         import numpy as np

@@ -83,3 +83,27 @@ def test_predictor_named_inputs_and_validation(tmp_path):
     out = predictor.get_output_handle('output_0').copy_to_cpu()
     np.testing.assert_allclose(out, model(paddle.to_tensor(x)).numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_jit_artifact_version_gate(tmp_path):
+    """Saved programs carry format/framework versions; a newer-major
+    artifact refuses to load (reference op_version_registry compat)."""
+    import pickle
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    import paddle_tpu.nn as nn
+
+    model = nn.Linear(4, 2)
+    path = str(tmp_path / 'm')
+    jit.save(model, path)
+    with open(path + '.pdmodel', 'rb') as f:
+        payload = pickle.load(f)
+    assert payload['meta']['format_version'] == jit._FORMAT_VERSION
+    assert payload['meta']['framework_version'] == paddle.__version__
+
+    payload['meta']['format_version'] = (jit._FORMAT_VERSION[0] + 1, 0)
+    with open(path + '.pdmodel', 'wb') as f:
+        pickle.dump(payload, f)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match='NEWER framework'):
+        jit.load(path)

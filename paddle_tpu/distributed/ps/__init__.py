@@ -12,4 +12,4 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            GeoCommunicator)
 from .dataset import MultiSlotDataset  # noqa: F401
 from .trainer import DownpourTrainer  # noqa: F401
-from .heter import HeterEmbedding  # noqa: F401
+from .heter import HeterEmbedding, PassCachedEmbedding  # noqa: F401

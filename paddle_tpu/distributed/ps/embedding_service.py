@@ -98,6 +98,16 @@ class EmbeddingTable:
                 if new_slots:
                     self._slots[key] = new_slots
 
+    def push_delta(self, ids, deltas):
+        """Apply raw parameter deltas (geo-SGD sends / PSGPU end-pass
+        flush): rows += delta, bypassing the server optimizer."""
+        with self._lock:
+            for key, d in zip(ids, deltas):
+                row = self._rows.get(key)
+                if row is None:
+                    continue
+                self._rows[key] = row + np.asarray(d, row.dtype)
+
     def __len__(self):
         return len(self._rows)
 

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, Parameter, run_op
 from ... import nn
 
-__all__ = ['HeterEmbedding']
+__all__ = ['HeterEmbedding', 'PassCachedEmbedding']
 
 
 class HeterEmbedding(nn.Layer):
@@ -94,3 +94,79 @@ class HeterEmbedding(nn.Layer):
         idsf = Tensor(jax.lax.bitcast_convert_type(
             ids_t._data.astype(jnp.int32), jnp.float32))
         return run_op('heter_embedding', lookup, idsf, self.push_token)
+
+class PassCachedEmbedding(nn.Layer):
+    """PSGPU/HeterPs analog (reference: framework/fleet/ps_gpu_wrapper.h:50
+    BuildPull/EndPass, heter_ps/heter_comm.h:50): per training PASS, the
+    pass's unique ids' rows are pulled ONCE into an HBM-resident table that
+    trains at device speed as an ordinary Parameter (the device optimizer
+    updates it inside the jitted step — the on-accelerator optimizer of
+    heter_ps/optimizer.cuh.h); end_pass() pushes the accumulated deltas
+    back to the host service. Data feeding remaps global ids to pass-local
+    slots host-side (lookup_slots), mirroring the reference's pass build
+    converting keys to local indices.
+
+    Use when the working set per pass fits HBM but the full table does not
+    — the complement of HeterEmbedding's per-step exchange."""
+
+    def __init__(self, client, table_id, embedding_dim, name=None):
+        super().__init__()
+        self.client = client
+        self.table_id = int(table_id)
+        self.dim = int(embedding_dim)
+        self.table = None          # device Parameter during a pass
+        self._ids = None
+        self._slot_of = None
+        self._base = None
+
+    def begin_pass(self, ids):
+        """Pull the pass working set into HBM."""
+        ids = np.unique(np.asarray(ids).reshape(-1).astype(np.int64))
+        rows = self.client.pull(self.table_id, ids)
+        self._ids = ids
+        self._slot_of = {int(i): s for s, i in enumerate(ids)}
+        self._base = rows.copy()
+        self.table = Parameter(rows.astype(np.float32))
+        # re-register so named_parameters picks the fresh table up
+        self._parameters['table'] = self.table
+        return len(ids)
+
+    def lookup_slots(self, ids):
+        """Global ids -> pass-local slot ids (host-side feed remap)."""
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1)
+        out = np.empty(flat.shape, np.int32)
+        for i, v in enumerate(flat):
+            try:
+                out[i] = self._slot_of[int(v)]
+            except KeyError:
+                raise KeyError('id %d not in the current pass working set '
+                               '(call begin_pass with every id the pass '
+                               'will touch)' % int(v))
+        return out.reshape(ids.shape)
+
+    def forward(self, slot_ids):
+        """slot_ids from lookup_slots -> rows [..., dim]."""
+        if self.table is None:
+            raise RuntimeError('begin_pass() before training')
+        t = slot_ids if isinstance(slot_ids, Tensor) else Tensor(slot_ids)
+
+        def fn(table, s):
+            return table[s]
+        return run_op('pass_cached_embedding', fn, self.table, t)
+
+    def end_pass(self):
+        """Push the pass's training deltas back to the host table."""
+        if self.table is None:
+            return 0
+        new = np.asarray(self.table.numpy(), np.float32)
+        delta = new - self._base
+        touched = np.abs(delta).sum(axis=1) > 0
+        if touched.any():
+            self.client.push_delta(self.table_id, self._ids[touched],
+                                   delta[touched])
+        n = int(touched.sum())
+        self.table = None
+        self._parameters.pop('table', None)
+        self._ids = self._slot_of = self._base = None
+        return n

@@ -241,3 +241,59 @@ def test_data_generator_roundtrips_with_dataset(tmp_path):
     np.testing.assert_array_equal(ids0, [1, 2, 3])
     np.testing.assert_array_equal(offs0, [0, 2, 3])
     np.testing.assert_array_equal(batch['label'], [1.0, 0.0])
+
+
+def test_pass_cached_embedding_trains_on_device_and_flushes():
+    """PSGPU analog (ps_gpu_wrapper BuildPull/EndPass): pass working set
+    pulled to HBM, trained as a device Parameter, deltas flushed back."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.distributed.ps.heter import PassCachedEmbedding
+    from paddle_tpu.framework import functional as func_mod
+
+    server = EmbeddingServer()
+    server.create_table(0, dim=4, optimizer='sgd', lr=1.0, init_scale=0.1)
+    client = EmbeddingClient(servers=[server])
+
+    paddle.seed(3)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = PassCachedEmbedding(client, 0, 4)
+            self.fc = nn.Linear(4, 1)
+
+        def forward(self, slots):
+            from paddle_tpu.tensor import math as tmath
+            return self.fc(tmath.mean(self.emb(slots), axis=1))
+
+    net = Net()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (64, 3)).astype(np.int64)
+    y = (ids.min(axis=1, keepdims=True) < 20).astype(np.float32)
+
+    n = net.emb.begin_pass(ids)
+    assert n == len(np.unique(ids))
+    before = client.pull(0, np.unique(ids)).copy()
+
+    opt = paddle.optimizer.SGD(learning_rate=0.3,
+                               parameters=net.parameters())
+    step = func_mod.TrainStep(
+        net, lambda lg, lb: F.binary_cross_entropy_with_logits(lg, lb),
+        opt, donate=False)
+    slots = paddle.to_tensor(net.emb.lookup_slots(ids))
+    y_t = paddle.to_tensor(y)
+    losses = [float(step(slots, y_t).numpy()) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.9
+
+    pushed = net.emb.end_pass()
+    assert pushed > 0
+    after = client.pull(0, np.unique(ids))
+    assert not np.allclose(before, after)  # deltas landed host-side
+    assert net.emb.table is None           # HBM released
+
+    # out-of-working-set id fails loudly at feed remap
+    net.emb.begin_pass(ids)
+    import pytest as _pytest
+    with _pytest.raises(KeyError, match='working set'):
+        net.emb.lookup_slots(np.asarray([999]))

@@ -1,11 +1,53 @@
-"""paddle.distributed.spawn (reference: distributed/spawn.py:333).
+"""paddle.distributed.spawn (reference: distributed/spawn.py:333 +
+fleet/launch_utils.py env contract).
 
 On TPU, one process drives all local chips (single-controller SPMD), so
-nprocs defaults to 1 process and spawn degenerates to calling func; true
-multi-host spawn goes through `python -m paddle_tpu.distributed.launch`.
+nprocs<=1 degenerates to calling func inline; nprocs>1 spawns real
+processes with the reference's env contract (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_CURRENT_ENDPOINT, PADDLE_TRAINER_ENDPOINTS)
+— the per-rank bootstrap a jax.distributed.initialize picks up on
+multi-host. Failures propagate with the failing rank's traceback text
+(launch_utils TrainerProc watch-loop behavior).
 """
 import multiprocessing as mp
 import os
+import traceback
+
+__all__ = ['spawn', 'SpawnContext']
+
+
+class SpawnContext:
+    def __init__(self, procs, error_queue):
+        self.processes = procs
+        self._errors = error_queue
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        failures = []
+        while not self._errors.empty():
+            failures.append(self._errors.get())
+        for p in self.processes:
+            if p.exitcode not in (0, None):
+                rank_tb = next((tb for r, tb in failures), None)
+                raise RuntimeError(
+                    'spawned rank failed (exitcode %s)%s'
+                    % (p.exitcode,
+                       (':\n' + rank_tb) if rank_tb else ''))
+        return True
+
+
+def _free_ports(n):
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
@@ -13,22 +55,31 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
         func(*args)
         return None
     ctx = mp.get_context('spawn')
+    error_queue = ctx.SimpleQueue()
+    ports = _free_ports(nprocs)
+    endpoints = ','.join('127.0.0.1:%d' % p for p in ports)
     procs = []
     for rank in range(nprocs):
         env = {'PADDLE_TRAINER_ID': str(rank),
-               'PADDLE_TRAINERS_NUM': str(nprocs)}
-        p = ctx.Process(target=_wrap, args=(func, args, env), daemon=daemon)
+               'PADDLE_TRAINERS_NUM': str(nprocs),
+               'PADDLE_CURRENT_ENDPOINT': '127.0.0.1:%d' % ports[rank],
+               'PADDLE_TRAINER_ENDPOINTS': endpoints}
+        p = ctx.Process(target=_wrap,
+                        args=(func, args, env, rank, error_queue),
+                        daemon=daemon)
         p.start()
         procs.append(p)
+    context = SpawnContext(procs, error_queue)
     if join:
-        for p in procs:
-            p.join()
-        for p in procs:
-            if p.exitcode != 0:
-                raise RuntimeError('spawned process failed: %s' % p.exitcode)
-    return procs
+        context.join()
+        return None
+    return context
 
 
-def _wrap(func, args, env):
+def _wrap(func, args, env, rank, error_queue):
     os.environ.update(env)
-    func(*args)
+    try:
+        func(*args)
+    except Exception:
+        error_queue.put((rank, traceback.format_exc()))
+        raise

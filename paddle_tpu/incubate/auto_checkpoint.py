@@ -1,0 +1,99 @@
+"""Auto-checkpoint / epoch-level resume (reference:
+fluid/incubate/checkpoint/auto_checkpoint.py — TrainEpochRange (:265)
+wraps the epoch loop, periodically snapshots training state keyed by job
+id, and on restart resumes at the last saved epoch; configured via
+PADDLE_* env).
+
+TPU-native: one snapshot layer (framework io_save / orbax-backed
+distributed checkpoint) holds {epoch, model state_dict, optimizer state};
+gang-scheduled TPU jobs restart whole, so epoch-granular resume is the
+first-class recovery path (SURVEY.md §5.3).
+"""
+import os
+import re
+
+from ..framework import io_save
+
+__all__ = ['TrainEpochRange', 'train_epoch_range']
+
+_CKPT_RE = re.compile(r'^epoch_(\d+)\.ckpt$')
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(20, 'job1', model=m, optimizer=opt):
+    — resumes from the newest snapshot in checkpoint_dir and saves one
+    every `save_checkpoint_inter` epochs (after the epoch body ran)."""
+
+    def __init__(self, max_epoch_num, name=None, checkpoint_dir=None,
+                 save_checkpoint_inter=1, model=None, optimizer=None,
+                 extra_state=None, keep_last=3):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name or os.environ.get('PADDLE_JOB_ID', 'acp_job')
+        self.dir = checkpoint_dir or os.environ.get(
+            'PADDLE_CHECKPOINT_DIR', './acp_checkpoints')
+        self.dir = os.path.join(self.dir, self.name)
+        self.inter = max(int(save_checkpoint_inter), 1)
+        self.model = model
+        self.optimizer = optimizer
+        self.extra_state = extra_state if extra_state is not None else {}
+        self.keep_last = int(keep_last)
+        self.restored_epoch = -1
+        self._restore()
+
+    # -- snapshot plumbing ---------------------------------------------------
+    def _epochs_on_disk(self):
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            m = _CKPT_RE.match(n)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def _path(self, epoch):
+        return os.path.join(self.dir, 'epoch_%d.ckpt' % epoch)
+
+    def _restore(self):
+        epochs = self._epochs_on_disk()
+        if not epochs:
+            return
+        epoch = epochs[-1]
+        payload = io_save.load(self._path(epoch))
+        if self.model is not None and 'model' in payload:
+            self.model.set_state_dict(payload['model'])
+        if self.optimizer is not None and 'optimizer' in payload:
+            self.optimizer.set_state_dict(payload['optimizer'])
+        self.extra_state.update(payload.get('extra', {}))
+        self.restored_epoch = epoch
+
+    def save(self, epoch):
+        payload = {'epoch': epoch, 'extra': dict(self.extra_state)}
+        if self.model is not None:
+            payload['model'] = self.model.state_dict()
+        if self.optimizer is not None:
+            payload['optimizer'] = self.optimizer.state_dict()
+        io_save.save(payload, self._path(epoch))
+        for old in self._epochs_on_disk()[:-self.keep_last]:
+            try:
+                os.remove(self._path(old))
+            except OSError:
+                pass
+
+    # -- the epoch loop ------------------------------------------------------
+    def __iter__(self):
+        start = self.restored_epoch + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self.inter == 0 or \
+                    epoch == self.max_epoch_num - 1:
+                self.save(epoch)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, **kwargs):
+    """Generator form (reference acp.train_epoch_range)."""
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter,
+                           **kwargs)

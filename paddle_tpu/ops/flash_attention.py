@@ -27,6 +27,8 @@ _NEG_INF = -1e30
 
 
 def is_available():
+    if os.environ.get('PADDLE_TPU_FLASH_DISABLE', '0') == '1':
+        return False  # explicit off-switch (bench retry safety valve)
     if interpret_mode():
         return True
     try:

@@ -49,3 +49,6 @@ class autograd:
                     for a in arrays]
         out, jvp_val = jax.jvp(fn, tuple(arrays), tuple(tangents))
         return Tensor(out), Tensor(jvp_val)
+from . import optimizer  # noqa: F401
+from . import moe  # noqa: F401
+from . import auto_checkpoint  # noqa: F401

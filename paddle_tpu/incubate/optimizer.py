@@ -1,0 +1,155 @@
+"""Optimizer wrappers (reference: fluid/optimizer.py — LookaheadOptimizer
+:5969, ModelAverage :3573, ExponentialMovingAverage :3882; modern paddle
+re-exposes them under paddle.incubate.optimizer).
+
+Each wraps an inner optimizer/parameter list and keeps shadow state in
+host-controlled jax arrays — functional updates, no in-place mutation of
+live math.
+"""
+import jax.numpy as jnp
+
+from ..framework.core import no_grad_guard
+
+__all__ = ['LookAhead', 'ModelAverage', 'ExponentialMovingAverage']
+
+
+class LookAhead:
+    """k fast steps, then slow weights pull toward fast by alpha
+    (Lookahead Optimizer; reference LookaheadOptimizer)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    @no_grad_guard()
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def state_dict(self):
+        return {'inner': self.inner_optimizer.state_dict(),
+                'steps': self._steps}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state.get('inner', {}))
+        self._steps = state.get('steps', 0)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running average of parameters over a window; apply()/restore()
+    context swaps the averaged weights in for evaluation (reference
+    ModelAverageOptimizer min/max_average_window semantics, simplified to
+    a cumulative window)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._data) for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    @no_grad_guard()
+    def step(self):
+        """Accumulate after the inner optimizer stepped."""
+        for p in self._params:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        if self._count == 0:
+            return
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._sum[id(p)] / self._count
+        return _RestoreCtx(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class _RestoreCtx:
+    def __init__(self, ma):
+        self._ma = ma
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters: shadow = decay*shadow + (1-decay)*param, with
+    the reference's bias-corrected thres_steps-free form; apply()/
+    restore() swap shadows in for eval (reference
+    ExponentialMovingAverage :3882)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        self._decay = float(decay)
+        self._params = list(parameters or [])
+        self._shadow = {id(p): p._data for p in self._params}
+        self._step = 0
+        self._backup = None
+
+    @no_grad_guard()
+    def update(self):
+        self._step += 1
+        # Adam-style bias-corrected dynamic decay (reference uses
+        # min(decay, (1+t)/(10+t)) when thres_steps is set; keep static)
+        d = self._decay
+        for p in self._params:
+            self._shadow[id(p)] = d * self._shadow[id(p)] + \
+                (1.0 - d) * p._data
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._shadow[id(p)]
+        return _RestoreCtx2(self) if need_restore else None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._params:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+
+class _RestoreCtx2(_RestoreCtx):
+    def __init__(self, ema):
+        self._ma = ema

@@ -1,2 +1,5 @@
-from .bert import BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401
+from .bert import (BertModel, BertForSequenceClassification,  # noqa: F401
+                   BertForPretraining, ErnieModel,
+                   ErnieForSequenceClassification, ErnieForPretraining,
+                   ernie_1_0)
 from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401

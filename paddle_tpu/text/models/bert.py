@@ -7,7 +7,10 @@ from ...framework.core import Tensor
 
 import jax.numpy as jnp
 
-__all__ = ['BertModel', 'BertForSequenceClassification', 'BertForPretraining']
+__all__ = ['BertModel', 'BertForSequenceClassification',
+           'BertForPretraining', 'ErnieModel',
+           'ErnieForSequenceClassification', 'ErnieForPretraining',
+           'ernie_1_0']
 
 
 class BertEmbeddings(nn.Layer):
@@ -110,3 +113,18 @@ class BertForPretraining(nn.Layer):
         mlm = self.decoder(self.layer_norm(self.act(self.transform(encoded))))
         nsp = self.seq_relationship(pooled)
         return mlm, nsp
+
+
+# ERNIE-1.0 (BASELINE config-3 metric family) shares BERT's encoder
+# architecture; the differences in the reference era were pretraining
+# objectives (phrase/entity masking), not the network. Named aliases keep
+# the user-facing model-zoo surface.
+ErnieModel = BertModel
+ErnieForSequenceClassification = BertForSequenceClassification
+ErnieForPretraining = BertForPretraining
+
+
+def ernie_1_0(vocab_size=18000, hidden_size=768, **kwargs):
+    """ERNIE-1.0-base configuration (12 layers, 768 hidden)."""
+    return ErnieModel(vocab_size=vocab_size, hidden_size=hidden_size,
+                      **kwargs)

@@ -122,3 +122,57 @@ def test_op_benchmark_harness_and_gate():
     regs = ob.compare(base, results, threshold=0.15)
     assert regs and regs[0]['op'] == 'matmul_tiny'
     assert ob.compare(results, results, threshold=0.15) == []
+
+
+def test_lookahead_slow_weights_pull():
+    """k fast steps then slow<-slow+alpha*(fast-slow) (reference
+    LookaheadOptimizer :5969)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.optimizer import LookAhead
+    lin, inner, x, y = _quadratic_setup(paddle.optimizer.SGD,
+                                        learning_rate=0.1)
+    la = LookAhead(inner, alpha=0.5, k=3)
+    w0 = lin.weight.numpy().copy()
+    trace = []
+    for i in range(6):
+        loss = F.mse_loss(lin(x), y)
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        trace.append(lin.weight.numpy().copy())
+    # after step 3 (k reached) the weights jumped back toward w0
+    # (interpolation), so ||w3 - w0|| < ||w2 - w0||
+    d2 = np.linalg.norm(trace[1] - w0)
+    d3 = np.linalg.norm(trace[2] - w0)
+    assert d3 < d2 * 0.75  # pullback happened at the k-th step
+
+
+def test_model_average_apply_restore():
+    from paddle_tpu.incubate.optimizer import ModelAverage
+    from paddle_tpu.framework.core import Parameter
+    p = Parameter(np.zeros(3, np.float32))
+    ma = ModelAverage(parameters=[p])
+    for v in (1.0, 2.0, 3.0):
+        p._data = np.full(3, v, np.float32) * 1.0
+        import jax.numpy as jnp
+        p._data = jnp.asarray(p._data)
+        ma.step()
+    cur = p.numpy().copy()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), np.full(3, 2.0), atol=1e-6)
+    np.testing.assert_allclose(p.numpy(), cur)  # restored
+
+
+def test_ema_tracks_and_restores():
+    from paddle_tpu.incubate.optimizer import ExponentialMovingAverage
+    from paddle_tpu.framework.core import Parameter
+    import jax.numpy as jnp
+    p = Parameter(np.ones(2, np.float32))
+    ema = ExponentialMovingAverage(decay=0.5, parameters=[p])
+    p._data = jnp.asarray(np.full(2, 3.0, np.float32))
+    ema.update()   # shadow = 0.5*1 + 0.5*3 = 2
+    cur = p.numpy().copy()
+    ema.apply(need_restore=False)
+    np.testing.assert_allclose(p.numpy(), np.full(2, 2.0))
+    ema.restore()
+    np.testing.assert_allclose(p.numpy(), cur)

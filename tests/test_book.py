@@ -1,0 +1,117 @@
+"""End-to-end "book" tests (reference: python/paddle/fluid/tests/book/ —
+small real models trained to a loss threshold, doubling as save/load
+round-trip tests; test_fit_a_line.py, test_recognize_digits.py,
+test_word2vec_book.py).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_fit_a_line(tmp_path):
+    """Linear regression on UCIHousing-shaped data to a loss threshold,
+    then a jit.save -> predictor round trip (test_fit_a_line.py)."""
+    paddle.seed(7)
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype(np.float32)
+    x = rng.randn(128, 13).astype(np.float32)
+    y = x @ w_true + 0.01 * rng.randn(128, 1).astype(np.float32)
+
+    model = nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    first = None
+    for epoch in range(60):
+        loss = F.mse_loss(model(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    final = float(loss.numpy())
+    assert final < 0.05 and final < first * 0.05
+
+    # save/load inference round trip
+    from paddle_tpu import jit, inference
+    path = str(tmp_path / 'fit_a_line')
+    model.eval()
+    jit.save(model, path)
+    pred = inference.create_predictor(inference.Config(path))
+    pred.get_input_handle(pred.get_input_names()[0]).copy_from_cpu(x[:4])
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, model(paddle.to_tensor(x[:4])).numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_recognize_digits_conv():
+    """Small conv net on synthetic digits converges
+    (test_recognize_digits.py conv variant)."""
+    paddle.seed(1)
+    rng = np.random.RandomState(2)
+    # separable synthetic "digits": class = brightest quadrant
+    n = 128
+    imgs = rng.rand(n, 1, 8, 8).astype(np.float32) * 0.1
+    labels = rng.randint(0, 4, n)
+    for i, c in enumerate(labels):
+        r, cc = divmod(int(c), 2)
+        imgs[i, 0, r * 4:(r + 1) * 4, cc * 4:(cc + 1) * 4] += 0.9
+
+    model = nn.Sequential(
+        nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Flatten(), nn.Linear(8 * 4 * 4, 4))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    x_t = paddle.to_tensor(imgs)
+    y_t = paddle.to_tensor(labels.astype(np.int64))
+    losses = []
+    for _ in range(30):
+        loss = F.cross_entropy(model(x_t), y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    pred = np.argmax(model(x_t).numpy(), -1)
+    acc = (pred == labels).mean()
+    assert losses[-1] < losses[0] * 0.3
+    assert acc > 0.9, acc
+
+
+def test_word2vec_book():
+    """Tiny skip-gram-style embedding model learns co-occurrence
+    (test_word2vec_book.py shape)."""
+    paddle.seed(3)
+    vocab, dim = 20, 8
+    rng = np.random.RandomState(4)
+    # pairs: word i co-occurs with i+1 mod vocab
+    centers = rng.randint(0, vocab, 256)
+    contexts = (centers + 1) % vocab
+
+    class W2V(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(vocab, dim)
+            self.out = nn.Linear(dim, vocab)
+
+        def forward(self, ids):
+            return self.out(self.emb(ids))
+
+    model = W2V()
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+    x_t = paddle.to_tensor(centers.astype(np.int64))
+    y_t = paddle.to_tensor(contexts.astype(np.int64))
+    losses = []
+    for _ in range(40):
+        loss = F.cross_entropy(model(x_t), y_t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5, losses[-1]
+    # the learned next-word distribution picks the right context
+    pred = np.argmax(model(paddle.to_tensor(
+        np.arange(vocab, dtype=np.int64))).numpy(), -1)
+    assert (pred == (np.arange(vocab) + 1) % vocab).mean() > 0.9

@@ -1,9 +1,11 @@
 """Streaming metrics (reference: python/paddle/metric/metrics.py)."""
 import numpy as np
+import jax
 
 from ..framework.core import Tensor
 
-__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy']
+__all__ = ['Metric', 'Accuracy', 'Precision', 'Recall', 'Auc', 'accuracy',
+           'auc']
 
 
 def _np(x):
@@ -162,3 +164,36 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     idx = jnp.argsort(-p, axis=-1)[..., :k]
     corr = jnp.any(idx == l[..., None], axis=-1)
     return wrap_out(jnp.mean(corr.astype(jnp.float32)))
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1, name=None):
+    """Batch AUC via threshold buckets (reference operators/metrics/
+    auc_op.cc; paddle.static.auc). input: [N, 2] class probs or [N, 1]
+    positive-class scores; label: [N, 1] or [N] in {0, 1}. Returns the
+    AUC value tensor (the reference additionally returns its stat
+    states; the streaming variant lives in metric.Auc)."""
+    import jax.numpy as jnp
+    from ..framework.core import wrap_out
+    p = input._data if hasattr(input, '_data') else jnp.asarray(input)
+    l = label._data if hasattr(label, '_data') else jnp.asarray(label)
+    if p.ndim == 2 and p.shape[1] == 2:
+        pos = p[:, 1]
+    else:
+        pos = p.reshape(-1)
+    l = l.reshape(-1).astype(jnp.float32)
+    # bucketed TPR/FPR sweep (trapezoid rule), XLA-friendly fixed shapes
+    buckets = jnp.clip((pos * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+    oneh = jax.nn.one_hot(buckets, num_thresholds + 1, dtype=jnp.float32)
+    pos_hist = jnp.sum(oneh * l[:, None], axis=0)
+    neg_hist = jnp.sum(oneh * (1.0 - l)[:, None], axis=0)
+    # cumulative from the HIGH-threshold end: tp(t) = positives above t
+    tp = jnp.cumsum(pos_hist[::-1])
+    fp = jnp.cumsum(neg_hist[::-1])
+    tot_p = jnp.maximum(tp[-1], 1e-12)
+    tot_n = jnp.maximum(fp[-1], 1e-12)
+    tpr = jnp.concatenate([jnp.zeros(1), tp / tot_p])
+    fpr = jnp.concatenate([jnp.zeros(1), fp / tot_n])
+    area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+    return wrap_out(area)

@@ -16,6 +16,7 @@ from .input_spec import InputSpec
 __all__ = ['InputSpec', 'data', 'Program', 'Executor', 'default_main_program',
            'default_startup_program', 'program_guard', 'name_scope',
            'save', 'load', 'save_inference_model', 'load_inference_model',
+           'accuracy', 'auc',
            'CompiledProgram', 'BuildStrategy', 'ExecutionStrategy', 'cpu_places',
            'device_guard', 'amp_guard']
 
@@ -259,6 +260,20 @@ class ExecutionStrategy:
 
 def cpu_places(device_count=None):
     return [d for d in jax.devices('cpu')][:device_count]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """paddle.static.accuracy parity (operators/metrics/accuracy_op.cc)."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k, correct=correct, total=total)
+
+
+def auc(input, label, curve='ROC', num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """paddle.static.auc parity (operators/metrics/auc_op.cc)."""
+    from ..metric import auc as _auc
+    out = _auc(input, label, curve=curve, num_thresholds=num_thresholds)
+    return out, out, []
 
 
 def amp_guard(*args, **kwargs):

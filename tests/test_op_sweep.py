@@ -169,3 +169,36 @@ def test_op_sweep(case):
                        if np.issubdtype(np.asarray(v).dtype, np.floating)]
         if float_names:
             t.check_grad(float_names)
+
+
+def test_metric_auc_matches_rank_formula():
+    """auc op vs the Mann-Whitney rank AUC (operators/metrics/auc_op.cc)."""
+    from paddle_tpu import metric
+    rng = np.random.RandomState(5)
+    n = 1000
+    scores = rng.rand(n).astype(np.float32)
+    labels = (scores + 0.4 * rng.randn(n) > 0.5).astype(np.float32)
+    a = float(metric.auc(paddle.to_tensor(scores[:, None]),
+                         paddle.to_tensor(labels[:, None])).numpy())
+    order = np.argsort(scores)
+    ranks = np.empty(n)
+    ranks[order] = np.arange(1, n + 1)
+    n1 = labels.sum()
+    n0 = n - n1
+    ref = (ranks[labels == 1].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+    assert abs(a - ref) < 0.01
+
+
+def test_static_accuracy_and_auc():
+    from paddle_tpu import static
+    rng = np.random.RandomState(6)
+    logits = rng.randn(32, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (32, 1)).astype(np.int64)
+    acc = static.accuracy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels), k=1)
+    ref = (np.argmax(logits, -1) == labels[:, 0]).mean()
+    np.testing.assert_allclose(float(acc.numpy()), ref, atol=1e-6)
+    out = static.auc(paddle.to_tensor(rng.rand(32, 1).astype(np.float32)),
+                     paddle.to_tensor((rng.rand(32, 1) > 0.5)
+                                      .astype(np.float32)))
+    assert 0.0 <= float(out[0].numpy()) <= 1.0

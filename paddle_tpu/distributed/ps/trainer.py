@@ -20,17 +20,6 @@ import jax.numpy as jnp
 __all__ = ['DownpourTrainer']
 
 
-def _segment_mean_matrix(offsets, n_ids):
-    """[B, n_ids] CSR mean-pool matrix (host-built, tiny)."""
-    b = len(offsets) - 1
-    m = np.zeros((b, n_ids), np.float32)
-    for i in range(b):
-        lo, hi = offsets[i], offsets[i + 1]
-        if hi > lo:
-            m[i, lo:hi] = 1.0 / (hi - lo)
-    return m
-
-
 class DownpourTrainer:
     """CTR trainer over sparse PS slots + local dense MLP.
 

@@ -74,7 +74,8 @@ def _run_measurement():
     def loss_fn(logits, labels):
         return model.loss(logits, labels)
 
-    step = func_mod.TrainStep(model, loss_fn, opt)
+    remat = os.environ.get('PADDLE_TPU_BENCH_REMAT', '0') == '1'
+    step = func_mod.TrainStep(model, loss_fn, opt, remat=remat)
 
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(
@@ -217,8 +218,11 @@ def _orchestrate(errors):
     #    an honest number (flash_in_program=false distinguishes it)
     if platform is not None:
         for attempt, extra in enumerate(
-                (None, {'PADDLE_TPU_FLASH_DISABLE': '1',
-                        'PADDLE_TPU_FLASH_STRICT': '0'})):
+                (None,
+                 {'PADDLE_TPU_BENCH_BATCH': '16',
+                  'PADDLE_TPU_BENCH_REMAT': '1'},
+                 {'PADDLE_TPU_FLASH_DISABLE': '1',
+                  'PADDLE_TPU_FLASH_STRICT': '0'})):
             result, err = _spawn_child(extra_env=extra)
             if result is not None:
                 if extra:

@@ -82,7 +82,9 @@ def sp_attention(q, k, v, causal, scale, state=None):
     if b_ax is not None and len(b_ax) == 1:
         b_ax = b_ax[0]
     spec = P(b_ax, axis, st['head_axis'], None)
-    fn = ra.ring_attention if mode == 'ring' else ra.ulysses_attention
+    # ring mode prefers the Pallas-block ring (falls back to the jnp ring
+    # internally when the kernel cannot run on this backend/shape)
+    fn = ra.ring_flash_attention if mode == 'ring' else ra.ulysses_attention
     wrapped = shard_map(
         functools.partial(fn, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

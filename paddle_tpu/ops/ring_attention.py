@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ['ring_attention', 'ulysses_attention', 'ring_attention_sharded',
-           'ulysses_attention_sharded']
+           'ulysses_attention_sharded', 'ring_flash_attention',
+           'ring_flash_attention_sharded']
 
 
 def _block_attn(q, k, v, scale, mask):
@@ -144,4 +145,122 @@ def ring_attention_sharded(q, k, v, mesh, axis_name='sp', causal=False):
 
 def ulysses_attention_sharded(q, k, v, mesh, axis_name='sp', causal=False):
     return _sharded(ulysses_attention, mesh, axis_name, q, k, v,
+                    causal=causal)
+
+
+# -- ring FLASH attention (SURVEY §5.7: 'ring attention as a Pallas kernel
+# with ppermute over ICI') --------------------------------------------------
+#
+# Per ring step the LOCAL block runs the Pallas flash kernel
+# (ops/flash_attention._fwd_impl) and the normalized partial outputs merge
+# through their LSEs; the backward is a second ring that reuses the Pallas
+# dq/dkv kernels with the GLOBAL lse/delta (blockwise-exact, Liu et al.),
+# rotating the dk/dv accumulators alongside their k/v blocks so each
+# block's grads arrive home after a full loop. Memory stays O(N_local);
+# the quadratic [Nq, Nk] matrix never materializes.
+
+def _lse_merge(o1, lse1, o2, lse2, w2):
+    """Merge normalized flash outputs (o [B,H,N,D], lse [B,H,N,1]);
+    w2 False masks block 2 out entirely."""
+    neg = jnp.full_like(lse2, -jnp.inf)
+    lse2w = jnp.where(w2, lse2, neg)
+    m = jnp.maximum(lse1, lse2w)
+    m_safe = jnp.where(jnp.isfinite(m), m, jnp.zeros_like(m))
+    a1 = jnp.exp(lse1 - m_safe)
+    a2 = jnp.exp(lse2w - m_safe)
+    denom = jnp.maximum(a1 + a2, 1e-30)
+    o = (o1 * a1 + o2 * a2) / denom
+    return o, m_safe + jnp.log(denom)
+
+
+def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None):
+    """Drop-in for ring_attention ([B, N_local, H, D] shards) running the
+    Pallas flash kernels per block. Falls back to the jnp ring when the
+    kernel cannot run (shape/backend)."""
+    from . import flash_attention as fa
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]
+    if not fa.is_available() or fa._supported(qt, qt, qt) is not None:
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              scale=scale)
+
+    n_dev = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    @jax.custom_vjp
+    def _ring(qb, kb, vb):
+        o, lse, _, _ = _ring_fwd_impl(qb, kb, vb)
+        return o
+
+    def _ring_fwd_impl(qb, kb, vb):
+        my = lax.axis_index(axis_name)
+        # step 0: the diagonal block (causal inside the kernel)
+        o, lse = fa._fwd_impl(qb, kb, vb, causal, scale)
+        o = o.astype(jnp.float32)
+
+        def step(carry, r):
+            o_c, lse_c, k_c, v_c = carry
+            k_n = lax.ppermute(k_c, axis_name, perm)
+            v_n = lax.ppermute(v_c, axis_name, perm)
+            o_b, lse_b = fa._fwd_impl(qb, k_n, v_n, False, scale)
+            src = jnp.mod(my - r, n_dev)
+            w = jnp.logical_or(jnp.asarray(not causal), src < my)
+            o_c, lse_c = _lse_merge(o_c, lse_c,
+                                    o_b.astype(jnp.float32), lse_b, w)
+            return (o_c, lse_c, k_n, v_n), None
+
+        (o, lse, k_last, v_last), _ = lax.scan(
+            step, (o, lse, kb, vb), jnp.arange(1, n_dev))
+        return o.astype(qb.dtype), lse, k_last, v_last
+
+    def _ring_vjp_fwd(qb, kb, vb):
+        o, lse, _, _ = _ring_fwd_impl(qb, kb, vb)
+        return o, (qb, kb, vb, o, lse)
+
+    def _ring_vjp_bwd(res, do):
+        qb, kb, vb, o, lse = res
+        my = lax.axis_index(axis_name)
+        do = do.astype(qb.dtype)
+
+        # step 0: diagonal block grads
+        dq, dk0, dv0 = fa._bwd_impl(qb, kb, vb, o, lse, do, causal, scale)
+        dq = dq.astype(jnp.float32)
+
+        def step(carry, r):
+            dq_c, k_c, v_c, dk_c, dv_c = carry
+            # rotate the kv block AND its grad accumulators together
+            k_n = lax.ppermute(k_c, axis_name, perm)
+            v_n = lax.ppermute(v_c, axis_name, perm)
+            dk_n = lax.ppermute(dk_c, axis_name, perm)
+            dv_n = lax.ppermute(dv_c, axis_name, perm)
+            dq_b, dk_b, dv_b = fa._bwd_impl(qb, k_n, v_n, o, lse, do,
+                                            False, scale)
+            src = jnp.mod(my - r, n_dev)
+            w = jnp.logical_or(jnp.asarray(not causal),
+                               src < my).astype(jnp.float32)
+            dq_c = dq_c + dq_b.astype(jnp.float32) * w
+            dk_n = dk_n + dk_b.astype(jnp.float32) * w
+            dv_n = dv_n + dv_b.astype(jnp.float32) * w
+            return (dq_c, k_n, v_n, dk_n, dv_n), None
+
+        (dq, _, _, dk_acc, dv_acc), _ = lax.scan(
+            step, (dq, kb, vb, dk0.astype(jnp.float32),
+                   dv0.astype(jnp.float32)), jnp.arange(1, n_dev))
+        # one final rotation brings each block's accumulators home
+        dk_home = lax.ppermute(dk_acc, axis_name, perm)
+        dv_home = lax.ppermute(dv_acc, axis_name, perm)
+        return (dq.astype(qb.dtype), dk_home.astype(kb.dtype),
+                dv_home.astype(vb.dtype))
+
+    _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    return jnp.swapaxes(_ring(qt, kt, vt), 1, 2)
+
+
+def ring_flash_attention_sharded(q, k, v, mesh, axis_name='sp',
+                                 causal=False):
+    return _sharded(ring_flash_attention, mesh, axis_name, q, k, v,
                     causal=causal)

@@ -21,8 +21,8 @@ import os
 import jax
 import jax.numpy as jnp
 
-_DEFAULT_BLOCK_Q = 256
-_DEFAULT_BLOCK_K = 512
+_DEFAULT_BLOCK_Q = int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_Q', 256))
+_DEFAULT_BLOCK_K = int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_K', 512))
 _NEG_INF = -1e30
 
 

@@ -11,5 +11,5 @@ from .communicator import (Communicator, AsyncCommunicator,  # noqa: F401
                            HalfAsyncCommunicator, SyncCommunicator,
                            GeoCommunicator)
 from .dataset import MultiSlotDataset  # noqa: F401
-from .trainer import DownpourTrainer  # noqa: F401
+from .trainer import DownpourTrainer, AsyncExecutor  # noqa: F401
 from .heter import HeterEmbedding, PassCachedEmbedding  # noqa: F401

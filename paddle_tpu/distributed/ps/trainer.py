@@ -17,7 +17,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ['DownpourTrainer']
+__all__ = ['DownpourTrainer', 'AsyncExecutor']
 
 
 class DownpourTrainer:
@@ -140,3 +140,31 @@ class DownpourTrainer:
             t.join()
         self.comm.flush()
         return list(self._losses)
+
+
+class AsyncExecutor:
+    """Legacy async-executor API (reference: framework/async_executor.cc,
+    deprecated there in favor of the TrainerBase runtime). Kept as a thin
+    facade over DownpourTrainer so old run-from-dataset scripts port:
+    construct, then run(dataset, trainer) or run_from_files(...)."""
+
+    def __init__(self, place=None, run_mode=''):
+        self.place = place
+        self._trainer = None
+
+    def run(self, trainer, dataset, debug=False, epochs=1):
+        """trainer: a DownpourTrainer (the modern runtime)."""
+        return trainer.train_from_dataset(dataset, epochs=epochs,
+                                          debug=debug)
+
+    def run_from_files(self, trainer, filelist, slots, batch_size=32,
+                       epochs=1, shuffle_seed=None):
+        from .dataset import MultiSlotDataset
+        ds = MultiSlotDataset()
+        ds.set_use_var(slots)
+        ds.set_filelist(filelist)
+        ds.set_batch_size(batch_size)
+        ds.load_into_memory()
+        if shuffle_seed is not None:
+            ds.local_shuffle(seed=shuffle_seed)
+        return self.run(trainer, ds, epochs=epochs)

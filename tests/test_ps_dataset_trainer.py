@@ -297,3 +297,23 @@ def test_pass_cached_embedding_trains_on_device_and_flushes():
     import pytest as _pytest
     with _pytest.raises(KeyError, match='working set'):
         net.emb.lookup_slots(np.asarray([999]))
+
+
+def test_async_executor_facade(tmp_path):
+    """Legacy AsyncExecutor API delegates to the modern trainer runtime
+    (reference framework/async_executor.cc, deprecated there too)."""
+    from paddle_tpu.distributed.ps.trainer import AsyncExecutor
+    files = _write_ctr_files(tmp_path, n_files=2)
+    server, client = _make_cluster()
+    comm = SyncCommunicator(client)
+    trainer = DownpourTrainer(client, comm, slots=['slot0', 'slot1'],
+                              tables={'slot0': 0, 'slot1': 1},
+                              emb_dim=8, hidden=16, lr=0.3, n_threads=1)
+    exe = AsyncExecutor()
+    losses = exe.run_from_files(
+        trainer, files,
+        slots=[('slot0', 'int64'), ('slot1', 'int64'),
+               ('label', 'float32')],
+        batch_size=16, epochs=2, shuffle_seed=0)
+    assert len(losses) == 16  # 128 samples / 16 per batch * 2 epochs
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])

@@ -249,3 +249,45 @@ def test_embedding_service_socket_transport():
     rows2 = client.pull(0, ids)
     assert not np.allclose(rows, rows2)
     srv.stop()
+
+
+def test_sync_batchnorm_global_stats_under_dp():
+    """SyncBatchNorm's contract — BN statistics span the GLOBAL batch —
+    holds under pjit dp sharding (the class doc's 'implicit sync' claim):
+    running mean after one step equals the global batch mean, not any
+    per-shard mean (reference sync_batch_norm_op.cu semantics)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework import functional as func_mod
+
+    paddle.seed(0)
+    bn = nn.SyncBatchNorm(3)
+    mesh = Mesh(np.array(jax.devices()).reshape(-1), ('dp',))
+
+    rng = np.random.RandomState(0)
+    # per-shard means differ strongly: shard i gets offset i
+    x = rng.randn(16, 3, 4, 4).astype(np.float32)
+    x += np.repeat(np.arange(8), 2)[:, None, None, None]
+
+    params = func_mod.extract_params(bn)
+    buffers = func_mod.extract_buffers(bn)
+
+    def step(params, buffers, xb):
+        out, new_buf = func_mod.functional_call(bn, params, buffers,
+                                                args=(xb,), training=True)
+        return out, new_buf
+
+    xb = jax.device_put(x, NamedSharding(mesh, P('dp')))
+    out, new_buf = jax.jit(step)(params, buffers, xb)
+
+    global_mean = x.mean(axis=(0, 2, 3))
+    momentum = bn._momentum
+    expect = (1 - momentum) * global_mean  # running mean starts at 0
+    got = np.asarray(new_buf['_mean'])
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-4)
+    # the normalized output is standardized over the GLOBAL batch
+    o = np.asarray(out)
+    np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0.0, atol=1e-4)

@@ -217,16 +217,16 @@ def _orchestrate(errors):
     #    the Pallas flash kernel so a kernel-compile failure still yields
     #    an honest number (flash_in_program=false distinguishes it)
     if platform is not None:
-        for attempt, extra in enumerate(
-                (None,
-                 {'PADDLE_TPU_BENCH_BATCH': '16',
-                  'PADDLE_TPU_BENCH_REMAT': '1'},
-                 {'PADDLE_TPU_FLASH_DISABLE': '1',
-                  'PADDLE_TPU_FLASH_STRICT': '0'})):
+        ladder = ((None, None),
+                  ({'PADDLE_TPU_BENCH_BATCH': '16',
+                    'PADDLE_TPU_BENCH_REMAT': '1'}, 'batch16_remat'),
+                  ({'PADDLE_TPU_FLASH_DISABLE': '1',
+                    'PADDLE_TPU_FLASH_STRICT': '0'}, 'flash_disabled'))
+        for attempt, (extra, label) in enumerate(ladder):
             result, err = _spawn_child(extra_env=extra)
             if result is not None:
-                if extra:
-                    result['flash_disabled_retry'] = True
+                if label:
+                    result['retry'] = label
                 print(json.dumps(result))
                 return
             errors.append('run %d: %s' % (attempt, err))

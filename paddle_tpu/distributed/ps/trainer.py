@@ -146,11 +146,10 @@ class AsyncExecutor:
     """Legacy async-executor API (reference: framework/async_executor.cc,
     deprecated there in favor of the TrainerBase runtime). Kept as a thin
     facade over DownpourTrainer so old run-from-dataset scripts port:
-    construct, then run(dataset, trainer) or run_from_files(...)."""
+    construct, then run(trainer, dataset) or run_from_files(...)."""
 
     def __init__(self, place=None, run_mode=''):
         self.place = place
-        self._trainer = None
 
     def run(self, trainer, dataset, debug=False, epochs=1):
         """trainer: a DownpourTrainer (the modern runtime)."""

@@ -183,7 +183,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
+        # clamped exp: for valid rows s - lse <= ~0; the headroom only
+        # matters when a caller (ring attention) zero-weights a block it
+        # computed unmasked — without the clamp an overflowing exp would
+        # turn 0 * inf into NaN
+        p = jnp.exp(jnp.minimum(s - lse, 30.0))
         dp = do @ v_blk.T
         ds = p * (dp - delta) * scale
         return dq_prev + ds @ k_blk
@@ -222,7 +226,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse_b)  # [bq, bk]
+        p = jnp.exp(jnp.minimum(s - lse_b, 30.0))  # [bq, bk]; see dq kernel
         dv_cur = dv_prev + p.T @ do_b
         dp = do_b @ v_blk.T  # [bq, bk]
         ds = p * (dp - delta_b) * scale

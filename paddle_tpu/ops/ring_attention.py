@@ -181,7 +181,13 @@ def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None):
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]
-    if not fa.is_available() or fa._supported(qt, qt, qt) is not None:
+    reason = (None if fa.is_available() else 'flash unavailable on this '
+              'backend') or fa._supported(qt, qt, qt)
+    if reason is not None:
+        if fa.strict_mode():
+            raise RuntimeError(
+                'PADDLE_TPU_FLASH_STRICT=1 but ring flash attention '
+                'cannot run: %s' % reason)
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
                               scale=scale)
 

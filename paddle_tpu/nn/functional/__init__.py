@@ -10,3 +10,16 @@ from . import activation, common, conv, loss, norm, pooling  # noqa: F401
 
 # attention lives in its own module (pallas-backed flash attention)
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from .extension import (sequence_mask, diag_embed, affine_grid,  # noqa: F401
+                        grid_sample, hsigmoid_loss)
+
+# reference-parity inplace aliases: functional purity makes true inplace
+# meaningless on TPU; x_(...) returns the new value like the reference's
+# return does
+
+def elu_(x, alpha=1.0, name=None):
+    return elu(x, alpha=alpha)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return softmax(x, axis=axis, dtype=dtype)

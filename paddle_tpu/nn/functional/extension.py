@@ -1,0 +1,181 @@
+"""Extension functionals (reference: python/paddle/nn/functional/
+extension.py + vision.py — sequence_mask, diag_embed, affine_grid,
+grid_sample, hsigmoid_loss)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import run_op
+from ...tensor._helpers import ensure_tensor
+
+__all__ = ['sequence_mask', 'diag_embed', 'affine_grid', 'grid_sample',
+           'hsigmoid_loss']
+
+
+def sequence_mask(x, maxlen=None, dtype='int64', name=None):
+    """lengths [...,] -> mask [..., maxlen] (operators/sequence_ops/
+    sequence_mask_op; the one sequence op kept — ragged-via-mask is the
+    TPU answer to LoD, SURVEY §7.5)."""
+    t = ensure_tensor(x)
+    n = int(maxlen) if maxlen is not None else None
+
+    def fn(lengths):
+        m = n if n is not None else int(jnp.max(lengths))
+        rng = jnp.arange(m, dtype=lengths.dtype)
+        from ...framework.dtype import to_jax_dtype
+        return (rng < lengths[..., None]).astype(to_jax_dtype(dtype))
+    return run_op('sequence_mask', fn, t)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Last dim -> diagonal of a new matrix pair of dims (reference
+    diag_embed op)."""
+    t = ensure_tensor(input)
+
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = {d1: nd - 2, d2: nd - 1}
+        it = iter(perm)
+        for i in range(nd):
+            order.append(src[i] if i in src else next(it))
+        return jnp.transpose(out, order)
+    return run_op('diag_embed', fn, t)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (affine_grid_op)."""
+    t = ensure_tensor(theta)
+    if hasattr(out_shape, 'numpy'):
+        out_shape = [int(v) for v in np.asarray(out_shape.numpy())]
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)                 # [H, W]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)     # [H, W, 3]
+        return jnp.einsum('hwk,njk->nhwj', base.astype(th.dtype), th)
+    return run_op('affine_grid', fn, t)
+
+
+def grid_sample(x, grid, mode='bilinear', padding_mode='zeros',
+                align_corners=True, name=None):
+    """Bilinear/nearest sampling of x [N,C,H,W] at grid [N,Hg,Wg,2]
+    (normalized xy in [-1,1]; grid_sampler_op)."""
+    xt = ensure_tensor(x)
+    gt = ensure_tensor(grid)
+
+    def fn(img, g):
+        n, c, h, w = img.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) / 2.0 * (size - 1)
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        fx = unnorm(g[..., 0], w)                     # [N, Hg, Wg]
+        fy = unnorm(g[..., 1], h)
+
+        def sample(ix, iy):
+            inb = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+            if padding_mode == 'border':
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+                inb = jnp.ones_like(inb)
+            else:  # zeros
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+            vals = img[jnp.arange(n)[:, None, None], :,
+                       iyc, ixc]                      # [N, Hg, Wg, C]
+            return vals * inb[..., None].astype(img.dtype)
+
+        if mode == 'nearest':
+            out = sample(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = (fx - x0).astype(img.dtype)[..., None]
+            wy = (fy - y0).astype(img.dtype)[..., None]
+            out = (sample(x0, y0) * (1 - wx) * (1 - wy) +
+                   sample(x1, y0) * wx * (1 - wy) +
+                   sample(x0, y1) * (1 - wx) * wy +
+                   sample(x1, y1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)               # [N, C, Hg, Wg]
+    return run_op('grid_sample', fn, xt, gt)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (hierarchical_sigmoid_op): default
+    complete binary tree over num_classes; custom trees via
+    path_table/path_code [N, L] (padded with -1)."""
+    xt = ensure_tensor(input)
+    lt = ensure_tensor(label)
+    wt = ensure_tensor(weight)
+    args = [xt, lt, wt]
+    if bias is not None:
+        args.append(ensure_tensor(bias))
+
+    # default complete-tree paths (host-built, static in num_classes)
+    if path_table is None:
+        depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+        tables = np.full((num_classes, depth), -1, np.int64)
+        codes = np.full((num_classes, depth), -1, np.int64)
+        for cls in range(num_classes):
+            # leaf index in a complete tree; internal nodes numbered from 1
+            node = cls + num_classes  # leaves occupy [num_classes, 2N)
+            path = []
+            while node > 1:
+                parent = node // 2
+                path.append((parent - 1, node % 2))
+                node = parent
+            for li, (nid, code) in enumerate(reversed(path)):
+                if li < depth:
+                    tables[cls, li] = nid
+                    codes[cls, li] = code
+        path_table_np, path_code_np = tables, codes
+    else:
+        path_table_np = np.asarray(path_table.numpy()
+                                   if hasattr(path_table, 'numpy')
+                                   else path_table, np.int64)
+        path_code_np = np.asarray(path_code.numpy()
+                                  if hasattr(path_code, 'numpy')
+                                  else path_code, np.int64)
+
+    def fn(x, lab, w, *maybe_bias):
+        tables = jnp.asarray(path_table_np)
+        codes = jnp.asarray(path_code_np)
+        lab_flat = lab.reshape(-1).astype(jnp.int32)
+        t = tables[lab_flat]                     # [N, L]
+        cde = codes[lab_flat].astype(x.dtype)    # [N, L]
+        valid = (t >= 0)
+        t_safe = jnp.clip(t, 0, w.shape[0] - 1)
+        wrows = w[t_safe]                        # [N, L, D]
+        logits = jnp.einsum('nd,nld->nl', x.astype(w.dtype), wrows)
+        if maybe_bias:
+            logits = logits + maybe_bias[0].reshape(-1)[t_safe]
+        # code 1 => sigmoid(logit), code 0 => 1 - sigmoid(logit)
+        zls = jnp.maximum(logits, 0) - logits * cde + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        loss = jnp.sum(jnp.where(valid, zls, 0.0), axis=1)
+        return jnp.mean(loss)[None]
+    return run_op('hsigmoid_loss', fn, *args)

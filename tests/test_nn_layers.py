@@ -247,3 +247,48 @@ def test_weight_norm():
                                rtol=1e-4)
     remove_weight_norm(layer)
     assert 'weight_g' not in dict(layer.named_parameters())
+
+
+def test_functional_extension_surface():
+    """sequence_mask / diag_embed / affine_grid / grid_sample /
+    hsigmoid_loss (reference nn/functional extension+vision ops; the
+    last 7 missing names of the functional surface)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    m = F.sequence_mask(paddle.to_tensor(np.asarray([1, 3, 2])), maxlen=4)
+    np.testing.assert_array_equal(
+        m.numpy(), [[1, 0, 0, 0], [1, 1, 1, 0], [1, 1, 0, 0]])
+
+    d = F.diag_embed(paddle.to_tensor(
+        np.asarray([[1., 2.], [3., 4.]], np.float32)))
+    np.testing.assert_allclose(d.numpy()[1], [[3, 0], [0, 4]])
+
+    # identity affine theta reproduces the image through grid_sample
+    img = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    theta = paddle.to_tensor(
+        np.asarray([[[1., 0, 0], [0, 1., 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(out.numpy(), img.numpy(), atol=1e-4)
+
+    # hsigmoid trains: loss decreases under SGD on a separable problem
+    paddle.seed(0)
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+    lab = paddle.to_tensor((rng.rand(32) * 4).astype(np.int64))
+    from paddle_tpu.framework.core import Parameter
+    w = Parameter(rng.randn(7, 8).astype(np.float32) * 0.1)
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+    losses = []
+    for _ in range(15):
+        loss = F.hsigmoid_loss(x, lab, 4, w)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()[0]))
+    assert losses[-1] < losses[0]
+
+    assert F.elu_ is not None and F.softmax_ is not None

@@ -26,3 +26,5 @@ class utils:  # namespace shim: paddle.nn.utils.*
             n = int(np.prod(p.shape)) if p.shape else 1
             p.set_value(vec._data[offset:offset + n].reshape(tuple(p.shape)))
             offset += n
+
+from .layer.loss import HSigmoidLoss  # noqa: F401

@@ -2,7 +2,8 @@
 from .. import functional as F
 from .layers import Layer
 
-__all__ = ['CrossEntropyLoss', 'MSELoss', 'L1Loss', 'NLLLoss', 'BCELoss',
+__all__ = [
+    'HSigmoidLoss','CrossEntropyLoss', 'MSELoss', 'L1Loss', 'NLLLoss', 'BCELoss',
            'BCEWithLogitsLoss', 'KLDivLoss', 'SmoothL1Loss',
            'MarginRankingLoss', 'CTCLoss', 'HingeEmbeddingLoss',
            'CosineEmbeddingLoss', 'TripletMarginLoss', 'SoftMarginLoss',
@@ -171,3 +172,28 @@ class MultiLabelSoftMarginLoss(Layer):
     def forward(self, input, label):
         return F.multi_label_soft_margin_loss(input, label, self.weight,
                                               self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid (reference nn.HSigmoidLoss over
+    hierarchical_sigmoid_op): holds the internal-node weight/bias table;
+    default complete binary tree or custom path tables at call time."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError('num_classes must be >= 2')
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        n_nodes = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter([n_nodes, feature_size],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([n_nodes], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table, path_code=path_code)

@@ -8,6 +8,7 @@ from ..framework.core import Tensor, run_op, wrap_out
 from ._helpers import ensure_tensor, axes_arg, shape_arg, jdt, as_static_int
 
 __all__ = [
+    'reshape_', 'squeeze_', 'unsqueeze_', 'scatter_',
     'reshape', 'transpose', 'concat', 'stack', 'unstack', 'split', 'chunk',
     'squeeze', 'unsqueeze', 'flatten', 'gather', 'gather_nd', 'scatter',
     'scatter_nd', 'scatter_nd_add', 'tile', 'expand', 'expand_as',
@@ -481,3 +482,26 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         in_shard = (a >= lo) & (a < hi) & (a >= 0) & (a < index_num)
         return jnp.where(in_shard, a - lo, ignore_value)
     return run_op('shard_index', fn, x)
+
+
+# reference-parity inplace variants: functional purity on TPU means the
+# trailing-underscore forms rebind the input Tensor's storage to the new
+# value and return it (observable effect matches the reference's
+# view-mutating semantics for the common x = op_(x) pattern)
+def _inplace(op):
+    def wrapped(x, *args, **kwargs):
+        out = op(x, *args, **kwargs)
+        if hasattr(x, '_data'):
+            x._data = out._data
+            x._grad_node = out._grad_node
+            x._node_out_idx = getattr(out, '_node_out_idx', None)
+            return x
+        return out
+    wrapped.__name__ = op.__name__ + '_'
+    return wrapped
+
+
+reshape_ = _inplace(reshape)
+squeeze_ = _inplace(squeeze)
+unsqueeze_ = _inplace(unsqueeze)
+scatter_ = _inplace(scatter)

@@ -326,3 +326,14 @@ def add_n(inputs, name=None):
             out = out + a
         return out
     return run_op('add_n', fn, *tensors)
+
+
+def tanh_(x, name=None):
+    """Inplace-alias (reference tanh_): rebinds x to tanh(x)."""
+    out = tanh(x)
+    if hasattr(x, '_data'):
+        x._data = out._data
+        x._grad_node = out._grad_node
+        x._node_out_idx = getattr(out, '_node_out_idx', None)
+        return x
+    return out

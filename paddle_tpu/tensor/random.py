@@ -13,6 +13,7 @@ from ..framework import dtype as dtype_mod
 from ._helpers import ensure_tensor, shape_arg, jdt
 
 __all__ = [
+    'check_shape',
     'rand', 'randn', 'randint', 'randint_like', 'randperm', 'uniform',
     'normal', 'standard_normal', 'bernoulli', 'multinomial', 'poisson',
     'uniform_', 'normal_', 'exponential_',
@@ -112,3 +113,28 @@ def exponential_(x, lam=1.0, name=None):
     x._data = jax.random.exponential(rng.next_key(),
                                      tuple(x._data.shape), x._data.dtype) / lam
     return x
+
+
+def check_shape(shape, op_name='check_shape'):
+    """Validate a shape ARGUMENT (reference fluid/data_feeder.py
+    check_shape, re-exported at paddle.check_shape): list/tuple of ints
+    (at most one -1) or an int Tensor."""
+    from ..framework.core import Tensor
+    if isinstance(shape, Tensor):
+        if shape._data.dtype not in ('int32', 'int64') and \
+                'int' not in str(shape._data.dtype):
+            raise TypeError("%s: shape tensor must be int32/int64" % op_name)
+        return
+    if not isinstance(shape, (list, tuple)):
+        raise TypeError("%s: shape must be a list/tuple/Tensor, got %r"
+                        % (op_name, type(shape)))
+    negs = 0
+    for s in shape:
+        if isinstance(s, Tensor):
+            continue
+        if int(s) < -1:
+            raise ValueError("%s: shape dims must be >= -1" % op_name)
+        if int(s) == -1:
+            negs += 1
+    if negs > 1:
+        raise ValueError("%s: at most one dim may be -1" % op_name)

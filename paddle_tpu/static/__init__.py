@@ -5,6 +5,8 @@ Executor. Here "static mode" IS jit compilation (SURVEY.md §7.1): a Program
 is a recorded python callable; Executor.run jit-compiles and executes it.
 The data/feed/fetch surface is kept so static-style user code ports over.
 """
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -16,7 +18,14 @@ from .input_spec import InputSpec
 __all__ = ['InputSpec', 'data', 'Program', 'Executor', 'default_main_program',
            'default_startup_program', 'program_guard', 'name_scope',
            'save', 'load', 'save_inference_model', 'load_inference_model',
-           'accuracy', 'auc',
+           'accuracy', 'auc', 'Variable', 'Scope', 'global_scope', 'scope_guard',
+           'create_global_var', 'create_parameter', 'append_backward',
+           'gradients', 'Print', 'py_func', 'cuda_places', 'xpu_places',
+           'WeightNormParamAttr', 'ParallelExecutor', 'serialize_program',
+           'deserialize_program', 'serialize_persistables',
+           'deserialize_persistables', 'save_to_file', 'load_from_file',
+           'save_vars', 'load_vars', 'load_program_state',
+           'set_program_state', 'normalize_program',
            'CompiledProgram', 'BuildStrategy', 'ExecutionStrategy', 'cpu_places',
            'device_guard', 'amp_guard']
 
@@ -375,3 +384,313 @@ class nn:
         if activation:
             out = getattr(_nn.functional, activation)(out)
         return out
+
+
+# -- fluid-era static surface (reference: python/paddle/static/__init__.py
+# re-exports of fluid Executor-world APIs) ----------------------------------
+
+Variable = Tensor  # the reference's graph Variable ≈ our recorded Tensor
+
+
+class Scope:
+    """Name -> value store (reference framework/scope.h Scope facade)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, Tensor(jnp.zeros((), jnp.float32),
+                                           name=name))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def local_scope(self):
+        return Scope()
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._saved = _global_scope
+        _global_scope = self._scope
+        return self
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._saved
+        return False
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value,
+                        dtype_mod.to_jax_dtype(dtype)), name=name)
+    t.persistable = persistable
+    if name:
+        _global_scope._vars[name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..framework.core import Parameter
+    from ..nn import initializer as init_mod
+    init = default_initializer or (init_mod.Constant(0.0) if is_bias
+                                   else init_mod.XavierNormal())
+    return Parameter(init(list(shape), dtype), name=name)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference fluid/backward.py:1369 — computes grads for the loss and
+    returns [(param, grad)] pairs. Here the tape IS the backward builder:
+    loss.backward() populates .grad on every reachable Parameter."""
+    from ..framework.core import Parameter
+    # walk the tape BEFORE backward consumes it to find the reachable
+    # Parameters, then run backward and pair them with their grads
+    params = []
+    seen = set()
+    node = getattr(loss, '_grad_node', None)
+    stack = [node] if node is not None else []
+    visited = set()
+    while stack:
+        nd = stack.pop()
+        if id(nd) in visited:
+            continue
+        visited.add(id(nd))
+        for t in nd.inputs:
+            if isinstance(t, Parameter) and id(t) not in seen:
+                seen.add(id(t))
+                params.append(t)
+            sub = getattr(t, '_grad_node', None)
+            if sub is not None:
+                stack.append(sub)
+    loss.backward()
+    pairs = [(p, p.grad) for p in params if p.grad is not None]
+    if parameter_list:
+        wanted = {id(p) for p in parameter_list}
+        pairs = [pg for pg in pairs if id(pg[0]) in wanted]
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference fluid/backward.py:1964 — symbolic d(targets)/d(inputs);
+    delegates to autograd.grad."""
+    from ..autograd import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return list(outs) if isinstance(outs, (list, tuple)) else [outs]
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase='both'):
+    """Debug print op (reference operators/print_op): prints through the
+    jit boundary via jax.debug.print and passes the value through."""
+    from ..framework.core import run_op
+
+    def fn(a):
+        jax.debug.print((message or '') + ' {x}', x=a)
+        return a
+    return run_op('print', fn, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference operators/py_func_op: wrap a host python callable as an
+    op via pure_callback. `out` provides the result template(s)."""
+    from ..framework.core import run_op
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+              for o in outs]
+
+    def fn(*arrays):
+        res = jax.pure_callback(
+            lambda *a: func(*[np.asarray(v) for v in a]),
+            shapes if len(shapes) > 1 else shapes[0], *arrays)
+        return tuple(res) if isinstance(res, (list, tuple)) else res
+    return run_op('py_func', fn, *xs)
+
+
+def cuda_places(device_ids=None):
+    # accelerator places == the TPU devices here
+    devs = [d for d in jax.devices() if d.platform != 'cpu'] or jax.devices()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return devs
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class WeightNormParamAttr:
+    """Accepted for API parity; weight-norm reparameterization comes from
+    nn.utils.weight_norm on the built layer."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class ParallelExecutor:
+    """Legacy multi-device executor facade (reference
+    parallel_executor.cc): delegates to Executor — device parallelism is
+    pjit's job now."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None):
+        self._program = main_program or _main_program
+        self._exe = Executor()
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+# -- program/vars (de)serialization (reference static/io.py) ----------------
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    from jax import export as jax_export
+    program = program or _main_program
+    import io as _io
+    import pickle as _pickle
+    buf = _io.BytesIO()
+    # reuse the replay exporter from save_inference_model
+    names = [getattr(v, 'name', None) or 'feed_%d' % i
+             for i, v in enumerate(feed_vars)]
+    payload = _export_program_payload(program, feed_vars, fetch_vars, names)
+    _pickle.dump(payload, buf, protocol=4)
+    return buf.getvalue()
+
+
+def _export_program_payload(program, feed_vars, fetch_vars, feed_names):
+    from jax import export as jax_export
+    if not program._ops:
+        raise RuntimeError('program recorded no ops — build it inside '
+                           'static.program_guard')
+    name_of = {id(v): n for v, n in zip(feed_vars, feed_names)}
+    feed_arrays = {name_of[id(v)]: v._data for v in feed_vars}
+    ordered = sorted(feed_arrays)
+    ops = list(program._ops)
+    feed_ids = {id(v): ordered.index(name_of[id(v)]) for v in feed_vars}
+    fetch_ids = [id(t) for t in fetch_vars]
+
+    def replay(feed_list):
+        env = {tid: feed_list[i] for tid, i in feed_ids.items()}
+        for fn, ins, outs in ops:
+            res = fn(*[env.get(id(t), t._data) for t in ins])
+            res = res if isinstance(res, tuple) else (res,)
+            for t, a in zip(outs, res):
+                env[id(t)] = a
+        return [env[tid] for tid in fetch_ids]
+
+    shaped = [jax.ShapeDtypeStruct(feed_arrays[n].shape,
+                                   feed_arrays[n].dtype) for n in ordered]
+    exported = jax_export.export(jax.jit(replay))(shaped)
+    return {'feed_names': ordered,
+            'exported': bytes(exported.serialize()),
+            'n_fetch': len(fetch_vars)}
+
+
+def deserialize_program(data):
+    import pickle as _pickle
+    payload = _pickle.loads(data)
+    return LoadedProgram(payload['feed_names'], payload['exported'],
+                         payload['n_fetch'])
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle as _pickle
+    sc = _global_scope
+    state = {n: np.asarray(t._data) for n, t in sc._vars.items()}
+    return _pickle.dumps(state, protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle as _pickle
+    state = _pickle.loads(data)
+    for n, arr in state.items():
+        _global_scope._vars[n] = Tensor(jnp.asarray(arr), name=n)
+    return state
+
+
+def save_to_file(path, content):
+    with open(path, 'wb') as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, 'rb') as f:
+        return f.read()
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from ..framework import io_save
+    vars = vars or list(_global_scope._vars.values())
+    state = {getattr(v, 'name', 'var_%d' % i) or 'var_%d' % i:
+             np.asarray(v._data) for i, v in enumerate(vars)}
+    io_save.save(state, os.path.join(dirname, filename or '__vars__'))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    from ..framework import io_save
+    state = io_save.load(os.path.join(dirname, filename or '__vars__'),
+                         return_numpy=True)
+    if vars:
+        by_name = {getattr(v, 'name', None): v for v in vars}
+        for n, arr in state.items():
+            if n in by_name and by_name[n] is not None:
+                by_name[n]._data = jnp.asarray(arr)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    """numpy-level state surgery (reference io.py:2144)."""
+    from ..framework import io_save
+    return io_save.load(model_path, return_numpy=True)
+
+
+def set_program_state(program, state_dict):
+    for n, arr in state_dict.items():
+        var = _global_scope._vars.get(n)
+        if var is not None:
+            var._data = jnp.asarray(arr)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed->fetch computation (reference normalize_program):
+    returns the self-contained LoadedProgram."""
+    names = [getattr(v, 'name', None) or 'feed_%d' % i
+             for i, v in enumerate(feed_vars)]
+    payload = _export_program_payload(program, feed_vars, fetch_vars, names)
+    return LoadedProgram(payload['feed_names'], payload['exported'],
+                         payload['n_fetch'])
+
+from .. import amp  # noqa: F401,E402 — paddle.static.amp submodule parity

@@ -74,3 +74,59 @@ def test_save_load_inference_model_roundtrip(tmp_path):
     w, b = lin.weight.numpy(), lin.bias.numpy()
     np.testing.assert_allclose(got, np.maximum(feed @ w + b, 0),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_fluid_era_static_surface(tmp_path):
+    """append_backward / gradients / scopes / py_func / serialize
+    round-trip (reference fluid Executor-world APIs)."""
+    import jax.numpy as jnp
+    import paddle_tpu.nn.functional as F
+
+    # append_backward returns (param, grad) pairs off the tape
+    paddle.seed(0)
+    lin = nn.Linear(3, 2)
+    x = paddle.to_tensor(np.ones((4, 3), np.float32))
+    loss = F.mse_loss(lin(x), paddle.to_tensor(np.zeros((4, 2), np.float32)))
+    pairs = static.append_backward(loss)
+    names = {id(p) for p, g in pairs}
+    assert id(lin.weight) in names and id(lin.bias) in names
+    for p, g in pairs:
+        assert g is not None and g.shape == p.shape
+
+    # gradients() delegates to autograd.grad
+    a = paddle.to_tensor(np.asarray([2.0], np.float32), stop_gradient=False)
+    b = a * a
+    (ga,) = static.gradients([b], [a])
+    np.testing.assert_allclose(ga.numpy(), [4.0])
+
+    # scope machinery
+    sc = static.Scope()
+    with static.scope_guard(sc):
+        v = static.create_global_var([2], 1.5, 'float32', name='gv')
+        assert static.global_scope().find_var('gv') is v
+    assert static.global_scope().find_var('gv') is None
+
+    # py_func wraps a host callable as an op
+    xt = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    out_t = paddle.to_tensor(np.zeros(2, np.float32))
+    res = static.py_func(lambda arr: arr * 3.0, xt, out_t)
+    np.testing.assert_allclose(res.numpy(), [3.0, 6.0])
+
+    # serialize/deserialize a recorded program
+    prog = static.Program()
+    with static.program_guard(prog):
+        inp = static.data('x', [2, 3], 'float32')
+        lin2 = nn.Linear(3, 2)
+        out = lin2(inp)
+    blob = static.serialize_program([inp], [out], program=prog)
+    loaded = static.deserialize_program(blob)
+    exe = static.Executor()
+    feed = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    got = exe.run(loaded, feed={'x': feed}, fetch_list=[0])[0]
+    np.testing.assert_allclose(got, feed @ lin2.weight.numpy()
+                               + lin2.bias.numpy(), rtol=1e-5, atol=1e-5)
+
+    # normalize_program returns the pruned executable form
+    np_prog = static.normalize_program(prog, [inp], [out])
+    got2 = exe.run(np_prog, feed={'x': feed}, fetch_list=[0])[0]
+    np.testing.assert_allclose(got2, got, rtol=1e-6)

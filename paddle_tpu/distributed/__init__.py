@@ -8,9 +8,15 @@ from .collective import (ReduceOp, new_group, all_reduce, all_gather,  # noqa: F
 from .topology import (HybridCommunicateGroup, Group,  # noqa: F401
                        get_hybrid_communicate_group, default_mesh)
 from . import fleet  # noqa: F401
+from . import cloud_utils  # noqa: F401
+from .fleet import utils  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import strategy  # noqa: F401
 from . import checkpoint  # noqa: F401
 
-QueueDataset = None  # PS-mode dataset; see distributed/ps
+from .ps.dataset import MultiSlotDataset as QueueDataset  # noqa: F401
+from .ps.dataset import MultiSlotDataset as InMemoryDataset  # noqa: F401
+from .ps.dataset import BoxPSDataset  # noqa: F401
+from .ps.embedding_service import (CountFilterEntry,  # noqa: F401
+                                   ProbabilityEntry)

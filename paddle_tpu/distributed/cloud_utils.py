@@ -1,0 +1,25 @@
+"""Cloud cluster discovery (reference: distributed/cloud_utils.py — reads
+PADDLE_TRAINERS / POD_IP etc. set by cloud schedulers to assemble the
+trainer endpoint list)."""
+import os
+
+__all__ = ['get_cloud_cluster']
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
+                      selected_devices=None):
+    """Returns (node_ips, current_ip, trainer_endpoints) from cloud env
+    with CLI-args fallback."""
+    node_ips = os.environ.get('PADDLE_TRAINERS', args_node_ips or '127.0.0.1')
+    if isinstance(node_ips, str):
+        node_ips = node_ips.replace(' ', ',').split(',')
+    cur_ip = os.environ.get('POD_IP', args_node_ip or node_ips[0])
+    port = int(os.environ.get('PADDLE_PORT', args_port))
+    n_per = max(len(selected_devices or [0]), 1)
+    endpoints = ['%s:%d' % (ip, port + i)
+                 for ip in node_ips for i in range(n_per)]
+    return node_ips, cur_ip, endpoints
+
+
+def _get_trainers_num():
+    return int(os.environ.get('PADDLE_TRAINERS_NUM', 1))

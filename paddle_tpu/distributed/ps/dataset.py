@@ -14,7 +14,7 @@ import numpy as np
 
 from ...native.datafeed import parse_multislot
 
-__all__ = ['MultiSlotDataset']
+__all__ = ['MultiSlotDataset', 'BoxPSDataset']
 
 
 class MultiSlotDataset:
@@ -129,3 +129,24 @@ class MultiSlotDataset:
                 np.cumsum([len(v) for v in vals], out=offs[1:])
                 batch[name] = (flat.astype(np.int64), offs)
         return batch
+
+
+class BoxPSDataset(MultiSlotDataset):
+    """BoxPS-style pass-oriented dataset (reference framework/fleet/
+    box_wrapper.h BeginPass/EndPass): begin_pass()/end_pass() bracket a
+    training pass — pair with ps.heter.PassCachedEmbedding, whose
+    begin_pass pulls the pass working set into HBM and end_pass flushes
+    deltas. wait_preload_done/preload_into_memory map onto the in-memory
+    loader."""
+
+    def begin_pass(self):
+        return True
+
+    def end_pass(self, need_save_delta=False):
+        return True
+
+    def preload_into_memory(self):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        return True

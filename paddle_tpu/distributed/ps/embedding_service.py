@@ -21,7 +21,8 @@ import threading
 
 import numpy as np
 
-__all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient']
+__all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient',
+           'CountFilterEntry', 'ProbabilityEntry']
 
 
 class _SparseOptimizer:
@@ -50,12 +51,39 @@ class _SparseOptimizer:
         return rows, [m, v]
 
 
+class CountFilterEntry:
+    """Feature admission: materialize a row only after its id was seen
+    `count` times (reference distributed/common/ entry_attr count_filter —
+    keeps one-off ids from bloating 100B-feature tables)."""
+
+    def __init__(self, count=1):
+        if count < 1:
+            raise ValueError('count must be >= 1')
+        self.count = int(count)
+
+    def accept(self, seen_count, rng):
+        return seen_count >= self.count
+
+
+class ProbabilityEntry:
+    """Feature admission with probability p (entry_attr probability)."""
+
+    def __init__(self, probability=1.0):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError('probability must be in (0, 1]')
+        self.probability = float(probability)
+
+    def accept(self, seen_count, rng):
+        return rng.rand() < self.probability
+
+
 class EmbeddingTable:
-    """One shard: id -> row. On-demand init (common_sparse_table semantics);
-    thread-safe; save/load to directory of npz chunks."""
+    """One shard: id -> row. On-demand init (common_sparse_table semantics)
+    with optional entry-admission policy; thread-safe; save/load to
+    directory of npz chunks."""
 
     def __init__(self, dim, initializer='uniform', init_scale=0.01,
-                 optimizer='sgd', lr=0.01, seed=0):
+                 optimizer='sgd', lr=0.01, seed=0, entry=None):
         self.dim = dim
         self._rows = {}
         self._slots = {}
@@ -64,6 +92,8 @@ class EmbeddingTable:
         self._init_scale = init_scale
         self._initializer = initializer
         self._opt = _SparseOptimizer(optimizer, lr)
+        self._entry = entry
+        self._seen = {}
 
     def _new_row(self):
         if self._initializer == 'zeros':
@@ -77,6 +107,14 @@ class EmbeddingTable:
             for i, key in enumerate(ids):
                 row = self._rows.get(key)
                 if row is None:
+                    if self._entry is not None:
+                        seen = self._seen.get(key, 0) + 1
+                        self._seen[key] = seen
+                        if not self._entry.accept(seen, self._rng):
+                            # not admitted yet: serve zeros, keep nothing
+                            out[i] = 0.0
+                            continue
+                        self._seen.pop(key, None)
                     row = self._new_row()
                     self._rows[key] = row
                     nslots = self._opt.slot_count()

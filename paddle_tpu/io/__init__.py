@@ -13,9 +13,11 @@ from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
 from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         get_worker_info, WorkerInfo)
 
-__all__ = ['Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
+__all__ = ['get_worker_info', 'WorkerInfo',
+           'Dataset', 'IterableDataset', 'TensorDataset', 'ComposeDataset',
            'ChainDataset', 'Subset', 'random_split', 'Sampler',
            'SequenceSampler', 'RandomSampler', 'WeightedRandomSampler',
            'BatchSampler', 'DistributedBatchSampler', 'DataLoader']

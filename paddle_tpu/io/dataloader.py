@@ -53,8 +53,26 @@ def _np_collate(batch):
     return batch
 
 
+class WorkerInfo:
+    """Info for the current DataLoader worker (reference
+    dataloader/worker.py get_worker_info): None in the main process."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_info = [None]
+
+
+def get_worker_info():
+    return _worker_info[0]
+
+
 def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
-                 worker_init_fn):
+                 worker_init_fn, num_workers=0):
+    _worker_info[0] = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:

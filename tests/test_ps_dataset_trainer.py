@@ -317,3 +317,45 @@ def test_async_executor_facade(tmp_path):
         batch_size=16, epochs=2, shuffle_seed=0)
     assert len(losses) == 16  # 128 samples / 16 per batch * 2 epochs
     assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+
+def test_entry_admission_policies():
+    """CountFilterEntry admits a feature only after N sightings;
+    ProbabilityEntry samples admission (reference entry_attr)."""
+    from paddle_tpu.distributed.ps.embedding_service import (
+        EmbeddingTable, CountFilterEntry, ProbabilityEntry)
+    t = EmbeddingTable(4, entry=CountFilterEntry(3), init_scale=0.5)
+    ids = np.asarray([7], np.int64)
+    r1 = t.pull(ids)
+    r2 = t.pull(ids)
+    np.testing.assert_array_equal(r1, 0.0)  # sightings 1, 2: zeros
+    np.testing.assert_array_equal(r2, 0.0)
+    assert len(t) == 0
+    r3 = t.pull(ids)                        # 3rd sighting: admitted
+    assert len(t) == 1 and np.abs(r3).sum() > 0
+
+    t2 = EmbeddingTable(4, entry=ProbabilityEntry(1.0))
+    t2.pull(np.asarray([1], np.int64))
+    assert len(t2) == 1  # p=1 admits immediately
+
+
+def test_get_worker_info_in_workers(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+    assert get_worker_info() is None  # main process
+
+    class DS(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            from paddle_tpu.io import get_worker_info as gwi
+            info = gwi()
+            wid = -1 if info is None else info.id
+            return np.asarray([i, wid], np.int64)
+
+    loader = DataLoader(DS(), batch_size=4, num_workers=2, shuffle=False)
+    rows = np.concatenate([np.asarray(b) for b in loader])
+    # every sample saw a real worker id (0 or 1), never the main proc
+    assert set(rows[:, 1].tolist()) <= {0, 1}

@@ -15,8 +15,12 @@ from .framework.core import is_grad_enabled, set_grad_enabled  # noqa: F401
 from .framework.dtype import set_default_dtype, get_default_dtype  # noqa: F401
 from .framework.device import (set_device, get_device, device_count,  # noqa: F401
                                is_compiled_with_cuda, is_compiled_with_xpu,
-                               is_compiled_with_npu)
+                               is_compiled_with_npu, is_compiled_with_rocm,
+                               get_cudnn_version, CPUPlace, CUDAPlace,
+                               CUDAPinnedPlace, XPUPlace, NPUPlace)
 from .framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from .framework.random import (get_rng_state as get_cuda_rng_state,  # noqa: F401
+                               set_rng_state as set_cuda_rng_state)
 
 # dtype singletons (paddle.float32 etc.)
 float16 = 'float16'
@@ -112,3 +116,48 @@ class version:
     @staticmethod
     def show():
         print('paddle_tpu', version.full_version)
+
+# eager/dygraph mode facades: this framework is always-eager with jit
+# compilation (SURVEY §7.1) — the reference's mode switch is a constant
+VarBase = Tensor
+
+
+def in_dygraph_mode():
+    return True
+
+
+def enable_dygraph(place=None):
+    pass
+
+
+def disable_dygraph():
+    pass
+
+
+enable_imperative = enable_dygraph
+disable_imperative = disable_dygraph
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .static import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    from .tensor.manipulation import crop
+    return crop(x, shape=shape, offsets=offsets)
+
+
+def monkey_patch_variable():  # no-op: Tensor methods are always patched
+    pass
+
+
+def monkey_patch_math_varbase():
+    pass
+
+
+class dtype(str):
+    """paddle.dtype: dtypes are canonical strings here; the class exists
+    so isinstance(x.dtype, paddle.dtype)-style checks can be ported."""

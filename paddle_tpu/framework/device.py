@@ -73,3 +73,47 @@ def device_count(backend=None):
         return len(jax.devices(backend) if backend else jax.devices())
     except RuntimeError:
         return 0
+
+
+class _Place:
+    """Place facades (reference platform/place.h variants): on TPU all
+    compute places resolve to the accelerator; identities kept for API
+    parity and isinstance checks."""
+
+    def __init__(self, device_id=0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return '%s(%d)' % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and \
+            self.device_id == other.device_id
+
+
+class CPUPlace(_Place):
+    pass
+
+
+class CUDAPlace(_Place):
+    pass
+
+
+class CUDAPinnedPlace(_Place):
+    pass
+
+
+class XPUPlace(_Place):
+    pass
+
+
+class NPUPlace(_Place):
+    pass
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on TPU (reference returns None when absent)
+
+
+def is_compiled_with_rocm():
+    return False

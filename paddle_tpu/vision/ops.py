@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from ..framework.core import Tensor, run_op, wrap_out
 from ..tensor._helpers import ensure_tensor
 
-__all__ = ['yolo_box', 'yolo_loss', 'nms', 'roi_align', 'roi_pool',
+__all__ = ['read_file', 'decode_jpeg',
+           'yolo_box', 'yolo_loss', 'nms', 'roi_align', 'roi_pool',
            'box_coder', 'prior_box', 'deform_conv2d', 'DeformConv2D',
            'distribute_fpn_proposals', 'generate_proposals', 'PSRoIPool',
            'RoIAlign', 'RoIPool']
@@ -497,3 +498,32 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, rscores, wrap_out(jnp.asarray(np.asarray(nums, np.int32)))
     return rois, rscores
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference read_file_op)."""
+    from ..framework.core import Tensor
+    with open(filename, 'rb') as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode='unchanged', name=None):
+    """JPEG bytes tensor -> image tensor [C, H, W] uint8 (reference
+    decode_jpeg op, nvjpeg-backed there; PIL-backed host decode here)."""
+    import io as _io
+    from PIL import Image
+    from ..framework.core import Tensor
+    data = bytes(np.asarray(x._data if hasattr(x, '_data') else x,
+                            np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(data))
+    if mode == 'gray':
+        img = img.convert('L')
+    elif mode == 'rgb':
+        img = img.convert('RGB')
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -1,2 +1,7 @@
 from .transforms import *  # noqa: F401,F403
 from . import functional  # noqa: F401
+
+from .functional import (to_tensor, normalize, resize, crop,  # noqa: F401
+                         center_crop, hflip, vflip, pad, rotate,
+                         adjust_brightness, adjust_contrast,
+                         adjust_saturation, adjust_hue, to_grayscale)

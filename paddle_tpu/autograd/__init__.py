@@ -110,3 +110,15 @@ class PyLayer:
 
 class LegacyPyLayer(PyLayer):
     pass
+
+
+class backward_mode:
+    """reference autograd/backward_mode.py: backward(tensors, grads) over
+    the tape."""
+
+    @staticmethod
+    def backward(tensors, grad_tensors=None, retain_graph=False):
+        tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+        grads = grad_tensors or [None] * len(tensors)
+        for t, g in zip(tensors, grads):
+            t.backward(g, retain_graph=retain_graph)

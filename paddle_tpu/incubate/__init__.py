@@ -52,3 +52,21 @@ class autograd:
 from . import optimizer  # noqa: F401
 from . import moe  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (reference incubate op
+    softmax_mask_fuse_upper_triangle — a CUDA fusion; XLA fuses the jnp
+    form). x: [B, H, N, N] attention scores."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import run_op
+
+    def fn(a):
+        n = a.shape[-1]
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask, a, -1e30)
+        return jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(a.dtype)
+    return run_op('softmax_mask_fuse_upper_triangle', fn, x)

@@ -335,3 +335,49 @@ def load(path, **configs):
                         t._data = jnp.asarray(t._data)
     return TranslatedLayer(layer, state['params'], state['buffers'],
                            meta=model_payload.get('meta'))
+
+
+class ProgramTranslator:
+    """Singleton facade (reference program_translator.py:759): jit tracing
+    replaces the AST transpiler, so enable/disable toggles a global
+    passthrough flag consumed by to_static wrappers."""
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        ProgramTranslator._enabled = bool(enable_to_static)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    pass  # transpiler diagnostics have no analog: tracing IS the program
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    pass
+
+
+class TracedLayer:
+    """reference dygraph/jit.py TracedLayer: trace once, replay compiled.
+    Static-shape jit trace over a Layer call."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._fn = to_static(layer.forward)
+        self._example = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        t = TracedLayer(layer, inputs)
+        return t._fn(*inputs), t
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kw):
+        save(self._layer, path, input_spec=self._example)

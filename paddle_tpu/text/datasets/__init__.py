@@ -17,7 +17,8 @@ import numpy as np
 
 from ...io.dataset import Dataset
 
-__all__ = ['Imdb', 'Conll05st', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16',
+__all__ = [
+    'Imikolov','Imdb', 'Conll05st', 'Movielens', 'UCIHousing', 'WMT14', 'WMT16',
            'FakeTextDataset', 'FakeLMDataset', 'MovieInfo', 'UserInfo']
 
 
@@ -478,3 +479,57 @@ class WMT16(_WMTBase):
                 if w and w not in d and (size < 0 or len(d) < size):
                     d[w] = len(d)
         return d
+
+
+class Imikolov(Dataset):
+    """PTB n-gram dataset (reference text/datasets/imikolov.py over
+    simple-examples.tgz ./data/ptb.{train,valid}.txt): builds the word
+    dict from train+valid, yields n-grams ('NGRAM' type) or (src, trg)
+    sequence pairs ('SEQ')."""
+
+    def __init__(self, data_file=None, data_type='NGRAM', window_size=5,
+                 mode='train', min_word_freq=50, download=False):
+        assert data_type in ('NGRAM', 'SEQ')
+        path = _resolve(data_file, 'imikolov', 'simple-examples.tgz')
+        member = './data/ptb.%s.txt' % ('train' if mode == 'train'
+                                        else 'valid')
+        texts = {}
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                if m.name.endswith(('ptb.train.txt', 'ptb.valid.txt')):
+                    texts[m.name] = tf.extractfile(m).read().decode(
+                        'utf-8', 'ignore')
+        freq = {}
+        for body in texts.values():
+            for w in body.split():
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted((w for w, c in freq.items()
+                        if c >= min_word_freq and w != '<unk>'),
+                       key=lambda w: (-freq[w], w))
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx['<unk>'] = len(self.word_idx)
+        self.word_idx.setdefault('<s>', len(self.word_idx))
+        self.word_idx.setdefault('<e>', len(self.word_idx))
+        unk = self.word_idx['<unk>']
+
+        body = next((t for n, t in texts.items() if n.endswith(
+            'ptb.train.txt' if mode == 'train' else 'ptb.valid.txt')), '')
+        self.data = []
+        for line in body.splitlines():
+            toks = ['<s>'] + line.split() + ['<e>']
+            ids = [self.word_idx.get(w, unk) for w in toks]
+            if data_type == 'NGRAM':
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(
+                            np.asarray(ids[i - window_size:i], np.int64))
+            else:
+                if len(ids) > 2:
+                    self.data.append((np.asarray(ids[:-1], np.int64),
+                                      np.asarray(ids[1:], np.int64)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]

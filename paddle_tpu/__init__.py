@@ -158,6 +158,4 @@ def monkey_patch_math_varbase():
     pass
 
 
-class dtype(str):
-    """paddle.dtype: dtypes are canonical strings here; the class exists
-    so isinstance(x.dtype, paddle.dtype)-style checks can be ported."""
+from .framework.dtype import DTypeStr as dtype  # noqa: F401,E402

@@ -10,9 +10,10 @@ def get_cloud_cluster(args_node_ips=None, args_node_ip=None, args_port=6170,
                       selected_devices=None):
     """Returns (node_ips, current_ip, trainer_endpoints) from cloud env
     with CLI-args fallback."""
+    import re as _re
     node_ips = os.environ.get('PADDLE_TRAINERS', args_node_ips or '127.0.0.1')
     if isinstance(node_ips, str):
-        node_ips = node_ips.replace(' ', ',').split(',')
+        node_ips = [ip for ip in _re.split(r'[,\s]+', node_ips) if ip]
     cur_ip = os.environ.get('POD_IP', args_node_ip or node_ips[0])
     port = int(os.environ.get('PADDLE_PORT', args_port))
     n_per = max(len(selected_devices or [0]), 1)

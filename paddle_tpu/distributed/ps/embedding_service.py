@@ -56,6 +56,8 @@ class CountFilterEntry:
     `count` times (reference distributed/common/ entry_attr count_filter —
     keeps one-off ids from bloating 100B-feature tables)."""
 
+    tracks_count = True
+
     def __init__(self, count=1):
         if count < 1:
             raise ValueError('count must be >= 1')
@@ -66,7 +68,10 @@ class CountFilterEntry:
 
 
 class ProbabilityEntry:
-    """Feature admission with probability p (entry_attr probability)."""
+    """Feature admission with probability p (entry_attr probability) —
+    memoryless, so no per-id sighting state is kept."""
+
+    tracks_count = False
 
     def __init__(self, probability=1.0):
         if not 0.0 < probability <= 1.0:
@@ -108,10 +113,15 @@ class EmbeddingTable:
                 row = self._rows.get(key)
                 if row is None:
                     if self._entry is not None:
-                        seen = self._seen.get(key, 0) + 1
-                        self._seen[key] = seen
+                        seen = 1
+                        if getattr(self._entry, 'tracks_count', False):
+                            seen = self._seen.get(key, 0) + 1
                         if not self._entry.accept(seen, self._rng):
-                            # not admitted yet: serve zeros, keep nothing
+                            # not admitted yet: serve zeros; count-based
+                            # policies remember the sighting, memoryless
+                            # ones keep nothing
+                            if getattr(self._entry, 'tracks_count', False):
+                                self._seen[key] = seen
                             out[i] = 0.0
                             continue
                         self._seen.pop(key, None)
@@ -155,13 +165,19 @@ class EmbeddingTable:
             keys = np.asarray(list(self._rows.keys()), np.int64)
             vals = np.stack(list(self._rows.values())) if self._rows else \
                 np.zeros((0, self.dim), np.float32)
-        np.savez(os.path.join(path, 'shard.npz'), keys=keys, vals=vals)
+            seen_keys = np.asarray(list(self._seen.keys()), np.int64)
+            seen_vals = np.asarray(list(self._seen.values()), np.int64)
+        np.savez(os.path.join(path, 'shard.npz'), keys=keys, vals=vals,
+                 seen_keys=seen_keys, seen_vals=seen_vals)
 
     def load(self, path):
         data = np.load(os.path.join(path, 'shard.npz'))
         with self._lock:
             self._rows = {int(k): v for k, v in zip(data['keys'],
                                                     data['vals'])}
+            if 'seen_keys' in data:
+                self._seen = {int(k): int(v) for k, v in
+                              zip(data['seen_keys'], data['seen_vals'])}
 
     def shrink(self, threshold=0):
         pass

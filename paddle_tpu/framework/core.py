@@ -272,7 +272,9 @@ class Tensor:
 
     @property
     def dtype(self):
-        return dtype_mod.convert_dtype(self._data.dtype)
+        # DTypeStr: a str subclass so isinstance(x.dtype, paddle.dtype)
+        # checks in ported reference code hold
+        return dtype_mod.DTypeStr(dtype_mod.convert_dtype(self._data.dtype))
 
     @property
     def place(self):

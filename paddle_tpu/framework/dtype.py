@@ -90,3 +90,9 @@ def set_default_dtype(d):
 
 def get_default_dtype():
     return _DEFAULT_DTYPE[0]
+
+
+class DTypeStr(str):
+    """paddle.dtype: dtypes are canonical strings that ALSO satisfy
+    isinstance(x.dtype, paddle.dtype) for ported reference code."""
+    __slots__ = ()

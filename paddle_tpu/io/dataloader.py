@@ -160,7 +160,8 @@ class DataLoader:
             iq = ctx.Queue()
             w = ctx.Process(target=_worker_loop,
                             args=(self.dataset, iq, data_queue, collate, wid,
-                                  self.worker_init_fn), daemon=True)
+                                  self.worker_init_fn, self.num_workers),
+                            daemon=True)
             w.start()
             index_queues.append(iq)
             workers.append(w)

@@ -510,18 +510,50 @@ def Print(input, first_n=-1, message=None, summarize=20,
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     """Reference operators/py_func_op: wrap a host python callable as an
-    op via pure_callback. `out` provides the result template(s)."""
+    op via pure_callback. `out` provides the result template(s).
+    backward_func(*inputs, *output_grads) -> input grads wires the custom
+    gradient; without it, gradient-requiring inputs raise (a host
+    callback has no automatic derivative)."""
     from ..framework.core import run_op
     xs = x if isinstance(x, (list, tuple)) else [x]
     outs = out if isinstance(out, (list, tuple)) else [out]
     shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
               for o in outs]
+    needs_grad = any(not getattr(t, 'stop_gradient', True) for t in xs)
+    if needs_grad and backward_func is None:
+        raise ValueError(
+            'py_func input requires grad but no backward_func was given — '
+            'host callbacks have no automatic derivative (reference '
+            'py_func_op needs one too)')
 
-    def fn(*arrays):
+    def call_fwd(*arrays):
         res = jax.pure_callback(
             lambda *a: func(*[np.asarray(v) for v in a]),
             shapes if len(shapes) > 1 else shapes[0], *arrays)
         return tuple(res) if isinstance(res, (list, tuple)) else res
+
+    if backward_func is None:
+        return run_op('py_func', call_fwd, *xs)
+
+    in_shapes = [jax.ShapeDtypeStruct(tuple(t.shape), t._data.dtype)
+                 for t in xs]
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        return call_fwd(*arrays)
+
+    def fwd(*arrays):
+        return fn(*arrays), arrays
+
+    def bwd(res_arrays, g):
+        gs = g if isinstance(g, tuple) else (g,)
+        dx = jax.pure_callback(
+            lambda *a: backward_func(*[np.asarray(v) for v in a]),
+            in_shapes if len(in_shapes) > 1 else in_shapes[0],
+            *res_arrays, *gs)
+        return tuple(dx) if isinstance(dx, (list, tuple)) else (dx,)
+
+    fn.defvjp(fwd, bwd)
     return run_op('py_func', fn, *xs)
 
 
@@ -624,18 +656,43 @@ def deserialize_program(data):
                          payload['n_fetch'])
 
 
+def _program_parameters(program):
+    """Parameters appearing as recorded-op inputs, in discovery order."""
+    from ..framework.core import Parameter
+    seen, out = set(), []
+    for _fn, ins, _outs in program._ops:
+        for t in ins:
+            if isinstance(t, Parameter) and id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+    return out
+
+
 def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    """Weights of the program's recorded Parameters (+ global-scope
+    vars), keyed by name or discovery index."""
     import pickle as _pickle
-    sc = _global_scope
-    state = {n: np.asarray(t._data) for n, t in sc._vars.items()}
+    program = program or _main_program
+    state = {}
+    for i, p in enumerate(_program_parameters(program)):
+        state[p.name or 'param_%d' % i] = np.asarray(p._data)
+    for n, t in _global_scope._vars.items():
+        state.setdefault(n, np.asarray(t._data))
     return _pickle.dumps(state, protocol=4)
 
 
 def deserialize_persistables(program, data, executor=None):
     import pickle as _pickle
     state = _pickle.loads(data)
+    params = _program_parameters(program) if program is not None \
+        and getattr(program, '_ops', None) else []
+    for i, p in enumerate(params):
+        key = p.name or 'param_%d' % i
+        if key in state:
+            p._data = jnp.asarray(state[key])
     for n, arr in state.items():
-        _global_scope._vars[n] = Tensor(jnp.asarray(arr), name=n)
+        if n in _global_scope._vars:
+            _global_scope._vars[n]._data = jnp.asarray(arr)
     return state
 
 

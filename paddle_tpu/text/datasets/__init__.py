@@ -491,8 +491,7 @@ class Imikolov(Dataset):
                  mode='train', min_word_freq=50, download=False):
         assert data_type in ('NGRAM', 'SEQ')
         path = _resolve(data_file, 'imikolov', 'simple-examples.tgz')
-        member = './data/ptb.%s.txt' % ('train' if mode == 'train'
-                                        else 'valid')
+        member = 'ptb.%s.txt' % ('train' if mode == 'train' else 'valid')
         texts = {}
         with tarfile.open(path) as tf:
             for m in tf.getmembers():
@@ -512,8 +511,8 @@ class Imikolov(Dataset):
         self.word_idx.setdefault('<e>', len(self.word_idx))
         unk = self.word_idx['<unk>']
 
-        body = next((t for n, t in texts.items() if n.endswith(
-            'ptb.train.txt' if mode == 'train' else 'ptb.valid.txt')), '')
+        body = next((t for n, t in texts.items() if n.endswith(member)),
+                    '')
         self.data = []
         for line in body.splitlines():
             toks = ['<s>'] + line.split() + ['<e>']

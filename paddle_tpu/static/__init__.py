@@ -369,23 +369,7 @@ def load_inference_model(path_prefix, executor, **kwargs):
     return [prog, list(prog.feed_names), list(range(prog.n_fetch))]
 
 
-class nn:
-    """paddle.static.nn shim: the static layer builders map to eager nn
-    functional calls (fc -> linear etc.)."""
-
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
-        from .. import nn as _nn
-        from ..tensor.manipulation import flatten
-        xf = flatten(x, start_axis=num_flatten_dims) \
-            if num_flatten_dims != 1 else x
-        lin = _nn.Linear(xf.shape[-1], size)
-        out = lin(xf)
-        if activation:
-            out = getattr(_nn.functional, activation)(out)
-        return out
-
-
+from . import nn  # noqa: E402,F401
 # -- fluid-era static surface (reference: python/paddle/static/__init__.py
 # re-exports of fluid Executor-world APIs) ----------------------------------
 

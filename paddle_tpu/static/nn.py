@@ -1,0 +1,343 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/__init__.py —
+static layer builders + the control-flow ops of
+fluid/layers/control_flow.py).
+
+TPU-native control flow: cond/case/switch_case/while_loop ARE
+lax.cond/lax.switch/lax.while_loop (SURVEY §7.1 — the reference's
+conditional_block/while ops compile to XLA control flow here, no
+sub-block machinery). cond and switch_case differentiate through the
+tape; while_loop is forward-only (XLA while has no reverse — use
+lax.scan-style bounded loops in differentiable paths, same guidance the
+reference gives for DynamicRNN).
+
+sequence_* builders are deliberately not ported (SURVEY §7.5: ragged
+data rides masks — see nn.functional.sequence_mask); they raise with
+that guidance.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor, run_op, no_grad_guard
+
+__all__ = ['fc', 'cond', 'case', 'switch_case', 'while_loop', 'embedding',
+           'batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
+           'prelu', 'conv2d', 'conv2d_transpose', 'conv3d', 'spectral_norm',
+           'create_parameter', 'py_func', 'data_norm', 'nce',
+           'sparse_embedding', 'bilinear_tensor_product', 'deform_conv2d']
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_wrap_tree(v) for v in tree)
+    return Tensor(tree) if not isinstance(tree, Tensor) else tree
+
+
+def _unwrap_tree(tree):
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_unwrap_tree(v) for v in tree)
+    return _unwrap(tree)
+
+
+# -- control flow ------------------------------------------------------------
+
+def _record_branch(fn):
+    """Run a branch builder eagerly while recording its paddle ops
+    (core._fwd_recorder — the same hook static.program_guard uses).
+    Mirrors the reference: cond BUILDS both sub-blocks
+    (conditional_block ops) at construction time."""
+    from ..framework import core as core_mod
+    rec = []
+    prev = core_mod._fwd_recorder[0]
+    core_mod._fwd_recorder[0] = \
+        lambda f, ins, outs: rec.append((f, list(ins), list(outs)))
+    try:
+        out = fn()
+    finally:
+        core_mod._fwd_recorder[0] = prev
+    return out, rec
+
+
+def _branch_leaves(rec):
+    """Input Tensors of a recording that no earlier recorded op produced
+    — the operands grads must flow to."""
+    produced = set()
+    leaves, seen = [], set()
+    for _f, ins, outs in rec:
+        for t in ins:
+            if id(t) not in produced and id(t) not in seen:
+                seen.add(id(t))
+                leaves.append(t)
+        produced.update(id(t) for t in outs)
+    return leaves
+
+
+def _replay_rec(rec, result, env):
+    """Re-evaluate a branch recording with `env` (id -> array)."""
+    for f, ins, outs in rec:
+        arrays = [env.get(id(t), t._data) for t in ins]
+        res = f(*arrays)
+        res = res if isinstance(res, tuple) else (res,)
+        for t, a in zip(outs, res):
+            env[id(t)] = a
+
+    def resolve(tree):
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(resolve(v) for v in tree)
+        if isinstance(tree, Tensor):
+            return env.get(id(tree), tree._data)
+        return tree
+    return resolve(result)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """lax.cond (reference control_flow.py cond / conditional_block op).
+    Both branches are built once eagerly (the reference builds both
+    sub-blocks too) and replayed inside lax.cond; every leaf Tensor a
+    branch reads becomes a tape operand, so grads flow."""
+    t_out, t_rec = _record_branch(true_fn)
+    f_out, f_rec = _record_branch(false_fn)
+    leaves, seen = [], set()
+    for t in _branch_leaves(t_rec) + _branch_leaves(f_rec):
+        if id(t) not in seen:
+            seen.add(id(t))
+            leaves.append(t)
+
+    def fn(p, *arrays):
+        env0 = {id(t): a for t, a in zip(leaves, arrays)}
+
+        def tf(_):
+            return _unwrap_tree(_replay_rec(t_rec, t_out, dict(env0)))
+
+        def ff(_):
+            return _unwrap_tree(_replay_rec(f_rec, f_out, dict(env0)))
+
+        return lax.cond(jnp.reshape(p, ()).astype(bool), tf, ff, None)
+
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(pred)
+    return _wrap_tree(run_op('cond', fn, pred_t, *leaves))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins chain of conds (reference control_flow.case)."""
+    if not pred_fn_pairs:
+        raise ValueError('case needs at least one (pred, fn) pair')
+
+    def build(pairs):
+        (p, fn) = pairs[0]
+        if len(pairs) == 1:
+            if default is None:
+                return fn()
+            return cond(p, fn, default)
+        return cond(p, fn, lambda: build(pairs[1:]))
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """lax.switch (reference control_flow.switch_case). branch_fns:
+    {index: fn} or [(index, fn)] or [fn, ...]."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    max_idx = items[-1][0]
+    table = {}
+    for i, f in items:
+        table[int(i)] = f
+    fallback = default or items[-1][1]
+    branches = [table.get(i, fallback) for i in range(max_idx + 1)] + \
+        [fallback]
+
+    idx = jnp.clip(jnp.reshape(_unwrap(branch_index), ()).astype(jnp.int32),
+                   0, max_idx + 1)
+    in_table = jnp.isin(jnp.reshape(_unwrap(branch_index), ()),
+                        jnp.asarray(sorted(table)))
+    idx = jnp.where(in_table, idx, max_idx + 1)
+    out = lax.switch(idx, [lambda _, f=f: _unwrap_tree(f())
+                           for f in branches], None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """lax.while_loop (reference control_flow.while_loop / while op).
+    Forward-only: XLA's while has no reverse-mode — outputs come back
+    stop_gradient=True."""
+    init = _unwrap_tree(list(loop_vars))
+
+    def c(vs):
+        return jnp.reshape(_unwrap(cond_fn(*_wrap_tree(vs))), ()).astype(bool)
+
+    def b(vs):
+        out = body_fn(*_wrap_tree(vs))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return _unwrap_tree(list(out))
+
+    with no_grad_guard():
+        out = lax.while_loop(c, b, init)
+    return _wrap_tree(list(out))
+
+
+# -- layer builders over the functional/eager surface ------------------------
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None, **kw):
+    from .. import nn as _nn
+    from ..tensor.manipulation import flatten
+    xf = flatten(x, start_axis=num_flatten_dims) \
+        if num_flatten_dims != 1 else x
+    lin = _nn.Linear(xf.shape[-1], size)
+    out = lin(xf)
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype='float32'):
+    from .. import nn as _nn
+    emb = _nn.Embedding(size[0], size[1], padding_idx=padding_idx)
+    return emb(input)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, **kw):
+    from .. import nn as _nn
+    bn = _nn.BatchNorm2D(input.shape[1], momentum=momentum, epsilon=epsilon)
+    out = bn(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, **kw):
+    from ..nn import functional as F
+    shape = input.shape[begin_norm_axis:]
+    import numpy as _np
+    n = int(_np.prod(shape))
+    w = Tensor(jnp.ones(shape, jnp.float32)) if scale else None
+    b = Tensor(jnp.zeros(shape, jnp.float32)) if shift else None
+    return F.layer_norm(input, shape, weight=w, bias=b)
+
+
+def instance_norm(input, epsilon=1e-5, **kw):
+    from .. import nn as _nn
+    return _nn.InstanceNorm2D(input.shape[1], epsilon=epsilon)(input)
+
+
+def group_norm(input, groups, epsilon=1e-5, **kw):
+    from .. import nn as _nn
+    return _nn.GroupNorm(groups, input.shape[1], epsilon=epsilon)(input)
+
+
+def prelu(x, mode='all', param_attr=None, **kw):
+    from ..nn import functional as F
+    n = 1 if mode == 'all' else x.shape[1]
+    return F.prelu(x, Tensor(jnp.full((n,), 0.25, jnp.float32)))
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, act=None, **kw):
+    from .. import nn as _nn
+    conv = _nn.Conv2D(input.shape[1], num_filters, filter_size,
+                      stride=stride, padding=padding, dilation=dilation,
+                      groups=groups)
+    out = conv(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, stride=1,
+                     padding=0, **kw):
+    from .. import nn as _nn
+    conv = _nn.Conv2DTranspose(input.shape[1], num_filters,
+                               filter_size or 3, stride=stride,
+                               padding=padding)
+    return conv(input)
+
+
+def conv3d(input, num_filters, filter_size, **kw):
+    from .. import nn as _nn
+    return _nn.Conv3D(input.shape[1], num_filters, filter_size)(input)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, **kw):
+    from ..nn.utils_weight_norm import _l2norm  # reuse if present
+    raise NotImplementedError(
+        'spectral_norm: use nn.utils.spectral_norm on the Layer instead')
+
+
+def create_parameter(*args, **kwargs):
+    from . import create_parameter as _cp
+    return _cp(*args, **kwargs)
+
+
+def py_func(*args, **kwargs):
+    from . import py_func as _pf
+    return _pf(*args, **kwargs)
+
+
+def data_norm(input, **kw):
+    # data_norm = batch stats normalization without scale/shift learning
+    from ..framework.core import run_op
+
+    def fn(a):
+        mu = jnp.mean(a, axis=0, keepdims=True)
+        var = jnp.var(a, axis=0, keepdims=True)
+        return (a - mu) / jnp.sqrt(var + 1e-5)
+    return run_op('data_norm', fn, input)
+
+
+def nce(input, label, num_total_classes, **kw):
+    raise NotImplementedError(
+        'nce: use nn.functional.hsigmoid_loss or sampled softmax via '
+        'paddle_tpu ops — the NCE op family is superseded')
+
+
+def sparse_embedding(input, size, **kw):
+    from ..distributed.ps.heter import HeterEmbedding
+    raise NotImplementedError(
+        'sparse_embedding (PS-backed): construct distributed.ps.'
+        'HeterEmbedding(client, table_id, dim) with an embedding service '
+        'client — the 100B-feature path needs the explicit service handle')
+
+
+def bilinear_tensor_product(x, y, size, **kw):
+    from ..framework.core import run_op, Parameter
+    import numpy as _np
+    w = Parameter((_np.random.RandomState(0).randn(
+        size, x.shape[-1], y.shape[-1]) * 0.01).astype(_np.float32))
+
+    def fn(a, b, ww):
+        return jnp.einsum('bi,kij,bj->bk', a, ww, b)
+    return run_op('bilinear_tensor_product', fn, x, y, w)
+
+
+def deform_conv2d(*args, **kwargs):
+    from ..vision.ops import deform_conv2d as _dc
+    return _dc(*args, **kwargs)
+
+
+def _sequence_unsupported(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            '%s: LoD sequence ops are not ported (SURVEY §7.5) — ragged '
+            'data rides masks on TPU; see nn.functional.sequence_mask'
+            % name)
+    fn.__name__ = name
+    return fn
+
+
+for _n in ('sequence_conv', 'sequence_softmax', 'sequence_pool',
+           'sequence_concat', 'sequence_first_step', 'sequence_last_step',
+           'sequence_slice', 'sequence_expand', 'sequence_expand_as',
+           'sequence_pad', 'sequence_unpad', 'sequence_reshape',
+           'sequence_scatter', 'sequence_enumerate', 'crf_decoding',
+           'row_conv', 'multi_box_head'):
+    globals()[_n] = _sequence_unsupported(_n)
+    __all__.append(_n)

@@ -130,3 +130,45 @@ def test_fluid_era_static_surface(tmp_path):
     np_prog = static.normalize_program(prog, [inp], [out])
     got2 = exe.run(np_prog, feed={'x': feed}, fetch_list=[0])[0]
     np.testing.assert_allclose(got2, got, rtol=1e-6)
+
+
+def test_static_nn_control_flow():
+    """cond/case/switch_case/while_loop over lax control flow
+    (reference fluid/layers/control_flow.py); cond grads flow to leaves
+    of BOTH branches via the record-and-replay tape operands."""
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.asarray([2.0], np.float32),
+                         stop_gradient=False)
+    out = static.nn.cond(paddle.to_tensor(True), lambda: x * 3,
+                         lambda: x * 5)
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0])
+    x.clear_grad()
+    out2 = static.nn.cond(paddle.to_tensor(False), lambda: x * 3,
+                          lambda: x * 5)
+    out2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    assert float(out2.numpy()[0]) == 10.0
+
+    r = static.nn.switch_case(
+        paddle.to_tensor(1),
+        {0: lambda: paddle.to_tensor(np.float32(10.)),
+         1: lambda: paddle.to_tensor(np.float32(20.))})
+    assert float(r.numpy()) == 20.0
+
+    i = paddle.to_tensor(np.asarray(0, np.int32))
+    s = paddle.to_tensor(np.asarray(0.0, np.float32))
+    iv, sv = static.nn.while_loop(lambda i, s: i < 5,
+                                  lambda i, s: [i + 1, s + 2.0], [i, s])
+    assert int(iv.numpy()) == 5 and float(sv.numpy()) == 10.0
+
+    c = static.nn.case(
+        [(paddle.to_tensor(False), lambda: paddle.to_tensor(np.float32(1.))),
+         (paddle.to_tensor(True), lambda: paddle.to_tensor(np.float32(2.)))],
+        default=lambda: paddle.to_tensor(np.float32(3.)))
+    assert float(c.numpy()) == 2.0
+
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError, match='sequence'):
+        static.nn.sequence_pool(None, 'sum')

@@ -30,6 +30,40 @@ _PROBE_SRC = (
     "print('PLATFORM=' + jax.devices()[0].platform)\n"
 )
 
+# A trivial Mosaic kernel: decides whether flash attempts are even worth
+# their child timeout. The axon relay's remote Pallas compile service can
+# wedge (hang, not error) — when THIS hangs, every pallas_call will, so
+# the ladder should jump straight to the flash-disabled rung instead of
+# burning 2x1500s on doomed children.
+_PALLAS_PROBE_SRC = (
+    "import jax, jax.numpy as jnp\n"
+    "from jax.experimental import pallas as pl\n"
+    "def k(x_ref, o_ref):\n"
+    "    o_ref[...] = x_ref[...] * 2.0\n"
+    "x = jnp.ones((256, 256), jnp.float32)\n"
+    "y = pl.pallas_call(\n"
+    "    k, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)\n"
+    "assert float(y[0, 0]) == 2.0\n"
+    "print('PALLAS=ok')\n"
+)
+
+
+def _probe_pallas(timeout=None):
+    """True iff a trivial pallas_call compiles+runs on the backend."""
+    if timeout is None:
+        timeout = int(os.environ.get('PADDLE_TPU_BENCH_PALLAS_PROBE_TIMEOUT',
+                                     300))
+    try:
+        proc = subprocess.run([sys.executable, '-c', _PALLAS_PROBE_SRC],
+                              capture_output=True, text=True,
+                              timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, 'pallas probe hung (>%ds)' % timeout
+    if 'PALLAS=ok' in proc.stdout:
+        return True, None
+    return False, 'pallas probe rc=%d: %s' % (proc.returncode,
+                                              (proc.stderr or '')[-400:])
+
 
 def _run_measurement():
     """Child-process body: the actual benchmark. Prints one JSON line."""
@@ -95,9 +129,15 @@ def _run_measurement():
                 os.environ.get('PADDLE_TPU_FLASH_DISABLE') != '1':
             raise RuntimeError('flash pallas_call absent from the step jaxpr')
 
-    # warmup/compile
-    step(ids, labels)
-    step(ids, labels).numpy()
+    # warmup/compile. The axon tunnel's dispatch path ramps over the first
+    # ~tens of steps (fresh-process step times start 4-10x higher than
+    # steady state), so warm until the measured window sees steady state.
+    warmup = int(os.environ.get('PADDLE_TPU_BENCH_WARMUP',
+                                15 if on_tpu else 1))
+    loss = step(ids, labels)
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    _ = loss.numpy()
 
     profile_dir = os.environ.get('PADDLE_TPU_BENCH_PROFILE')
     if profile_dir:
@@ -222,6 +262,11 @@ def _orchestrate(errors):
                     'PADDLE_TPU_BENCH_REMAT': '1'}, 'batch16_remat'),
                   ({'PADDLE_TPU_FLASH_DISABLE': '1',
                     'PADDLE_TPU_FLASH_STRICT': '0'}, 'flash_disabled'))
+        if platform == 'tpu':
+            pallas_ok, perr = _probe_pallas()
+            if not pallas_ok:
+                errors.append(perr)
+                ladder = ladder[-1:]  # flash rungs are doomed; skip them
         for attempt, (extra, label) in enumerate(ladder):
             result, err = _spawn_child(extra_env=extra)
             if result is not None:

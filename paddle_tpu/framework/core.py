@@ -96,18 +96,41 @@ class GradNode:
     Mirrors the reference's GradOpNode (imperative/op_base.h) but the
     "grad kernel" is jax.vjp's closure instead of a registered grad op.
     """
-    __slots__ = ('name', 'vjp_fn', 'inputs', 'out_avals', 'out_refs', '__weakref__')
+    __slots__ = ('name', 'vjp_fn', 'inputs', 'out_avals', 'out_refs',
+                 '_lazy', '__weakref__')
 
-    def __init__(self, name, vjp_fn, inputs, out_avals):
+    def __init__(self, name, vjp_fn, inputs, out_avals, lazy=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs            # list[Tensor] (positional primals)
         self.out_avals = out_avals      # list[(shape, jnp dtype)]
         self.out_refs = []              # weakrefs to output tensors
+        # (fn, arrays) when the vjp closure is materialized on demand: under
+        # an outer functional trace (jit / value_and_grad) an eager jax.vjp
+        # here would flatten any custom_vjp in `fn` into the outer trace —
+        # pallas kernels then get JVP'd and die. Tracer inputs therefore
+        # defer the vjp to backward time (which eager tape users pay only
+        # if they actually call .backward() on a traced graph).
+        self._lazy = lazy
+
+    def materialize_vjp(self):
+        if self.vjp_fn is None and self._lazy is not None:
+            fn, arrays = self._lazy
+            try:
+                _, self.vjp_fn = jax.vjp(fn, *arrays)
+                self._lazy = None  # don't retain primals twice
+            except jax.errors.UnexpectedTracerError as e:
+                raise RuntimeError(
+                    'backward() through op %r whose inputs are stale '
+                    'tracers: the Tensor was produced inside a jit/'
+                    'TrainStep trace that has since ended. Differentiate '
+                    'inside the traced function instead.' % self.name) from e
+        return self.vjp_fn
 
     def release(self):
         self.vjp_fn = None
         self.inputs = ()
+        self._lazy = None
 
 
 def _topo_order(root_node):
@@ -185,7 +208,7 @@ def backward_engine(tensors, grad_tensors=None, retain_graph=False):
         cots = pending.pop(id(node), None)
         if cots is None:
             continue
-        if node.vjp_fn is None:
+        if node.materialize_vjp() is None:
             raise RuntimeError(
                 "trying to backward through the graph a second time (op %r): "
                 "the saved intermediate results were freed. Pass "
@@ -512,10 +535,21 @@ def run_op(name, fn, *inputs, n_outputs=None):
             _fwd_recorder[0](fn, tensors, wrapped)
         return tuple(wrapped) if multi else wrapped[0]
 
-    out, vjp_fn = jax.vjp(fn, *arrays)
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        # inside an outer trace (functional TrainStep / jit): call fn
+        # directly so any custom_vjp inside binds against the OUTER AD
+        # trace (an eager jax.vjp here would flatten it — the pallas flash
+        # kernel then gets JVP'd by the outer trace and fails). The tape
+        # vjp is materialized lazily iff .backward() is actually called.
+        out = fn(*arrays)
+        vjp_fn, lazy = None, (fn, tuple(arrays))
+    else:
+        out, vjp_fn = jax.vjp(fn, *arrays)
+        lazy = None
     multi = isinstance(out, tuple)
     outs = out if multi else (out,)
-    node = GradNode(name, vjp_fn, tensors, [(o.shape, o.dtype) for o in outs])
+    node = GradNode(name, vjp_fn, tensors,
+                    [(o.shape, o.dtype) for o in outs], lazy=lazy)
     wrapped = []
     for i, o in enumerate(outs):
         t = wrap_out(o, requires_grad=True)

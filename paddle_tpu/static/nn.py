@@ -18,6 +18,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax import tree_util as jtu
 
 from ..framework.core import Tensor, run_op, no_grad_guard
 
@@ -86,22 +87,34 @@ def _replay_rec(rec, result, env):
         for t, a in zip(outs, res):
             env[id(t)] = a
 
-    def resolve(tree):
-        if isinstance(tree, (list, tuple)):
-            return type(tree)(resolve(v) for v in tree)
-        if isinstance(tree, Tensor):
-            return env.get(id(tree), tree._data)
-        return tree
-    return resolve(result)
+    # Tensors are unregistered pytree leaves, so tree_map substitutes
+    # them in-place across any output structure (list/tuple/dict/...)
+    return jtu.tree_map(
+        lambda t: env.get(id(t), t._data) if isinstance(t, Tensor) else t,
+        result)
+
+
+def _flat_unwrapped(tree):
+    """Flatten a branch-output tree (Tensors are leaves) to arrays."""
+    return tuple(_unwrap(v) for v in jtu.tree_flatten(tree)[0])
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None):
     """lax.cond (reference control_flow.py cond / conditional_block op).
     Both branches are built once eagerly (the reference builds both
     sub-blocks too) and replayed inside lax.cond; every leaf Tensor a
-    branch reads becomes a tape operand, so grads flow."""
+    branch reads becomes a tape operand, so grads flow. Branch outputs
+    may be a Tensor or any pytree of them; run_op sees a flat tuple and
+    the caller gets the original structure back."""
     t_out, t_rec = _record_branch(true_fn)
     f_out, f_rec = _record_branch(false_fn)
+    t_leaves, t_def = jtu.tree_flatten(t_out)
+    _f_leaves, f_def = jtu.tree_flatten(f_out)
+    if t_def != f_def:
+        raise TypeError('cond branches must return the same structure: '
+                        '%s vs %s' % (t_def, f_def))
+    if not t_leaves:
+        return t_out  # e.g. both branches return None (side-effect build)
     leaves, seen = [], set()
     for t in _branch_leaves(t_rec) + _branch_leaves(f_rec):
         if id(t) not in seen:
@@ -112,15 +125,18 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
         env0 = {id(t): a for t, a in zip(leaves, arrays)}
 
         def tf(_):
-            return _unwrap_tree(_replay_rec(t_rec, t_out, dict(env0)))
+            return _flat_unwrapped(_replay_rec(t_rec, t_out, dict(env0)))
 
         def ff(_):
-            return _unwrap_tree(_replay_rec(f_rec, f_out, dict(env0)))
+            return _flat_unwrapped(_replay_rec(f_rec, f_out, dict(env0)))
 
-        return lax.cond(jnp.reshape(p, ()).astype(bool), tf, ff, None)
+        out = lax.cond(jnp.reshape(p, ()).astype(bool), tf, ff, None)
+        return out if len(out) > 1 else out[0]
 
     pred_t = pred if isinstance(pred, Tensor) else Tensor(pred)
-    return _wrap_tree(run_op('cond', fn, pred_t, *leaves))
+    out = run_op('cond', fn, pred_t, *leaves)
+    outs = out if isinstance(out, tuple) else (out,)
+    return jtu.tree_unflatten(t_def, _wrap_tree(list(outs)))
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -140,13 +156,20 @@ def case(pred_fn_pairs, default=None, name=None):
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
     """lax.switch (reference control_flow.switch_case). branch_fns:
-    {index: fn} or [(index, fn)] or [fn, ...]."""
+    {index: fn} or [(index, fn)] or [fn, ...]. Branches are recorded
+    eagerly and replayed inside lax.switch through the tape (same
+    machinery as cond), so grads flow to Tensors the branches read."""
     if isinstance(branch_fns, dict):
         items = sorted(branch_fns.items())
     elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
         items = sorted((int(i), f) for i, f in branch_fns)
     else:
         items = list(enumerate(branch_fns))
+    if not items:
+        raise ValueError('switch_case needs at least one branch')
+    if items[0][0] < 0:
+        raise ValueError('switch_case branch indices must be non-negative, '
+                         'got %r' % (items[0][0],))
     max_idx = items[-1][0]
     table = {}
     for i, f in items:
@@ -155,14 +178,45 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     branches = [table.get(i, fallback) for i in range(max_idx + 1)] + \
         [fallback]
 
-    idx = jnp.clip(jnp.reshape(_unwrap(branch_index), ()).astype(jnp.int32),
-                   0, max_idx + 1)
-    in_table = jnp.isin(jnp.reshape(_unwrap(branch_index), ()),
-                        jnp.asarray(sorted(table)))
-    idx = jnp.where(in_table, idx, max_idx + 1)
-    out = lax.switch(idx, [lambda _, f=f: _unwrap_tree(f())
-                           for f in branches], None)
-    return _wrap_tree(out)
+    # record each distinct builder once; gaps/out-of-range share a record
+    rec_by_id = {}
+    recorded = []
+    for f in branches:
+        if id(f) not in rec_by_id:
+            rec_by_id[id(f)] = _record_branch(f)
+        recorded.append(rec_by_id[id(f)])
+    first_out = recorded[0][0]
+    first_leaves, first_def = jtu.tree_flatten(first_out)
+    for out_i, _rec in recorded[1:]:
+        if jtu.tree_flatten(out_i)[1] != first_def:
+            raise TypeError('switch_case branches must return the same '
+                            'structure')
+    if not first_leaves:
+        return first_out
+    leaves, seen = [], set()
+    for _out, rec in recorded:
+        for t in _branch_leaves(rec):
+            if id(t) not in seen:
+                seen.add(id(t))
+                leaves.append(t)
+
+    def fn(bidx, *arrays):
+        env0 = {id(t): a for t, a in zip(leaves, arrays)}
+        fns = [lambda _, o=o, r=r: _flat_unwrapped(
+                   _replay_rec(r, o, dict(env0)))
+               for o, r in recorded]
+        flat_idx = jnp.reshape(bidx, ()).astype(jnp.int32)
+        idx = jnp.clip(flat_idx, 0, max_idx + 1)
+        in_table = jnp.isin(flat_idx, jnp.asarray(sorted(table)))
+        idx = jnp.where(in_table, idx, max_idx + 1)
+        out = lax.switch(idx, fns, None)
+        return out if len(out) > 1 else out[0]
+
+    bidx_t = branch_index if isinstance(branch_index, Tensor) \
+        else Tensor(branch_index)
+    out = run_op('switch_case', fn, bidx_t, *leaves)
+    outs = out if isinstance(out, tuple) else (out,)
+    return jtu.tree_unflatten(first_def, _wrap_tree(list(outs)))
 
 
 def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
@@ -217,8 +271,6 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5, **kw):
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, **kw):
     from ..nn import functional as F
     shape = input.shape[begin_norm_axis:]
-    import numpy as _np
-    n = int(_np.prod(shape))
     w = Tensor(jnp.ones(shape, jnp.float32)) if scale else None
     b = Tensor(jnp.zeros(shape, jnp.float32)) if shift else None
     return F.layer_norm(input, shape, weight=w, bias=b)
@@ -267,7 +319,6 @@ def conv3d(input, num_filters, filter_size, **kw):
 
 
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, **kw):
-    from ..nn.utils_weight_norm import _l2norm  # reuse if present
     raise NotImplementedError(
         'spectral_norm: use nn.utils.spectral_norm on the Layer instead')
 
@@ -300,7 +351,6 @@ def nce(input, label, num_total_classes, **kw):
 
 
 def sparse_embedding(input, size, **kw):
-    from ..distributed.ps.heter import HeterEmbedding
     raise NotImplementedError(
         'sparse_embedding (PS-backed): construct distributed.ps.'
         'HeterEmbedding(client, table_id, dim) with an embedding service '

@@ -172,3 +172,48 @@ def test_static_nn_control_flow():
     import pytest as _pytest
     with _pytest.raises(NotImplementedError, match='sequence'):
         static.nn.sequence_pool(None, 'sum')
+
+
+def test_static_nn_cond_list_outputs_and_switch_grads():
+    """cond branches may return nested lists (reference cond contract);
+    switch_case differentiates through the tape like cond; empty
+    branch_fns raise a clear ValueError."""
+    import pytest as _pytest
+
+    x = paddle.to_tensor(np.asarray([2.0], np.float32),
+                         stop_gradient=False)
+    a, b = static.nn.cond(paddle.to_tensor(True),
+                          lambda: [x * 3, x * 7],
+                          lambda: [x * 5, x * 9])
+    np.testing.assert_allclose(a.numpy(), [6.0])
+    np.testing.assert_allclose(b.numpy(), [14.0])
+    (a + b).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+
+    x.clear_grad()
+    r = static.nn.switch_case(
+        paddle.to_tensor(1),
+        {0: lambda: x * 10, 1: lambda: x * 20})
+    r.backward()
+    np.testing.assert_allclose(r.numpy(), [40.0])
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+    with _pytest.raises(ValueError, match='at least one'):
+        static.nn.switch_case(paddle.to_tensor(0), [])
+
+
+def test_static_nn_cond_structure_checks():
+    """Branch-structure mismatches raise; negative switch keys raise;
+    leafless branches (side-effect-only, None return) pass through."""
+    import pytest as _pytest
+
+    x = paddle.to_tensor(np.asarray([2.0], np.float32))
+    with _pytest.raises(TypeError, match='same structure'):
+        static.nn.cond(paddle.to_tensor(True),
+                       lambda: [x * 3, x * 7],
+                       lambda: [x * 5, [x * 9]])
+    with _pytest.raises(ValueError, match='non-negative'):
+        static.nn.switch_case(paddle.to_tensor(0),
+                              {-1: lambda: x, 0: lambda: x * 2})
+    assert static.nn.cond(paddle.to_tensor(True),
+                          lambda: None, lambda: None) is None

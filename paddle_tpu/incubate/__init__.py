@@ -3,6 +3,52 @@ from ..distributed.fleet.utils import recompute  # noqa: F401
 from . import asp  # noqa: F401
 
 
+def _segment(op_name, data, segment_ids):
+    """Shared body of segment_{sum,mean,max,min} (reference segment_pool
+    op, paddle/fluid/operators/segment_pool_op.cc). segment_ids must be
+    sorted non-negative ints; the segment count is read off the ids, so
+    these run eagerly (inside jit, pass concrete ids or pad)."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import run_op
+    from ..tensor._helpers import ensure_tensor
+
+    d = ensure_tensor(data)
+    ids = ensure_tensor(segment_ids)
+    num = int(jax.device_get(ids._data.max())) + 1 if ids.shape[0] else 0
+
+    def fn(a, i):
+        if op_name == 'sum':
+            return jax.ops.segment_sum(a, i, num_segments=num)
+        if op_name == 'mean':
+            s = jax.ops.segment_sum(a, i, num_segments=num)
+            cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), a.dtype), i,
+                                      num_segments=num)
+            cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (a.ndim - 1))
+            return s / cnt
+        if op_name == 'max':
+            return jax.ops.segment_max(a, i, num_segments=num)
+        return jax.ops.segment_min(a, i, num_segments=num)
+
+    return run_op('segment_' + op_name, fn, d, ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment('sum', data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment('mean', data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment('max', data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment('min', data, segment_ids)
+
+
 class nn:
     """incubate.nn namespace: fused layers map to the XLA-fused defaults —
     the framework's layers are already the fused implementations on TPU."""

@@ -10,7 +10,8 @@ from . import activation, common, conv, loss, norm, pooling  # noqa: F401
 
 # attention lives in its own module (pallas-backed flash attention)
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
-from .extension import (sequence_mask, diag_embed, affine_grid,  # noqa: F401
+from .extension import (gather_tree, temporal_shift,  # noqa: F401
+                        sequence_mask, diag_embed, affine_grid,
                         grid_sample, hsigmoid_loss)
 
 # reference-parity inplace aliases: functional purity makes true inplace

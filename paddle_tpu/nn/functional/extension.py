@@ -9,7 +9,64 @@ from ...framework.core import run_op
 from ...tensor._helpers import ensure_tensor
 
 __all__ = ['sequence_mask', 'diag_embed', 'affine_grid', 'grid_sample',
-           'hsigmoid_loss']
+           'hsigmoid_loss', 'gather_tree', 'temporal_shift']
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree op,
+    paddle/fluid/operators/gather_tree_op.cc): walk parent pointers from
+    the last step back, re-linking each beam's token ids into full paths.
+    ids/parents: [max_time, batch, beam_width] int."""
+    ids_t = ensure_tensor(ids)
+    par_t = ensure_tensor(parents)
+
+    def fn(idv, parv):
+        max_time, batch, beam = idv.shape
+        bidx = jnp.arange(batch)[:, None]
+
+        def step(carry, xs):
+            beam_sel = carry                 # [batch, beam] parent slot
+            idv_t, parv_t = xs               # this timestep, walking backward
+            tok = idv_t[bidx, beam_sel]      # [batch, beam]
+            nxt = parv_t[bidx, beam_sel]
+            return nxt, tok
+
+        init = jnp.broadcast_to(jnp.arange(beam, dtype=parv.dtype),
+                                (batch, beam))
+        # time-reversed scan: seed with each final beam slot, follow parents
+        _, toks = jax.lax.scan(step, init, (idv[::-1], parv[::-1]))
+        return toks[::-1]
+
+    return run_op('gather_tree', fn, ids_t, par_t)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format='NCHW',
+                   name=None):
+    """TSM temporal shift (reference temporal_shift_op.cc): fold the batch
+    into (N//seg_num, seg_num) segments and shift the first `shift_ratio`
+    of channels one step back in time, the second forward, rest unchanged."""
+    if data_format != 'NCHW':
+        raise ValueError('temporal_shift supports NCHW only')
+    xt = ensure_tensor(x)
+    nt, c, h, w = xt.shape
+    if nt % seg_num:
+        raise ValueError('batch %d not divisible by seg_num %d'
+                         % (nt, seg_num))
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+
+    def fn(a):
+        v = a.reshape(nt // seg_num, seg_num, c, h, w)
+        # reference temporal_shift_op.h: first c1 channels read x[t-1]
+        # (shift forward in time), next c1..c2 read x[t+1] (shift back)
+        from_past = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, :c1]), v[:, :-1, :c1]], axis=1)
+        from_future = jnp.concatenate(
+            [v[:, 1:, c1:c2], jnp.zeros_like(v[:, :1, c1:c2])], axis=1)
+        out = jnp.concatenate([from_past, from_future, v[:, :, c2:]], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return run_op('temporal_shift', fn, xt)
 
 
 def sequence_mask(x, maxlen=None, dtype='int64', name=None):

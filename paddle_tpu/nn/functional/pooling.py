@@ -12,9 +12,9 @@ from ...framework.core import run_op, wrap_out
 from ...tensor._helpers import ensure_tensor
 
 __all__ = ['avg_pool1d', 'avg_pool2d', 'avg_pool3d', 'max_pool1d', 'max_pool2d',
-           'max_pool3d', 'adaptive_avg_pool1d', 'adaptive_avg_pool2d',
-           'adaptive_avg_pool3d', 'adaptive_max_pool1d', 'adaptive_max_pool2d',
-           'adaptive_max_pool3d']
+           'max_pool3d', 'max_unpool2d', 'adaptive_avg_pool1d',
+           'adaptive_avg_pool2d', 'adaptive_avg_pool3d', 'adaptive_max_pool1d',
+           'adaptive_max_pool2d', 'adaptive_max_pool3d']
 
 
 def _norm(v, n):
@@ -75,6 +75,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool('max_pool1d', 1, x, kernel_size, stride, padding, 'max',
                 ceil_mode, data_format=fmt)
     if return_mask:
+        _check_mask_supported(fmt, 'NCW', padding)
         return out, _pool_indices(x, out, 1, kernel_size, stride, padding)
     return out
 
@@ -84,6 +85,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool('max_pool2d', 2, x, kernel_size, stride, padding, 'max',
                 ceil_mode, data_format=data_format)
     if return_mask:
+        _check_mask_supported(data_format, 'NCHW', padding)
         return out, _pool_indices(x, out, 2, kernel_size, stride, padding)
     return out
 
@@ -93,13 +95,100 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool('max_pool3d', 3, x, kernel_size, stride, padding, 'max',
                 ceil_mode, data_format=data_format)
     if return_mask:
+        _check_mask_supported(data_format, 'NCDHW', padding)
         return out, _pool_indices(x, out, 3, kernel_size, stride, padding)
     return out
 
 
+def _check_mask_supported(data_format, channel_first, padding):
+    """return_mask needs channel-first layout and numeric padding (the
+    reference raises the same way for non-NCHW; string padding would
+    desync _pool_indices' window origin from the pooled values)."""
+    if data_format != channel_first:
+        raise ValueError('return_mask=True requires data_format=%r, got %r'
+                         % (channel_first, data_format))
+    if isinstance(padding, str):
+        raise ValueError('return_mask=True requires numeric padding, '
+                         'got %r' % padding)
+
+
 def _pool_indices(x, out, nd, kernel, stride, padding):
-    # indices of max within flattened spatial dims (approximation: argmax scan)
-    return wrap_out(jnp.zeros(ensure_tensor(out)._data.shape, jnp.int32))
+    """Flat spatial index of each window's max (paddle return_mask contract:
+    index into the flattened input spatial dims, per (N, C)).
+
+    Enumerates the kernel offsets (small static product), slicing the
+    padded input once per offset — XLA fuses the stack+argmax; no gather.
+    """
+    import itertools
+
+    a = ensure_tensor(x)._data
+    o = ensure_tensor(out)._data
+    k = _norm(kernel, nd)
+    s = _norm(stride if stride is not None else kernel, nd)
+    p = _norm(padding if not isinstance(padding, str) else 0, nd)
+    spatial = a.shape[2:]
+    out_sp = o.shape[2:]
+    neg = jnp.asarray(-jnp.inf, a.dtype) if jnp.issubdtype(a.dtype, jnp.floating) \
+        else jnp.iinfo(a.dtype).min
+    # pad enough that every window slice is in-bounds
+    pad_cfg = [(0, 0), (0, 0)]
+    for d in range(nd):
+        need = (out_sp[d] - 1) * s[d] + k[d]
+        pad_cfg.append((p[d], max(0, need - spatial[d] - p[d])))
+    padded = jnp.pad(a, pad_cfg, constant_values=neg)
+
+    strides_flat = []
+    for d in range(nd):
+        strides_flat.append(int(np.prod(spatial[d + 1:])) if d + 1 <= nd else 1)
+
+    vals, idxs = [], []
+    for off in itertools.product(*[range(kd) for kd in k]):
+        sl = [slice(None), slice(None)]
+        coord_flat = jnp.zeros((1, 1) + tuple(out_sp), jnp.int32)
+        oob = jnp.zeros((1, 1) + tuple(out_sp), bool)
+        for d in range(nd):
+            sl.append(slice(off[d], off[d] + s[d] * out_sp[d], s[d]))
+            coords = jnp.arange(out_sp[d], dtype=jnp.int32) * s[d] - p[d] + off[d]
+            shape = [1] * (2 + nd)
+            shape[2 + d] = out_sp[d]
+            cd = coords.reshape(shape)
+            coord_flat = coord_flat + cd * strides_flat[d]
+            oob = oob | (cd < 0) | (cd >= spatial[d])
+        vals.append(jnp.where(oob, neg, padded[tuple(sl)]))
+        idxs.append(jnp.broadcast_to(coord_flat, vals[-1].shape))
+    stacked = jnp.stack(vals)             # [K, N, C, *out_sp]
+    which = jnp.argmax(stacked, axis=0)   # [N, C, *out_sp]
+    flat = jnp.take_along_axis(jnp.stack(idxs), which[None], axis=0)[0]
+    return wrap_out(flat.astype(jnp.int32))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format='NCHW', output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True) (reference unpool op,
+    paddle/fluid/operators/unpool_op.cc): scatters each pooled value back
+    to the flat spatial index its window's max came from; the rest is 0."""
+    if data_format != 'NCHW':
+        raise ValueError('max_unpool2d supports NCHW only')
+    xt = ensure_tensor(x)
+    it = ensure_tensor(indices)
+    k = _norm(kernel_size, 2)
+    s = _norm(stride if stride is not None else kernel_size, 2)
+    p = _norm(padding, 2)
+    n, c, hin, win = xt.shape
+    if output_size is None:
+        hout = (hin - 1) * s[0] - 2 * p[0] + k[0]
+        wout = (win - 1) * s[1] - 2 * p[1] + k[1]
+    else:
+        hout, wout = [int(v) for v in output_size[-2:]]
+
+    def fn(a, idx):
+        flat = jnp.zeros((n, c, hout * wout), a.dtype)
+        bi = jnp.arange(n).reshape(n, 1, 1)
+        ci = jnp.arange(c).reshape(1, c, 1)
+        flat = flat.at[bi, ci, idx.reshape(n, c, -1)].set(a.reshape(n, c, -1))
+        return flat.reshape(n, c, hout, wout)
+
+    return run_op('max_unpool2d', fn, xt, it)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,

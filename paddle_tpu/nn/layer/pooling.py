@@ -3,9 +3,9 @@ from .. import functional as F
 from .layers import Layer
 
 __all__ = ['AvgPool1D', 'AvgPool2D', 'AvgPool3D', 'MaxPool1D', 'MaxPool2D',
-           'MaxPool3D', 'AdaptiveAvgPool1D', 'AdaptiveAvgPool2D',
-           'AdaptiveAvgPool3D', 'AdaptiveMaxPool1D', 'AdaptiveMaxPool2D',
-           'AdaptiveMaxPool3D']
+           'MaxPool3D', 'MaxUnPool2D', 'AdaptiveAvgPool1D',
+           'AdaptiveAvgPool2D', 'AdaptiveAvgPool3D', 'AdaptiveMaxPool1D',
+           'AdaptiveMaxPool2D', 'AdaptiveMaxPool3D']
 
 
 class _Pool(Layer):
@@ -101,3 +101,16 @@ class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__(F.adaptive_max_pool3d, output_size=output_size,
                          return_mask=return_mask)
+
+
+class MaxUnPool2D(_Pool):
+    """Inverse of MaxPool2D(return_mask=True) (reference unpool op)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format='NCHW', output_size=None, name=None):
+        super().__init__(F.max_unpool2d, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         data_format=data_format, output_size=output_size)
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, **self._kwargs)

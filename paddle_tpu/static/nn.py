@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax import tree_util as jtu
 
-from ..framework.core import Tensor, run_op, no_grad_guard
+from ..framework.core import Tensor, Parameter, run_op, no_grad_guard
 
 __all__ = ['fc', 'cond', 'case', 'switch_case', 'while_loop', 'embedding',
            'batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
@@ -387,7 +387,322 @@ for _n in ('sequence_conv', 'sequence_softmax', 'sequence_pool',
            'sequence_concat', 'sequence_first_step', 'sequence_last_step',
            'sequence_slice', 'sequence_expand', 'sequence_expand_as',
            'sequence_pad', 'sequence_unpad', 'sequence_reshape',
-           'sequence_scatter', 'sequence_enumerate', 'crf_decoding',
-           'row_conv', 'multi_box_head'):
+           'sequence_scatter', 'sequence_enumerate', 'multi_box_head'):
     globals()[_n] = _sequence_unsupported(_n)
     __all__.append(_n)
+
+
+# -- fluid-era losses / CTR ops (batch layout, mask-based) -------------------
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference rank_loss_op.cc): o = left-right,
+    C = log(1 + e^o) - label*o."""
+    def fn(t, lo, ro):
+        o = lo - ro
+        return jnp.logaddexp(0.0, o) - t * o
+    return run_op('rank_loss', fn, label, left, right)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking (reference bpr_loss_op.cc): per row,
+    -mean over j != y of log(sigmoid(x_y - x_j))."""
+    def fn(x, y):
+        n, c = x.shape
+        pos = jnp.take_along_axis(x, y.reshape(n, 1).astype(jnp.int32),
+                                  axis=1)
+        diff = pos - x                       # [n, c]
+        lsig = jax.nn.log_sigmoid(diff)
+        mask = jnp.ones((n, c), x.dtype).at[
+            jnp.arange(n), y.reshape(n).astype(jnp.int32)].set(0.0)
+        return (-(lsig * mask).sum(1) / jnp.maximum(mask.sum(1), 1.0)
+                ).reshape(n, 1)
+    return run_op('bpr_loss', fn, input, label)
+
+
+def center_loss(input, label, num_classes, alpha, centers=None,
+                update_center=True, name=None):
+    """Center loss (reference center_loss_op.cc): 0.5*||x - c_y||^2 per
+    sample; class centers drift toward their members by `alpha` (eager
+    side update, like the reference's in-op center update). Returns
+    (loss [N,1], centers)."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    if centers is None:
+        centers = Tensor(jnp.zeros((num_classes, x.shape[-1]),
+                                   x._data.dtype))
+    y = (label if isinstance(label, Tensor) else Tensor(label))
+
+    def fn(a, c):
+        yy = y._data.reshape(-1).astype(jnp.int32)
+        diff = a - c[yy]
+        return 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    loss = run_op('center_loss', fn, x, centers)
+
+    if update_center:
+        with no_grad_guard():
+            yy = y._data.reshape(-1).astype(jnp.int32)
+            diff = centers._data[yy] - x._data          # [N, D]
+            num = jax.ops.segment_sum(diff, yy, num_segments=num_classes)
+            cnt = jax.ops.segment_sum(jnp.ones_like(yy, x._data.dtype), yy,
+                                      num_segments=num_classes)
+            centers._data = centers._data - alpha * num / (
+                1.0 + cnt).reshape(-1, 1)
+    return loss, centers
+
+
+def cvm(input, cvm_input, use_cvm=True, name=None):
+    """CTR show/click feature op (reference cvm_op.cc). First two columns
+    of each embedding row carry (show, click); use_cvm=True rewrites them
+    to (log(show+1), log(click+1)-log(show+1)), else strips them."""
+    def fn(x, c):
+        if not use_cvm:
+            return x[:, 2:]
+        show = jnp.log(c[:, :1] + 1.0)
+        ctr = jnp.log(c[:, 1:2] + 1.0) - show
+        return jnp.concatenate([show, ctr, x[:, 2:]], axis=1)
+    return run_op('cvm', fn, input, cvm_input)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape (reference pad_constant_like_op.cc)."""
+    ref = x if isinstance(x, Tensor) else Tensor(x)
+
+    def fn(b):
+        pads = [(0, int(sx - sy)) for sx, sy in zip(ref.shape, b.shape)]
+        return jnp.pad(b, pads, constant_values=pad_value)
+    return run_op('pad_constant_like', fn, y)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None,
+                **kw):
+    """Patch extraction to sequence rows (reference im2sequence_op.cc):
+    [N,C,H,W] -> [N*oh*ow, C*fh*fw]."""
+    fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) \
+        else filter_size
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride[:2]
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding[:2]
+
+    def fn(a):
+        n, c, _h, _w = a.shape
+        patches = lax.conv_general_dilated_patches(
+            a, (fh, fw), (sh, sw), [(ph, ph), (pw, pw)])
+        # patches: [N, C*fh*fw, oh, ow] -> [N*oh*ow, C*fh*fw]
+        n_, cf, oh, ow = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(n_ * oh * ow, cf)
+    return run_op('im2sequence', fn, input)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (reference row_conv_op.cc), batch
+    layout [B, L, D]: out[t] = sum_{i<=future} w[i] * x[t+i]."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    b, l, d = x.shape
+    # fresh trainable filter per call, like this module's fc/conv builders
+    w = Parameter(jnp.full((future_context_size + 1, d), 1.0 /
+                           (future_context_size + 1), x._data.dtype))
+
+    def fn(a, ww):
+        out = jnp.zeros_like(a)
+        for i in range(future_context_size + 1):
+            sh = jnp.pad(a, ((0, 0), (0, i), (0, 0)))[:, i:i + l]
+            out = out + sh * ww[i]
+        return out
+    out = run_op('row_conv', fn, x, w)
+    if act:
+        from ..nn import functional as _F
+        out = getattr(_F, act)(out)
+    return out
+
+
+def sample_logits(logits, label, num_samples, num_true=1, seed=0,
+                  remove_accidental_hits=True, use_customized_samples=False,
+                  customized_samples=None, customized_probabilities=None,
+                  name=None):
+    """Sampled-softmax helper (reference sample_logits_op.cc): gather the
+    true-class logits plus `num_samples` log-uniform negatives, correct
+    both by -log(Q) so softmax over the sampled set estimates the full
+    softmax. Returns (sampled_logits [N, T+S], sampled_labels [N, T])."""
+    lg = logits if isinstance(logits, Tensor) else Tensor(logits)
+    lb = label if isinstance(label, Tensor) else Tensor(label)
+    n, k = lg.shape
+    rng = np.random.RandomState(seed or None)
+    if use_customized_samples:
+        samples = jnp.asarray(customized_samples._data
+                              if isinstance(customized_samples, Tensor)
+                              else customized_samples)
+        probs = jnp.asarray(customized_probabilities._data
+                            if isinstance(customized_probabilities, Tensor)
+                            else customized_probabilities)
+    else:
+        # log-uniform (Zipfian) candidate sampler, as the reference uses
+        u = rng.uniform(size=(num_samples,))
+        samples = jnp.asarray(
+            np.clip((np.exp(u * np.log(k + 1.0)) - 1.0).astype(np.int64),
+                    0, k - 1))
+        probs = jnp.asarray(
+            (np.log((samples + 2.0) / (samples + 1.0)) /
+             np.log(k + 1.0)).astype(np.float32))
+
+    def fn(x, y):
+        yy = y.reshape(n, num_true).astype(jnp.int32)
+        true_logit = jnp.take_along_axis(x, yy, axis=1)
+        true_q = (jnp.log((yy + 2.0) / (yy + 1.0)) /
+                  jnp.log(k + 1.0)).astype(x.dtype)
+        samp_logit = x[:, samples.astype(jnp.int32)]
+        if remove_accidental_hits:
+            hit = (samples[None, None, :] == yy[:, :, None]).any(1)
+            samp_logit = samp_logit - hit.astype(x.dtype) * 1e20
+        out = jnp.concatenate([true_logit - jnp.log(true_q),
+                               samp_logit - jnp.log(probs)[None, :].astype(
+                                   x.dtype)], axis=1)
+        return out
+    out = run_op('sample_logits', fn, lg, lb)
+    sampled_label = Tensor(jnp.tile(jnp.arange(num_true, dtype=jnp.int32),
+                                    (n, 1)))
+    return out, sampled_label
+
+
+# -- linear-chain CRF (reference linear_chain_crf_op.cc / crf_decoding) ------
+
+def _crf_scan_nll(emission, transition, label, length):
+    """Negative log-likelihood per sequence. emission [B,L,K]; transition
+    [K+2, K] paddle layout (row 0 start, row 1 stop, rows 2.. K x K);
+    label [B,L] int; length [B] int."""
+    b, l, k = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    t_idx = jnp.arange(l)
+    mask = (t_idx[None, :] < length[:, None]).astype(emission.dtype)  # [B,L]
+
+    # log partition: alpha recursion over time
+    def step(alpha, xs):
+        em_t, m_t = xs                       # [B,K], [B,1]
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + em_t
+        return jnp.where(m_t > 0, nxt, alpha), None
+    alpha0 = start[None] + emission[:, 0]
+    alphaT, _ = lax.scan(
+        step, alpha0,
+        (jnp.swapaxes(emission[:, 1:], 0, 1),
+         jnp.swapaxes(mask[:, 1:, None], 0, 1)))
+    log_z = jax.scipy.special.logsumexp(alphaT + stop[None], axis=1)
+
+    # gold path score
+    lb = label.astype(jnp.int32)
+    em_score = (jnp.take_along_axis(emission, lb[:, :, None],
+                                    axis=2)[..., 0] * mask).sum(1)
+    pair_m = mask[:, 1:]
+    tr_score = (trans[lb[:, :-1], lb[:, 1:]] * pair_m).sum(1)
+    last = jnp.maximum(length - 1, 0)
+    last_lb = jnp.take_along_axis(lb, last[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0]
+    gold = (em_score + tr_score + start[lb[:, 0]] + stop[last_lb])
+    return (log_z - gold).reshape(b, 1)
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None,
+                     transition=None, name=None):
+    """Linear-chain CRF cost (reference linear_chain_crf_op.cc), batch
+    layout: input [B,L,K] emissions, label [B,L], length [B] (defaults
+    to full L). Returns (cost [B,1], transition) — minimize the cost
+    directly, as fluid does with the op's LogLikelihood output."""
+    em = input if isinstance(input, Tensor) else Tensor(input)
+    lb = label if isinstance(label, Tensor) else Tensor(label)
+    b, l, k = em.shape
+    if transition is None:
+        transition = Parameter((np.random.RandomState(0)
+                                .uniform(-0.1, 0.1, (k + 2, k))
+                                ).astype(np.float32))
+    if length is None:
+        length = Tensor(jnp.full((b,), l, jnp.int32))
+    ln = length if isinstance(length, Tensor) else Tensor(length)
+
+    def fn(e, t):
+        return _crf_scan_nll(e, t, lb._data, ln._data)
+    return run_op('linear_chain_crf', fn, em, transition), transition
+
+
+def crf_decoding(input, transition, length=None, label=None, name=None):
+    """Viterbi decode (reference crf_decoding_op.cc): argmax path under
+    the CRF. Returns [B,L] int32 (entries past `length` are 0); with
+    `label` given, returns per-token mismatch mask like the reference."""
+    em = input if isinstance(input, Tensor) else Tensor(input)
+    tr = transition if isinstance(transition, Tensor) else Tensor(transition)
+    b, l, k = em.shape
+    if length is None:
+        length = Tensor(jnp.full((b,), l, jnp.int32))
+    ln = length if isinstance(length, Tensor) else Tensor(length)
+
+    def fn(e, t):
+        start, stop, trans = t[0], t[1], t[2:]
+        lens = ln._data
+        mask = (jnp.arange(l)[None, :] < lens[:, None])
+
+        def step(carry, xs):
+            score = carry                       # [B,K]
+            em_t, m_t = xs
+            cand = score[:, :, None] + trans[None]     # [B,K,K]
+            best = cand.max(1) + em_t
+            back = cand.argmax(1).astype(jnp.int32)    # [B,K]
+            nscore = jnp.where(m_t[:, None], best, score)
+            return nscore, back
+        score0 = start[None] + e[:, 0]
+        scoreT, backs = lax.scan(
+            step, score0,
+            (jnp.swapaxes(e[:, 1:], 0, 1),
+             jnp.swapaxes(mask[:, 1:], 0, 1)))   # backs [L-1,B,K]
+        final = (scoreT + stop[None]).argmax(1).astype(jnp.int32)  # [B]
+
+        def walk(carry, xs):
+            cur = carry                          # [B]
+            back_t, m_t = xs
+            prev = jnp.take_along_axis(back_t, cur[:, None], axis=1)[:, 0]
+            nxt = jnp.where(m_t, prev, cur)
+            return nxt, cur
+        # walk backward: at masked steps the pointer is frozen. The scan
+        # emits the tag at each t from L-1 down to 1; its final carry is
+        # the tag at t=0.
+        tag0, path_rev = lax.scan(walk, final,
+                                  (backs[::-1], jnp.swapaxes(mask[:, 1:],
+                                                             0, 1)[::-1]))
+        path = jnp.concatenate([tag0[None], path_rev[::-1]], axis=0)  # [L,B]
+        path = jnp.swapaxes(path, 0, 1)
+        path = jnp.where(mask, path, 0)
+        return path
+    out = run_op('crf_decoding', fn, em, tr)
+    if label is not None:
+        # reference crf_decoding_op.h: 1 marks a correctly decoded tag
+        lb = label if isinstance(label, Tensor) else Tensor(label)
+        return Tensor((out._data == lb._data.astype(out._data.dtype))
+                      .astype(jnp.int32))
+    return out
+
+
+# -- fluid-era aliases over the modern functional surface --------------------
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format='NCHW'):
+    from ..nn import functional as _F
+    return _F.local_response_norm(input, size=n, alpha=alpha, beta=beta,
+                                  k=k, data_format=data_format)
+
+
+def cos_sim(X, Y, name=None):
+    from ..nn import functional as _F
+    from ..tensor.manipulation import reshape
+    return reshape(_F.cosine_similarity(X, Y, axis=1), [-1, 1])
+
+
+def space_to_depth(x, blocksize, name=None):
+    from ..nn import functional as _F
+    return _F.pixel_unshuffle(x, blocksize)
+
+
+def reverse(x, axis, name=None):
+    from ..tensor.manipulation import flip
+    return flip(x, axis)
+
+
+__all__ += ['rank_loss', 'bpr_loss', 'center_loss', 'cvm',
+            'pad_constant_like', 'im2sequence', 'row_conv', 'sample_logits',
+            'linear_chain_crf', 'crf_decoding', 'lrn', 'cos_sim',
+            'space_to_depth', 'reverse']

@@ -1,0 +1,296 @@
+"""Round-3 op tranche: fluid-era losses/CTR ops, CRF, beam-search
+backtrace, segment pools, max-unpool, temporal shift — each checked
+against an independent numpy reference (reference ops cited per-op in
+the implementations)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import static
+
+
+def test_rank_loss():
+    rng = np.random.RandomState(0)
+    t = rng.randint(0, 2, (8, 1)).astype(np.float32)
+    left = rng.randn(8, 1).astype(np.float32)
+    right = rng.randn(8, 1).astype(np.float32)
+    got = static.nn.rank_loss(paddle.to_tensor(t), paddle.to_tensor(left),
+                              paddle.to_tensor(right)).numpy()
+    o = left - right
+    want = np.log1p(np.exp(-np.abs(o))) + np.maximum(o, 0) - t * o
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_bpr_loss():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype(np.float32)
+    y = rng.randint(0, 6, (4, 1))
+    got = static.nn.bpr_loss(paddle.to_tensor(x),
+                             paddle.to_tensor(y)).numpy()
+    want = np.zeros((4, 1), np.float32)
+    for i in range(4):
+        acc = []
+        for j in range(6):
+            if j == y[i, 0]:
+                continue
+            d = x[i, y[i, 0]] - x[i, j]
+            acc.append(np.log(1.0 / (1.0 + np.exp(-d))))
+        want[i, 0] = -np.mean(acc)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_center_loss_updates_centers():
+    rng = np.random.RandomState(2)
+    x = rng.randn(6, 4).astype(np.float32)
+    y = np.array([0, 1, 0, 2, 1, 0])
+    loss, centers = static.nn.center_loss(
+        paddle.to_tensor(x), paddle.to_tensor(y), num_classes=3, alpha=0.5)
+    want = 0.5 * (x ** 2).sum(1, keepdims=True)  # centers start at zero
+    np.testing.assert_allclose(loss.numpy(), want, rtol=1e-5)
+    c = centers.numpy()
+    # class 0 has 3 members; update = -alpha * sum(0 - x_i) / (1 + 3)
+    np.testing.assert_allclose(
+        c[0], 0.5 * x[y == 0].sum(0) / 4.0, rtol=1e-5)
+    assert np.abs(c).sum() > 0
+
+
+def test_cvm():
+    rng = np.random.RandomState(3)
+    x = rng.rand(5, 6).astype(np.float32)
+    show_click = np.abs(rng.rand(5, 2).astype(np.float32)) * 10
+    got = static.nn.cvm(paddle.to_tensor(x),
+                        paddle.to_tensor(show_click), use_cvm=True).numpy()
+    np.testing.assert_allclose(got[:, 0], np.log(show_click[:, 0] + 1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        got[:, 1], np.log(show_click[:, 1] + 1) - np.log(show_click[:, 0] + 1),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got[:, 2:], x[:, 2:])
+    stripped = static.nn.cvm(paddle.to_tensor(x),
+                             paddle.to_tensor(show_click),
+                             use_cvm=False).numpy()
+    np.testing.assert_allclose(stripped, x[:, 2:])
+
+
+def test_pad_constant_like_and_im2sequence():
+    x = paddle.to_tensor(np.zeros((3, 5), np.float32))
+    y = paddle.to_tensor(np.ones((2, 3), np.float32))
+    got = static.nn.pad_constant_like(x, y, pad_value=7.0).numpy()
+    assert got.shape == (3, 5)
+    assert got[2, 4] == 7.0 and got[1, 2] == 1.0
+
+    img = paddle.to_tensor(
+        np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    seq = static.nn.im2sequence(img, filter_size=2, stride=2).numpy()
+    assert seq.shape == (4, 4)
+    np.testing.assert_allclose(seq[0], [0, 1, 4, 5])
+    np.testing.assert_allclose(seq[3], [10, 11, 14, 15])
+
+
+def test_row_conv_shapes_and_lookahead():
+    x = paddle.to_tensor(np.eye(4, dtype=np.float32).reshape(1, 4, 4))
+    out = static.nn.row_conv(x, future_context_size=1)
+    got = out.numpy()[0]
+    # uniform weights 1/2: out[t] = (x[t] + x[t+1]) / 2
+    want = 0.5 * (np.eye(4) + np.vstack([np.eye(4)[1:], np.zeros(4)]))
+    np.testing.assert_allclose(got, want.astype(np.float32), rtol=1e-5)
+
+
+def test_sample_logits():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 50).astype(np.float32)
+    y = rng.randint(0, 50, (3, 1))
+    out, lbl = static.nn.sample_logits(paddle.to_tensor(x),
+                                       paddle.to_tensor(y), num_samples=10)
+    assert tuple(out.shape) == (3, 11)
+    assert lbl.numpy().tolist() == [[0], [0], [0]]
+    k = 50.0
+    q = np.log((y + 2.0) / (y + 1.0)) / np.log(k + 1.0)
+    want_true = np.take_along_axis(x, y, axis=1) - np.log(q)
+    np.testing.assert_allclose(out.numpy()[:, :1], want_true, rtol=1e-4)
+
+
+def _np_crf_nll(em, trans, lab, lens):
+    b, l, k = em.shape
+    start, stop, tr = trans[0], trans[1], trans[2:]
+    out = np.zeros((b, 1), np.float64)
+    for i in range(b):
+        n = lens[i]
+        # brute-force logZ over all paths
+        paths = [[t] for t in range(k)]
+        for _ in range(n - 1):
+            paths = [p + [t] for p in paths for t in range(k)]
+        scores = []
+        for p in paths:
+            s = start[p[0]] + stop[p[-1]] + sum(em[i, t, p[t]]
+                                                for t in range(n))
+            s += sum(tr[p[t], p[t + 1]] for t in range(n - 1))
+            scores.append(s)
+        logz = np.log(np.sum(np.exp(np.asarray(scores) -
+                                    max(scores)))) + max(scores)
+        g = lab[i, :n]
+        gold = start[g[0]] + stop[g[-1]] + sum(em[i, t, g[t]]
+                                               for t in range(n))
+        gold += sum(tr[g[t], g[t + 1]] for t in range(n - 1))
+        out[i, 0] = logz - gold
+    return out
+
+
+def test_linear_chain_crf_and_decoding():
+    rng = np.random.RandomState(5)
+    b, l, k = 3, 4, 3
+    em = rng.randn(b, l, k).astype(np.float32)
+    trans = rng.randn(k + 2, k).astype(np.float32) * 0.3
+    lab = rng.randint(0, k, (b, l))
+    lens = np.array([4, 3, 2], np.int32)
+
+    cost, _t = static.nn.linear_chain_crf(
+        paddle.to_tensor(em), paddle.to_tensor(lab),
+        transition=paddle.to_tensor(trans),
+        length=paddle.to_tensor(lens))
+    want = _np_crf_nll(em.astype(np.float64), trans.astype(np.float64),
+                       lab, lens)
+    np.testing.assert_allclose(cost.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    path = static.nn.crf_decoding(paddle.to_tensor(em),
+                                  paddle.to_tensor(trans),
+                                  length=paddle.to_tensor(lens)).numpy()
+    # brute-force viterbi per sequence
+    start, stop, tr = trans[0], trans[1], trans[2:]
+    for i in range(b):
+        n = lens[i]
+        best, best_s = None, -np.inf
+        paths = [[t] for t in range(k)]
+        for _ in range(n - 1):
+            paths = [p + [t] for p in paths for t in range(k)]
+        for p in paths:
+            s = start[p[0]] + stop[p[-1]] + sum(em[i, t, p[t]]
+                                                for t in range(n))
+            s += sum(tr[p[t], p[t + 1]] for t in range(n - 1))
+            if s > best_s:
+                best_s, best = s, p
+        assert path[i, :n].tolist() == best
+        assert (path[i, n:] == 0).all()
+
+
+def test_gather_tree():
+    # beam=2 toy: reference semantics from gather_tree_op.cc unit test
+    ids = np.array([[[2, 2]], [[6, 1]], [[3, 9]]], np.int64)  # [T=3,B=1,W=2]
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    got = F.gather_tree(paddle.to_tensor(ids),
+                        paddle.to_tensor(parents)).numpy()
+    # walk: final step tokens [3, 9]; parents [0,1] -> step1 tokens
+    # slot0<-parent0: 6 ... slot1<-parent1: 1; then their parents [1, 0]
+    want = np.array([[[2, 2]], [[6, 1]], [[3, 9]]], np.int64)
+    assert got.shape == (3, 1, 2)
+    np.testing.assert_array_equal(got[2], want[2])
+    np.testing.assert_array_equal(got[1], [[6, 1]])
+    np.testing.assert_array_equal(got[0], [[2, 2]])
+
+
+def test_gather_tree_relinks_crossed_beams():
+    # crossed parents force re-linking: slot 0's history comes from slot 1
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 1]], [[1, 0]]], np.int64)
+    got = F.gather_tree(paddle.to_tensor(ids),
+                        paddle.to_tensor(parents)).numpy()
+    # slot0 final token 5, parent 1 -> time1 token 4, its parent 1 -> 2
+    np.testing.assert_array_equal(got[:, 0, 0], [2, 4, 5])
+    # slot1 final token 6, parent 0 -> time1 token 3, its parent 1 -> 2
+    np.testing.assert_array_equal(got[:, 0, 1], [2, 3, 6])
+
+
+def test_segment_pools():
+    data = np.array([[1., 2.], [3., 4.], [10., 20.]], np.float32)
+    ids = np.array([0, 0, 1])
+    d, i = paddle.to_tensor(data), paddle.to_tensor(ids)
+    np.testing.assert_allclose(paddle.incubate.segment_sum(d, i).numpy(),
+                               [[4., 6.], [10., 20.]])
+    np.testing.assert_allclose(paddle.incubate.segment_mean(d, i).numpy(),
+                               [[2., 3.], [10., 20.]])
+    np.testing.assert_allclose(paddle.incubate.segment_max(d, i).numpy(),
+                               [[3., 4.], [10., 20.]])
+    np.testing.assert_allclose(paddle.incubate.segment_min(d, i).numpy(),
+                               [[1., 2.], [10., 20.]])
+
+
+def test_max_pool_mask_and_unpool_roundtrip():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    xt = paddle.to_tensor(x)
+    out, mask = F.max_pool2d(xt, kernel_size=2, stride=2, return_mask=True)
+    # mask must hold the true argmax flat indices
+    for n in range(2):
+        for c in range(3):
+            for oh in range(3):
+                for ow in range(3):
+                    win = x[n, c, oh * 2:oh * 2 + 2, ow * 2:ow * 2 + 2]
+                    fi = int(mask.numpy()[n, c, oh, ow])
+                    assert x[n, c, fi // 6, fi % 6] == win.max()
+    un = F.max_unpool2d(out, mask, kernel_size=2, stride=2)
+    assert tuple(un.shape) == (2, 3, 6, 6)
+    # unpooled tensor holds each max at its original position, zeros else
+    got = un.numpy()
+    assert np.count_nonzero(got) <= 2 * 3 * 9
+    np.testing.assert_allclose(got.max(axis=(2, 3)),
+                               out.numpy().max(axis=(2, 3)))
+
+    layer = paddle.nn.MaxUnPool2D(kernel_size=2, stride=2)
+    np.testing.assert_allclose(layer(out, mask).numpy(), got)
+
+
+def test_temporal_shift():
+    x = np.arange(2 * 4 * 4 * 1 * 1, dtype=np.float32).reshape(8, 4, 1, 1)
+    got = F.temporal_shift(paddle.to_tensor(x), seg_num=4,
+                           shift_ratio=0.25).numpy()
+    v = x.reshape(2, 4, 4, 1, 1)
+    want = np.zeros_like(v)
+    # reference semantics: channel group 0 reads x[t-1], group 1 reads
+    # x[t+1], rest identity (temporal_shift_op.h)
+    want[:, 1:, 0:1] = v[:, :-1, 0:1]
+    want[:, :-1, 1:2] = v[:, 1:, 1:2]
+    want[:, :, 2:] = v[:, :, 2:]
+    np.testing.assert_allclose(got, want.reshape(8, 4, 1, 1))
+
+
+def test_fluid_aliases():
+    x = paddle.to_tensor(np.random.RandomState(7)
+                         .randn(2, 4, 4, 4).astype(np.float32))
+    assert tuple(static.nn.lrn(x).shape) == (2, 4, 4, 4)
+    y = static.nn.space_to_depth(x, 2)
+    assert tuple(y.shape) == (2, 16, 2, 2)
+    r = static.nn.reverse(paddle.to_tensor(
+        np.arange(4, dtype=np.float32)), [0])
+    np.testing.assert_allclose(r.numpy(), [3, 2, 1, 0])
+    a = paddle.to_tensor(np.ones((2, 3), np.float32))
+    cs = static.nn.cos_sim(a, a)
+    assert tuple(cs.shape) == (2, 1)  # fluid returns [N, 1]
+    assert cs.numpy().max() <= 1.0 + 1e-6
+
+
+def test_crf_grads_flow():
+    rng = np.random.RandomState(8)
+    em = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32),
+                          stop_gradient=False)
+    trans = paddle.to_tensor((rng.randn(6, 4) * 0.1).astype(np.float32),
+                             stop_gradient=False)
+    lab = paddle.to_tensor(rng.randint(0, 4, (2, 3)))
+    cost, _ = static.nn.linear_chain_crf(em, lab, transition=trans)
+    cost.sum().backward()
+    assert em.grad is not None and np.isfinite(em.grad.numpy()).all()
+    assert trans.grad is not None and np.isfinite(trans.grad.numpy()).all()
+
+    # default transition is a trainable Parameter
+    em2 = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32),
+                           stop_gradient=False)
+    cost2, t2 = static.nn.linear_chain_crf(em2, lab)
+    assert not t2.stop_gradient
+    cost2.sum().backward()
+    assert t2.grad is not None
+
+    # crf_decoding(label=...) marks CORRECT tags with 1 (reference
+    # crf_decoding_op.h)
+    path = static.nn.crf_decoding(em2, t2)
+    marks = static.nn.crf_decoding(em2, t2, label=path)
+    assert (marks.numpy() == 1).all()

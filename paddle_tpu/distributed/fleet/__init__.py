@@ -141,7 +141,21 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 def _prepare_train_step():
-    pass
+    """distributed_model's placement step (reference fleet_base.py:836
+    broadcasts/places initial params when the model is wrapped): put every
+    parameter onto the fleet mesh under the strategy's shardings NOW, so
+    the first fleet_train_step compiles against pre-placed arrays and
+    large models never materialize fully replicated. Optimizer slots are
+    NOT touched here — they must be created after placement (zeros_like
+    of the sharded param; see place_opt_slots), which fleet_train_step
+    does."""
+    model = _FLEET['model']
+    hcg = _FLEET['hcg']
+    if model is None or hcg is None:
+        return
+    cfg = strategy_mod.build_shardings(model, strategy_mod._NullOpt(),
+                                       hcg.mesh, _strategy_dict())
+    strategy_mod.place_params(model, cfg['param_shardings'])
 
 
 def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
@@ -344,7 +358,17 @@ def server_endpoints(to_string=False):
 
 
 def barrier_worker():
-    pass
+    """reference fleet_base.py barrier_worker: in PS mode, rendezvous all
+    workers through the service's BarrierTable (the reference the_one_ps
+    reserves a table id for this — configure it via
+    PADDLE_FLEET_BARRIER_TABLE_ID); collective mode under
+    single-controller SPMD has no cross-process eager phase to order, so
+    it is a no-op there by design."""
+    from ..ps import runtime as ps_runtime
+    client = ps_runtime.get_client()
+    tid = os.environ.get('PADDLE_FLEET_BARRIER_TABLE_ID')
+    if client is not None and tid is not None:
+        client.barrier(int(tid), worker_id=worker_index())
 
 
 def init_worker():
@@ -375,4 +399,25 @@ def save_inference_model(*args, **kwargs):
 
 
 def save_persistables(executor, dirname, main_program=None, mode=0):
-    pass
+    """reference fleet save_persistables: PS mode saves the server-side
+    tables through the service; otherwise the registered fleet model's
+    state_dict is written under `dirname` (the persistables of the
+    single-controller job)."""
+    from ..ps import runtime as ps_runtime
+    client = ps_runtime.get_client()
+    if client is not None:
+        # sparse side: every service table listed for this job
+        tids = os.environ.get('PADDLE_FLEET_PS_TABLE_IDS', '0')
+        for tid in tids.split(','):
+            client.save(int(tid), os.path.join(dirname,
+                                               'table_%s' % tid.strip()))
+        return
+    model = _FLEET['model']
+    if model is None:
+        raise RuntimeError('save_persistables: no fleet model registered '
+                           '(call fleet.distributed_model first) and no '
+                           'PS service is running')
+    from ... import save as paddle_save
+    os.makedirs(dirname, exist_ok=True)
+    paddle_save(model.state_dict(),
+                os.path.join(dirname, 'persistables.pdparams'))

@@ -14,10 +14,29 @@ class DataParallel:
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
                  group=None):
+        from ..nn.layer.layers import Layer
+        if not isinstance(layers, Layer):
+            raise TypeError('DataParallel expects a paddle Layer, got %s'
+                            % type(layers).__name__)
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
         layers._is_data_parallel = True
         self._dp_marked = True
+        # register with fleet so a later fleet.fleet_train_step /
+        # distributed_optimizer picks this model up (paddle users wrap
+        # with DataParallel OR fleet.distributed_model — same effect here)
+        from . import fleet as fleet_mod
+        if getattr(fleet_mod, '_FLEET', None) is not None and \
+                fleet_mod._FLEET.get('model') is None:
+            fleet_mod._FLEET['model'] = layers
+
+    def no_sync(self):
+        """paddle DataParallel.no_sync parity: under SPMD the gradient
+        all-reduce is part of the compiled step (there is no per-layer
+        eager sync to suppress), so this context only exists so ported
+        training loops run unchanged."""
+        import contextlib
+        return contextlib.nullcontext()
 
     def __call__(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

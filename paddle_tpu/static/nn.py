@@ -479,12 +479,20 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None,
     fh, fw = (filter_size, filter_size) if isinstance(filter_size, int) \
         else filter_size
     sh, sw = (stride, stride) if isinstance(stride, int) else stride[:2]
-    ph, pw = (padding, padding) if isinstance(padding, int) else padding[:2]
+    if isinstance(padding, int):
+        pads = [(padding, padding), (padding, padding)]
+    elif len(padding) == 4:
+        # reference im2sequence_op layout: [up, left, down, right]
+        up, left, down, right = padding
+        pads = [(up, down), (left, right)]
+    else:
+        ph, pw = padding[:2]
+        pads = [(ph, ph), (pw, pw)]
 
     def fn(a):
         n, c, _h, _w = a.shape
         patches = lax.conv_general_dilated_patches(
-            a, (fh, fw), (sh, sw), [(ph, ph), (pw, pw)])
+            a, (fh, fw), (sh, sw), pads)
         # patches: [N, C*fh*fw, oh, ow] -> [N*oh*ow, C*fh*fw]
         n_, cf, oh, ow = patches.shape
         return patches.transpose(0, 2, 3, 1).reshape(n_ * oh * ow, cf)
@@ -624,7 +632,8 @@ def linear_chain_crf(input, label, param_attr=None, length=None,
 def crf_decoding(input, transition, length=None, label=None, name=None):
     """Viterbi decode (reference crf_decoding_op.cc): argmax path under
     the CRF. Returns [B,L] int32 (entries past `length` are 0); with
-    `label` given, returns per-token mismatch mask like the reference."""
+    `label` given, returns 1 where the decoded tag matches the label
+    (reference crf_decoding_op.h marks correct tags with 1)."""
     em = input if isinstance(input, Tensor) else Tensor(input)
     tr = transition if isinstance(transition, Tensor) else Tensor(transition)
     b, l, k = em.shape

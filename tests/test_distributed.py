@@ -291,3 +291,40 @@ def test_sync_batchnorm_global_stats_under_dp():
     # the normalized output is standardized over the GLOBAL batch
     o = np.asarray(out)
     np.testing.assert_allclose(o.mean(axis=(0, 2, 3)), 0.0, atol=1e-4)
+
+
+def test_fleet_wrapper_behaviors(tmp_path):
+    """Former pass-bodies now act: distributed_model pre-places params on
+    the fleet mesh, save_persistables writes the model state, and
+    DataParallel registers with fleet + validates its input."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    fleet._FLEET['model'] = None
+    fleet.init(is_collective=True)
+    net = nn.Linear(4, 3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=net.parameters())
+    fleet.distributed_optimizer(opt)
+    fleet.distributed_model(net)
+    # params now live on the hcg mesh (placed, not host-committed)
+    sh = net.weight._data.sharding
+    assert set(getattr(sh, 'mesh', None).axis_names) >= {'dp'}
+
+    out_dir = str(tmp_path / 'persist')
+    fleet.save_persistables(None, out_dir)
+    import os
+    assert os.path.exists(os.path.join(out_dir, 'persistables.pdparams'))
+    state = paddle.load(os.path.join(out_dir, 'persistables.pdparams'))
+    np.testing.assert_allclose(np.asarray(state['weight']),
+                               net.weight.numpy())
+
+    fleet.barrier_worker()  # no PS service: must be a clean no-op
+
+    fleet._FLEET['model'] = None
+    dp = DataParallel(net)
+    assert fleet._FLEET['model'] is net
+    with dp.no_sync():
+        pass
+    with pytest.raises(TypeError):
+        DataParallel('not a layer')

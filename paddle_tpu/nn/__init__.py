@@ -6,6 +6,7 @@ from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)
 from .utils_weight_norm import weight_norm, remove_weight_norm  # noqa: F401
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
 
 
 class utils:  # namespace shim: paddle.nn.utils.*

@@ -1,0 +1,195 @@
+"""Beam-search decoding (reference: python/paddle/fluid/layers/rnn.py
+BeamSearchDecoder/dynamic_decode, exposed as paddle.nn.* in 2.x).
+
+TPU-native shape discipline: all per-beam state rides a merged
+[batch*beam, ...] leading dim (one big batched matmul per step instead of
+beam small ones); the decode loop runs eagerly with early exit on
+all-finished, and finalize backtracks with F.gather_tree.
+"""
+import numpy as np
+import jax.numpy as jnp
+from jax import tree_util as jtu
+
+from ..framework.core import Tensor, run_op
+from ..tensor._helpers import ensure_tensor
+
+__all__ = ['Decoder', 'BeamSearchDecoder', 'dynamic_decode']
+
+
+def _map_state(tree, fn):
+    """Apply fn over every Tensor leaf of a (possibly nested) state —
+    Tensors are unregistered pytree leaves, so tree_map handles
+    list/tuple/dict-shaped cell states alike."""
+    return jtu.tree_map(fn, tree)
+
+
+class Decoder:
+    """Abstract decoder contract (initialize/step/finalize)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """reference fluid/layers/rnn.py BeamSearchDecoder: beam search over an
+    RNNCell. embedding_fn maps token ids -> cell inputs; output_fn maps
+    cell outputs -> vocab logits (identity if the cell already emits
+    logits)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers over merged [batch*beam, ...] layout ------------------------
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] by repeating each row."""
+        t = ensure_tensor(x)
+
+        def fn(a):
+            return jnp.repeat(a, beam_size, axis=0)
+        return run_op('tile_beam_merge', fn, t)
+
+    def _split(self, a):
+        return a.reshape((-1, self.beam_size) + a.shape[1:])
+
+    def _merge(self, a):
+        return a.reshape((-1,) + a.shape[2:])
+
+    def initialize(self, initial_cell_states):
+        states = _map_state(
+            initial_cell_states,
+            lambda s: self.tile_beam_merge_with_batch(s, self.beam_size))
+        first = jtu.tree_leaves(states)[0]
+        nbw = first.shape[0]
+        batch = nbw // self.beam_size
+        # only beam 0 is live at t=0 (all beams hold the same start token)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32),
+            (batch, 1))                                    # [B, W]
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        token = Tensor(jnp.full((nbw,), self.start_token, jnp.int32))
+        inputs = self.embedding_fn(token) if self.embedding_fn else token
+        beam_state = {'cell': states, 'log_probs': Tensor(log_probs),
+                      'finished': Tensor(finished), 'lengths': Tensor(lengths)}
+        return inputs, beam_state, Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax
+        cell_out, next_cell = self.cell(inputs, states['cell'], **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = ensure_tensor(cell_out)._data          # [B*W, V]
+        vocab = logits.shape[-1]
+        w = self.beam_size
+        logp = ensure_tensor(states['log_probs'])._data  # [B, W]
+        fin = ensure_tensor(states['finished'])._data    # [B, W]
+        lens = ensure_tensor(states['lengths'])._data
+
+        step_logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1)        # [B*W, V]
+        step_logp = self._split(step_logp)              # [B, W, V]
+        # finished beams may only emit end_token, at probability 1, so
+        # their total score is frozen while live beams keep extending
+        onehot_end = jnp.full((vocab,), -1e9, jnp.float32
+                              ).at[self.end_token].set(0.0)
+        step_logp = jnp.where(fin[:, :, None], onehot_end[None, None],
+                              step_logp)
+        total = logp[:, :, None] + step_logp            # [B, W, V]
+        flat = total.reshape(total.shape[0], w * vocab)
+        top_val, top_idx = jax.lax.top_k(flat, w)       # [B, W]
+        parent = top_idx // vocab
+        token = top_idx % vocab
+
+        fin_parent = jnp.take_along_axis(fin, parent, axis=1)
+        new_fin = fin_parent | (token == self.end_token)
+        new_lens = jnp.take_along_axis(lens, parent, axis=1) + \
+            (~fin_parent).astype(jnp.int32)
+
+        # reorder every cell-state row by its beam's parent
+        def regather(s):
+            t = ensure_tensor(s)
+
+            def fn(a):
+                sp = self._split(a)                     # [B, W, ...]
+                idx = parent.reshape(parent.shape + (1,) *
+                                     (sp.ndim - 2)).astype(jnp.int32)
+                return self._merge(jnp.take_along_axis(
+                    sp, jnp.broadcast_to(idx, parent.shape + sp.shape[2:]),
+                    axis=1))
+            return run_op('beam_regather', fn, t)
+        next_cell = _map_state(next_cell, regather)
+
+        beam_state = {'cell': next_cell, 'log_probs': Tensor(top_val),
+                      'finished': Tensor(new_fin), 'lengths': Tensor(new_lens)}
+        tok_t = Tensor(self._merge(token))
+        next_inputs = self.embedding_fn(tok_t) if self.embedding_fn else tok_t
+        outputs = {'token': Tensor(token), 'parent': Tensor(parent),
+                   'scores': Tensor(top_val)}
+        return outputs, beam_state, next_inputs, Tensor(new_fin)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack parent pointers into full sequences via gather_tree;
+        returns predicted ids [T, B, W] time-major."""
+        from . import functional as F
+        ids = outputs['token']          # [T, B, W]
+        parents = outputs['parent']
+        return F.gather_tree(ids, parents), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference fluid/layers/rnn.py dynamic_decode: drive
+    decoder.initialize/step until every beam is finished or max_step_num.
+    Eager loop with early exit (decode is inference; each step is one
+    fused device program)."""
+    if impute_finished:
+        raise NotImplementedError(
+            'impute_finished=True: finished beams are already frozen by '
+            'BeamSearchDecoder.step (end-token-only extension), so their '
+            'outputs need no imputation; file an issue if a custom Decoder '
+            'needs it')
+    inputs, states, finished = decoder.initialize(inits)
+    tokens, parents, scores = [], [], []
+    step = 0
+    while True:
+        if max_step_num is not None and step >= max_step_num:
+            break
+        outputs, states, inputs, finished = decoder.step(step, inputs,
+                                                         states, **kwargs)
+        tokens.append(outputs['token']._data)
+        parents.append(outputs['parent']._data)
+        scores.append(outputs['scores']._data)
+        step += 1
+        if bool(np.asarray(finished._data).all()):
+            break
+
+    stacked = {'token': Tensor(jnp.stack(tokens)),
+               'parent': Tensor(jnp.stack(parents)),
+               'scores': Tensor(jnp.stack(scores))}
+    lengths = states['lengths'] if isinstance(states, dict) and \
+        'lengths' in states else None
+    preds, final_states = decoder.finalize(stacked, states, lengths)
+    if not output_time_major:
+        preds = Tensor(jnp.transpose(preds._data, (1, 0, 2)))
+    if return_length:
+        return preds, final_states, lengths
+    return preds, final_states

@@ -167,11 +167,19 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
             'BeamSearchDecoder.step (end-token-only extension), so their '
             'outputs need no imputation; file an issue if a custom Decoder '
             'needs it')
+    if max_step_num is not None and max_step_num <= 0:
+        raise ValueError('max_step_num must be >= 1, got %r' % max_step_num)
+    # max_step_num=None means "until finished" (the reference's while op) —
+    # bounded by a safety cap so a beam that never emits end_token returns
+    # partial sequences instead of hanging the host loop
+    import os
+    cap = max_step_num if max_step_num is not None else \
+        int(os.environ.get('PADDLE_TPU_MAX_DECODE_STEPS', 10000))
     inputs, states, finished = decoder.initialize(inits)
     tokens, parents, scores = [], [], []
     step = 0
     while True:
-        if max_step_num is not None and step >= max_step_num:
+        if step >= cap:
             break
         outputs, states, inputs, finished = decoder.step(step, inputs,
                                                          states, **kwargs)

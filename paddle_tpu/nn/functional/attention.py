@@ -14,7 +14,7 @@ from ...framework.core import run_op
 from ...tensor._helpers import ensure_tensor
 
 
-def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
+def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, drop_key=None):
     # q,k,v: [B, N, H, D] paddle layout
     qt = jnp.swapaxes(q, 1, 2)  # B,H,N,D
     kt = jnp.swapaxes(k, 1, 2)
@@ -27,6 +27,9 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale):
     if mask is not None:
         s = s + mask
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and drop_key is not None:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0).astype(q.dtype)
     o = jnp.einsum('bhqk,bhkd->bhqd', p, vt)
     return jnp.swapaxes(o, 1, 2)
 
@@ -80,8 +83,23 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                            scale=scale)
         return run_op('flash_attention', fn, q, k, v)
 
+    # attention-prob dropout rides the framework RNG stream (same
+    # convention as F.dropout: key drawn outside the pure fn); the remat
+    # recompute reuses the key, so backward sees the same mask
+    drop_key = None
+    if dropout_p and training:
+        from ...framework import random as rng
+        drop_key = rng.next_key()
+
+    # remat the quadratic body: backward recomputes the [B,H,N,N] scores
+    # and probabilities from q/k/v instead of keeping them resident —
+    # the flash-attention memory shape, in pure XLA (kicks in whenever
+    # the Pallas kernel doesn't; ~1/3 extra attention flops, which are a
+    # small slice of a transformer step)
+    @jax.checkpoint
     def fn(qq, kk, vv):
-        return _sdpa_ref(qq, kk, vv, mask_arr, dropout_p, is_causal, scale)
+        return _sdpa_ref(qq, kk, vv, mask_arr, dropout_p, is_causal, scale,
+                         drop_key)
     return run_op('sdpa', fn, q, k, v)
 
 

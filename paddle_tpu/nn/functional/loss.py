@@ -1,9 +1,50 @@
 """Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, run_op
 from ...tensor._helpers import ensure_tensor
+
+
+# -- memory-lean fused softmax cross-entropy ---------------------------------
+#
+# The naive log_softmax + take_along_axis path saves an f32 [N, V] logp
+# residual for backward — 2GB/step on the bench config (vocab 30k). This
+# custom_vjp saves only the (bf16) logits and recomputes softmax in the
+# backward, cutting the dominant HBM term of LM training; the grad is the
+# classic softmax(logits) - onehot(label).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _ce_with_logits(logits, label, ignore_index):
+    return _ce_value(logits, label, ignore_index)
+
+
+def _ce_value(logits, label, ignore_index):
+    af = logits.astype(jnp.float32)
+    m = jax.lax.stop_gradient(af.max(axis=-1))
+    lse = m + jnp.log(jnp.sum(jnp.exp(af - m[..., None]), axis=-1))
+    picked = jnp.take_along_axis(af, label[..., None], axis=-1)[..., 0]
+    return jnp.where(label != ignore_index, lse - picked, 0.0)
+
+
+def _ce_fwd(logits, label, ignore_index):
+    return _ce_value(logits, label, ignore_index), (logits, label)
+
+
+def _ce_bwd(ignore_index, res, g):
+    logits, label = res
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    onehot = (jnp.arange(logits.shape[-1], dtype=label.dtype)
+              == label[..., None])
+    valid = (label != ignore_index)
+    grad = (p - onehot) * (g * valid)[..., None]
+    return grad.astype(logits.dtype), jnp.zeros(label.shape,
+                                                jax.dtypes.float0)
+
+
+_ce_with_logits.defvjp(_ce_fwd, _ce_bwd)
 
 __all__ = [
     'cross_entropy', 'softmax_with_cross_entropy', 'binary_cross_entropy',
@@ -43,11 +84,18 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     lab = lab.astype(jnp.int32)
 
     def fn(a, *mw):
-        logp = jax.nn.log_softmax(a, axis=axis) if use_softmax else jnp.log(a)
-        picked = jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis=axis)
-        out = -jnp.squeeze(picked, axis=axis)
-        valid = (lab != ignore_index)
-        out = jnp.where(valid, out, 0.0)
+        if use_softmax and axis in (-1, a.ndim - 1):
+            # f32 internal math; output dtype matches the slow path
+            out = _ce_with_logits(a, lab, ignore_index).astype(a.dtype)
+            valid = (lab != ignore_index)
+        else:
+            logp = jax.nn.log_softmax(a, axis=axis) if use_softmax \
+                else jnp.log(a)
+            picked = jnp.take_along_axis(logp, jnp.expand_dims(lab, axis),
+                                         axis=axis)
+            out = -jnp.squeeze(picked, axis=axis)
+            valid = (lab != ignore_index)
+            out = jnp.where(valid, out, 0.0)
         if mw:
             cw = jnp.take(mw[0], jnp.clip(lab, 0, mw[0].shape[0] - 1))
             out = out * cw

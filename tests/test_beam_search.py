@@ -107,3 +107,29 @@ def test_beam_search_lstm_shapes():
     assert out.shape[0] == batch and out.shape[2] == beam
     assert out.shape[1] <= 5
     assert (out >= 0).all() and (out < vocab).all()
+
+
+def test_dynamic_decode_guards_and_attention_dropout():
+    """max_step_num=0 raises; attention dropout actually drops (review
+    regression: dropout_p was silently ignored on the reference path)."""
+    import pytest
+    import paddle_tpu.nn.functional as F
+
+    cell = _TableCell(np.zeros((3, 3), np.float32))
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=2, beam_size=1)
+    with pytest.raises(ValueError, match='max_step_num'):
+        nn.dynamic_decode(dec, inits=Tensor(np.zeros((1, 2), np.float32)),
+                          max_step_num=0)
+
+    paddle.seed(7)
+    q = Tensor(np.random.RandomState(0).randn(2, 8, 2, 4).astype(np.float32))
+    no_drop = F.scaled_dot_product_attention(q, q, q).numpy()
+    paddle.seed(7)
+    dropped = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                             training=True).numpy()
+    assert not np.allclose(no_drop, dropped)
+    # eval mode ignores dropout
+    paddle.seed(7)
+    eval_out = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                              training=False).numpy()
+    np.testing.assert_allclose(eval_out, no_drop)

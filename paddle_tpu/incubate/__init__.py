@@ -26,9 +26,15 @@ def _segment(op_name, data, segment_ids):
                                       num_segments=num)
             cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (a.ndim - 1))
             return s / cnt
+        # empty segments: paddle emits 0, jax emits +/-inf — mask them
+        cnt = jax.ops.segment_sum(jnp.ones((a.shape[0],), jnp.float32), i,
+                                  num_segments=num)
+        empty = (cnt == 0).reshape((-1,) + (1,) * (a.ndim - 1))
         if op_name == 'max':
-            return jax.ops.segment_max(a, i, num_segments=num)
-        return jax.ops.segment_min(a, i, num_segments=num)
+            out = jax.ops.segment_max(a, i, num_segments=num)
+        else:
+            out = jax.ops.segment_min(a, i, num_segments=num)
+        return jnp.where(empty, jnp.zeros_like(out), out)
 
     return run_op('segment_' + op_name, fn, d, ids)
 

@@ -141,8 +141,7 @@ SWEEP = [
 ]
 
 
-@pytest.mark.parametrize('case', SWEEP, ids=[c[0] for c in SWEEP])
-def test_op_sweep(case):
+def _run_sweep_case(case):
     name, fn, ref, specs, attrs, grad = case
     import zlib
     rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
@@ -169,6 +168,11 @@ def test_op_sweep(case):
                        if np.issubdtype(np.asarray(v).dtype, np.floating)]
         if float_names:
             t.check_grad(float_names)
+
+
+@pytest.mark.parametrize('case', SWEEP, ids=[c[0] for c in SWEEP])
+def test_op_sweep(case):
+    _run_sweep_case(case)
 
 
 def test_metric_auc_matches_rank_formula():
@@ -202,3 +206,65 @@ def test_static_accuracy_and_auc():
                      paddle.to_tensor((rng.rand(32, 1) > 0.5)
                                       .astype(np.float32)))
     assert 0.0 <= float(out[0].numpy()) <= 1.0
+
+
+def _seg_ids():
+    return np.array([0, 0, 1, 1, 1, 3], np.int32)
+
+
+SWEEP2 = [
+    ('atan2', paddle.atan2, np.arctan2, [(3, 4), (3, 4)], {}, True),
+    ('trunc', paddle.trunc, np.trunc, [(3, 4)], {}, False),
+    ('expm1', paddle.expm1, np.expm1, [(3, 4)], {}, True),
+    ('lgamma', paddle.lgamma,
+     lambda x: np.vectorize(__import__('math').lgamma)(x),
+     [('pos', (3, 4))], {}, True),
+    ('nanmean', paddle.nanmean, np.nanmean, [(3, 4)], {}, False),
+    ('nansum', paddle.nansum, np.nansum, [(3, 4)], {}, False),
+    ('diff', paddle.diff, lambda x: np.diff(x), [(3, 6)], {}, True),
+    ('heaviside', paddle.heaviside, np.heaviside,
+     [(3, 4), (3, 4)], {}, False),
+    ('dist', paddle.dist,
+     lambda x, y: np.linalg.norm((x - y).ravel()),
+     [(3, 4), (3, 4)], {}, True),
+    ('median', paddle.median, np.median, [(3, 5)], {}, False),
+    ('frac', paddle.frac, lambda x: x - np.trunc(x), [(3, 4)], {}, True),
+    ('deg2rad', paddle.deg2rad, np.deg2rad, [(3, 4)], {}, True),
+    ('rad2deg', paddle.rad2deg, np.rad2deg, [(3, 4)], {}, True),
+    ('rot90', paddle.rot90, lambda x: np.rot90(x), [(3, 4)], {}, True),
+    # round-3 tranche ops through the same harness
+    ('rank_loss',
+     lambda t, l, r: paddle.static.nn.rank_loss(t, l, r),
+     lambda t, l, r: np.log1p(np.exp(-np.abs(l - r)))
+     + np.maximum(l - r, 0) - t * (l - r),
+     [('int', (6, 1), 2), (6, 1), (6, 1)], {}, False),
+    ('cvm_strip',
+     lambda x, c: paddle.static.nn.cvm(x, c, use_cvm=False),
+     lambda x, c: x[:, 2:], [('pos', (4, 6)), ('pos', (4, 2))], {}, True),
+    ('temporal_shift',
+     lambda x: F.temporal_shift(x, seg_num=2, shift_ratio=0.25),
+     None, [(4, 8, 2, 2)], {}, True),
+    ('segment_sum',
+     lambda d: paddle.incubate.segment_sum(
+         d, paddle.to_tensor(_seg_ids())),
+     lambda d: np.stack([d[_seg_ids() == i].sum(0) if (_seg_ids() == i).any()
+                         else np.zeros(d.shape[1:], d.dtype)
+                         for i in range(4)]),
+     [(6, 3)], {}, True),
+    ('segment_max',
+     lambda d: paddle.incubate.segment_max(
+         d, paddle.to_tensor(_seg_ids())),
+     lambda d: np.stack([d[_seg_ids() == i].max(0) if (_seg_ids() == i).any()
+                         else np.zeros(d.shape[1:], d.dtype)
+                         for i in range(4)]),
+     [(6, 3)], {}, True),
+    ('max_unpool2d_grad',
+     lambda x: F.max_unpool2d(*F.max_pool2d(x, 2, 2, return_mask=True),
+                              kernel_size=2, stride=2),
+     None, [(2, 2, 4, 4)], {}, True),
+]
+
+
+@pytest.mark.parametrize('case', SWEEP2, ids=[c[0] for c in SWEEP2])
+def test_op_sweep2(case):
+    _run_sweep_case(case)

@@ -1,0 +1,71 @@
+"""GNN training bridge over the distributed graph engine.
+
+The reference fork's marquee feature is a brpc-sharded graph store feeding
+GNN trainers (reference: paddle/fluid/distributed/table/
+common_graph_table.cc + graph_py_service.cc; consumed there by PGL-style
+samplers). This module is the TPU-native consumption path: sample fixed
+fan-out neighborhoods through GraphPyClient, pad to static shapes (XLA
+wants static), and aggregate with a GraphSAGE layer whose batch is one
+fused device program.
+"""
+import numpy as np
+
+from .. import nn
+from ..framework.core import Tensor
+
+__all__ = ['neighbor_sample', 'gather_features', 'GraphSageLayer',
+           'sample_and_gather']
+
+
+def neighbor_sample(client, etype, ids, fanout):
+    """Fixed fan-out neighbor sample with self-fallback padding.
+
+    Returns int64 [len(ids), fanout]: the engine pads missing neighbors
+    with -1 (isolated node or fanout > degree); those slots are replaced
+    by the node itself so downstream gathers stay in-bounds and the mean
+    aggregator degrades to self-features — static shapes, no masks.
+    """
+    ids = np.asarray(ids, np.int64)
+    neigh = client.sample_neighbors(etype, ids, fanout)
+    self_col = np.broadcast_to(ids[:, None], neigh.shape)
+    return np.where(neigh < 0, self_col, neigh)
+
+
+def gather_features(client, etype, ids, dim):
+    """Features for a (possibly shaped) id array: [*, dim] float32."""
+    ids = np.asarray(ids, np.int64)
+    flat = client.get_node_feat(etype, ids.reshape(-1), dim)
+    return flat.reshape(ids.shape + (dim,))
+
+
+def sample_and_gather(client, etype, batch_ids, fanouts, dim):
+    """Multi-hop subgraph batch: returns (self_feat, [hop1_feat, ...])
+    where hop k has shape [B, fanout_1, ..., fanout_k, dim]. The sampling
+    rides the service (host side); the returned arrays are ready for one
+    jitted forward."""
+    ids = np.asarray(batch_ids, np.int64)
+    feats = [gather_features(client, etype, ids, dim)]
+    frontier = ids
+    for f in fanouts:
+        frontier = neighbor_sample(client, etype, frontier.reshape(-1),
+                                   f).reshape(frontier.shape + (f,))
+        feats.append(gather_features(client, etype, frontier, dim))
+    return feats[0], feats[1:]
+
+
+class GraphSageLayer(nn.Layer):
+    """GraphSAGE mean aggregator (Hamilton et al.; the PGL layer the
+    reference's graph engine feeds): h = act(W [self || mean(neigh)])."""
+
+    def __init__(self, in_dim, out_dim, act='relu'):
+        super().__init__()
+        self.linear = nn.Linear(2 * in_dim, out_dim)
+        self._act = act
+
+    def forward(self, self_feat, neigh_feat):
+        from .. import tensor as T
+        agg = T.mean(neigh_feat, axis=-2)
+        h = self.linear(T.concat([self_feat, agg], axis=-1))
+        if self._act:
+            h = getattr(nn.functional, self._act)(h)
+        return h

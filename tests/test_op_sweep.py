@@ -8,6 +8,7 @@ attrs, grad). Input spec: shape tuple or ('int', shape, hi).
 """
 import numpy as np
 import pytest
+from scipy.special import gammaln
 
 import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
@@ -106,7 +107,7 @@ SWEEP = [
      lambda x: np.linalg.norm(x), [(3, 4)], {}, True),
     ('dist_2', T.dist,
      lambda x, y, p=2: np.linalg.norm((x - y).ravel(), ord=p),
-     [(3, 4), (3, 4)], {}, False),
+     [(3, 4), (3, 4)], {}, True),
     ('det', T.det, np.linalg.det, [(3, 3)], {}, False),
     ('inv', T.inv, np.linalg.inv, [(3, 3)], {}, False),
     ('cross', lambda x, y: T.cross(x, y, axis=-1),
@@ -216,17 +217,12 @@ SWEEP2 = [
     ('atan2', paddle.atan2, np.arctan2, [(3, 4), (3, 4)], {}, True),
     ('trunc', paddle.trunc, np.trunc, [(3, 4)], {}, False),
     ('expm1', paddle.expm1, np.expm1, [(3, 4)], {}, True),
-    ('lgamma', paddle.lgamma,
-     lambda x: np.vectorize(__import__('math').lgamma)(x),
-     [('pos', (3, 4))], {}, True),
+    ('lgamma', paddle.lgamma, gammaln, [('pos', (3, 4))], {}, True),
     ('nanmean', paddle.nanmean, np.nanmean, [(3, 4)], {}, False),
     ('nansum', paddle.nansum, np.nansum, [(3, 4)], {}, False),
     ('diff', paddle.diff, lambda x: np.diff(x), [(3, 6)], {}, True),
     ('heaviside', paddle.heaviside, np.heaviside,
      [(3, 4), (3, 4)], {}, False),
-    ('dist', paddle.dist,
-     lambda x, y: np.linalg.norm((x - y).ravel()),
-     [(3, 4), (3, 4)], {}, True),
     ('median', paddle.median, np.median, [(3, 5)], {}, False),
     ('frac', paddle.frac, lambda x: x - np.trunc(x), [(3, 4)], {}, True),
     ('deg2rad', paddle.deg2rad, np.deg2rad, [(3, 4)], {}, True),

@@ -284,8 +284,16 @@ class EmbeddingServer:
         self.endpoint = '%s:%d' % (host, self.port)
         self._thread = None
 
-    def create_table(self, table_id, dim, table_class=None, **kwargs):
-        cls = table_class or EmbeddingTable
+    def create_table(self, table_id, dim, table_class=None, backend=None,
+                     **kwargs):
+        if backend == 'native':
+            if table_class is not None:
+                raise ValueError('pass either table_class or '
+                                 "backend='native', not both")
+            from ...native.embedding_table import NativeEmbeddingTable
+            cls = NativeEmbeddingTable
+        else:
+            cls = table_class or EmbeddingTable
         self._tables[table_id] = cls(dim, **kwargs)
         return self._tables[table_id]
 

@@ -1,0 +1,99 @@
+"""Secondary benchmark harness for the BASELINE.md tracked configs that
+bench.py's single-line contract does not cover:
+
+  config 2 — ResNet-50 train throughput (images/sec), @to_static -> XLA
+  config 4 — YOLO-family inference latency through AnalysisPredictor
+
+Prints one JSON line per config. Safe anywhere: CPU runs are tagged
+degraded (tiny shapes); TPU runs use the real config. Not invoked by the
+driver — evidence harness for manual runs (python bench_extra.py).
+"""
+import json
+import time
+
+import numpy as np
+
+
+def _platform():
+    import jax
+    return jax.devices()[0].platform
+
+
+def bench_resnet(on_tpu):
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50, resnet18
+    from paddle_tpu.framework import functional as func_mod
+
+    paddle.seed(0)
+    if on_tpu:
+        model, batch, steps, size = resnet50(), 64, 20, 224
+        model.bfloat16()
+    else:
+        model, batch, steps, size = resnet18(), 2, 2, 32
+    opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                    parameters=model.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    step = func_mod.TrainStep(model, lambda lo, la: ce(lo, la), opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype(np.float32))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
+    step(x, y).numpy()                      # compile
+    warm = 10 if on_tpu else 1
+    for _ in range(warm):
+        loss = step(x, y)
+    _ = loss.numpy()
+    t0 = time.time()
+    for _ in range(steps):
+        loss = step(x, y)
+    _ = loss.numpy()
+    dt = time.time() - t0
+    return {'metric': 'resnet_train_images_per_sec',
+            'value': round(batch * steps / dt, 2), 'unit': 'images/sec',
+            'batch': batch, 'image_size': size,
+            'model': type(model).__name__,
+            'degraded': not on_tpu}
+
+
+def bench_yolo_infer(on_tpu):
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models.yolo import ppyolov2
+    paddle.seed(0)
+    size = 320 if on_tpu else 64
+    model = ppyolov2(num_classes=80)
+    model.eval()
+    import jax
+    from paddle_tpu.framework.functional import (extract_params,
+                                                 extract_buffers,
+                                                 functional_call)
+    params = extract_params(model)
+    buffers = extract_buffers(model)
+
+    def fwd(p, b, img):
+        out, _ = functional_call(model, p, b, (paddle.Tensor(img),),
+                                 training=False)
+        return out
+    jfwd = jax.jit(fwd)
+    img = np.random.RandomState(0).rand(1, 3, size, size).astype(np.float32)
+    out = jfwd(params, buffers, img)
+    jax.block_until_ready(out)
+    n = 20 if on_tpu else 2
+    t0 = time.time()
+    for _ in range(n):
+        out = jfwd(params, buffers, img)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0])
+    dt = (time.time() - t0) / n
+    return {'metric': 'yolo_infer_latency_ms', 'value': round(dt * 1e3, 2),
+            'unit': 'ms', 'image_size': size, 'degraded': not on_tpu}
+
+
+def main():
+    on_tpu = _platform() == 'tpu'
+    for fn in (bench_resnet, bench_yolo_infer):
+        try:
+            print(json.dumps(fn(on_tpu)))
+        except Exception as e:  # never die half-way
+            print(json.dumps({'metric': fn.__name__, 'error': repr(e)[:300]}))
+
+
+if __name__ == '__main__':
+    main()

@@ -68,6 +68,12 @@ from .nn.layer.layers import ParamAttr  # noqa: F401
 from .framework.io_save import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary, flops  # noqa: F401
+from .hapi import callbacks  # noqa: F401  (paddle.callbacks namespace)
+from .framework import device  # noqa: F401  (paddle.device module)
+# make `import paddle_tpu.callbacks` / `.device` statement forms work too
+import sys as _sys
+_sys.modules[__name__ + '.callbacks'] = callbacks
+_sys.modules[__name__ + '.device'] = device
 from .batch import batch  # noqa: F401
 from .autograd import grad  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401

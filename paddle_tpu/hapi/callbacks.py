@@ -5,7 +5,8 @@ import time
 import numpy as np
 
 __all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
-           'EarlyStopping', 'VisualDL', 'config_callbacks']
+           'EarlyStopping', 'VisualDL', 'ReduceLROnPlateau',
+           'config_callbacks']
 
 
 class CallbackList:
@@ -155,6 +156,23 @@ class LRScheduler(Callback):
             s.step()
 
 
+def _monitor_op(mode, monitor, min_delta):
+    """Shared monitor-direction resolution (EarlyStopping /
+    ReduceLROnPlateau): returns (op, signed_min_delta)."""
+    if mode == 'max' or (mode == 'auto' and 'acc' in monitor):
+        return np.greater, abs(min_delta)
+    return np.less, -abs(min_delta)
+
+
+def _monitor_value(logs, monitor):
+    v = (logs or {}).get(monitor)
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple, np.ndarray)):
+        v = float(np.asarray(v).reshape(-1)[0])
+    return v
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor='loss', mode='auto', patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
@@ -165,22 +183,15 @@ class EarlyStopping(Callback):
         self.baseline = baseline
         self.save_best_model = save_best_model
         self.stopped_epoch = 0
-        if mode == 'max' or (mode == 'auto' and 'acc' in monitor):
-            self.monitor_op = np.greater
-            self.min_delta *= 1
-        else:
-            self.monitor_op = np.less
-            self.min_delta *= -1
+        self.monitor_op, self.min_delta = _monitor_op(mode, monitor,
+                                                      min_delta)
         self.best = None
         self.wait = 0
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        current = logs.get(self.monitor)
+        current = _monitor_value(logs, self.monitor)
         if current is None:
             return
-        if isinstance(current, (list, tuple, np.ndarray)):
-            current = float(np.asarray(current).reshape(-1)[0])
         if self.best is None or self.monitor_op(current - self.min_delta,
                                                 self.best):
             self.best = current
@@ -234,3 +245,60 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
               'verbose': verbose, 'metrics': metrics or []}
     cbk_list.set_params(params)
     return cbk_list
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR by `factor` after `patience` evals without
+    improvement of `monitor` (reference hapi/callbacks.py:956)."""
+
+    def __init__(self, monitor='loss', factor=0.1, patience=10, verbose=1,
+                 mode='auto', min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError('ReduceLROnPlateau does not support a factor '
+                             '>= 1.0')
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.monitor_op, self.min_delta = _monitor_op(mode, monitor,
+                                                      min_delta)
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def on_eval_end(self, logs=None):
+        current = _monitor_value(logs, self.monitor)
+        if current is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.best is None or self.monitor_op(current - self.min_delta,
+                                                self.best):
+            self.best = current
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, '_optimizer', None)
+                if opt is not None:
+                    try:
+                        old = float(opt.get_lr())
+                        new = max(old * self.factor, self.min_lr)
+                        if old - new > 1e-12:
+                            opt.set_lr(new)
+                            if self.verbose:
+                                print('ReduceLROnPlateau: lr %g -> %g'
+                                      % (old, new))
+                    except RuntimeError:
+                        # LR driven by a scheduler: the reference callback
+                        # warns and leaves the scheduler in charge
+                        if self.verbose:
+                            print('ReduceLROnPlateau skipped: optimizer '
+                                  'lr is scheduler-driven')
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

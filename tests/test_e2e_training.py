@@ -178,3 +178,41 @@ def test_amp_autocast_eager():
     assert z.dtype == 'bfloat16'
     w = paddle.matmul(x, y)
     assert w.dtype == 'float32'
+
+
+def test_reduce_lr_on_plateau_callback():
+    """paddle.callbacks.ReduceLROnPlateau halves the lr after `patience`
+    stagnant evals (reference hapi/callbacks.py:956); also pins the
+    paddle.callbacks / paddle.device namespaces."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    assert callable(paddle.device.set_device)
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor='loss', factor=0.5,
+                                            patience=2, verbose=0)
+
+    class _FakeOpt:
+        def __init__(self):
+            self._lr = 1.0
+
+        def get_lr(self):
+            return self._lr
+
+        def set_lr(self, v):
+            self._lr = v
+
+    class _FakeModel:
+        pass
+
+    m = _FakeModel()
+    m._optimizer = _FakeOpt()
+    cb.set_model(m)
+    cb.on_eval_end({'loss': 1.0})   # best
+    cb.on_eval_end({'loss': 1.0})   # wait 1
+    assert m._optimizer.get_lr() == 1.0
+    cb.on_eval_end({'loss': 1.0})   # wait 2 -> reduce
+    assert np.isclose(m._optimizer.get_lr(), 0.5)
+    cb.on_eval_end({'loss': 0.2})   # improvement resets
+    cb.on_eval_end({'loss': 0.2})
+    cb.on_eval_end({'loss': 0.2})
+    assert np.isclose(m._optimizer.get_lr(), 0.25)

@@ -216,3 +216,28 @@ def test_reduce_lr_on_plateau_callback():
     cb.on_eval_end({'loss': 0.2})
     cb.on_eval_end({'loss': 0.2})
     assert np.isclose(m._optimizer.get_lr(), 0.25)
+
+
+def test_load_reference_format_pdparams(tmp_path):
+    """A checkpoint written the reference way — a plain pickled dict of
+    numpy arrays (python/paddle/framework/io.py paddle.save) with
+    paddle-structured key names — loads via paddle.load +
+    set_state_dict, so users can migrate existing .pdparams files."""
+    import pickle
+    import numpy as np
+    import paddle_tpu as paddle
+
+    src = paddle.vision.models.LeNet()
+    ref_ckpt = {k: np.asarray(v.numpy()) for k, v in
+                src.state_dict().items()}
+    path = str(tmp_path / 'model.pdparams')
+    with open(path, 'wb') as f:
+        pickle.dump(ref_ckpt, f, protocol=2)   # plain pickle, no wrapper
+
+    dst = paddle.vision.models.LeNet()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 1, 28, 28).astype(np.float32))
+    before = dst(x).numpy()
+    dst.set_state_dict(paddle.load(path))
+    np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(), rtol=1e-6)
+    assert not np.allclose(before, src(x).numpy())

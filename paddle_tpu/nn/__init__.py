@@ -5,12 +5,15 @@ from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)
-from .utils_weight_norm import weight_norm, remove_weight_norm  # noqa: F401
+from .utils_weight_norm import (weight_norm, remove_weight_norm,  # noqa: F401
+                               spectral_norm, remove_spectral_norm)
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from . import quant  # noqa: F401
 
 
 class utils:  # namespace shim: paddle.nn.utils.*
-    from .utils_weight_norm import weight_norm, remove_weight_norm
+    from .utils_weight_norm import (weight_norm, remove_weight_norm,
+                                    spectral_norm, remove_spectral_norm)
     from .clip import clip_grad_norm_, clip_grad_value_
 
     @staticmethod

@@ -3,7 +3,8 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor, Parameter, run_op
 
-__all__ = ['weight_norm', 'remove_weight_norm']
+__all__ = ['weight_norm', 'remove_weight_norm',
+           'spectral_norm', 'remove_spectral_norm']
 
 
 def _norm_except(w, dim):
@@ -44,4 +45,82 @@ def remove_weight_norm(layer, name='weight'):
     layer.add_parameter(name, Parameter(w))
     if hasattr(layer, '_wn_hook'):
         layer._wn_hook.remove()
+    return layer
+
+
+def _l2_normalize(v, eps=1e-12):
+    return v / (jnp.sqrt(jnp.sum(jnp.square(v))) + eps)
+
+
+def _sn_power_iterate(wmat, u, iters, eps):
+    """Shared power-iteration body (also the structure of
+    nn.SpectralNorm.forward): returns (u, v) after `iters` rounds."""
+    v = None
+    for _ in range(iters):
+        v = _l2_normalize(wmat.T @ u, eps)
+        u = _l2_normalize(wmat @ v, eps)
+    return u, v
+
+
+def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Spectral-norm reparameterization (reference
+    nn/utils/spectral_norm_hook.py): W_sn = W / sigma_max(W). The power
+    iteration advances a persistent u buffer; sigma = u^T W v is computed
+    INSIDE the recorded op so d(W/sigma)/dW keeps the -W (u v^T)/sigma^2
+    term (same structure as nn.SpectralNorm.forward)."""
+    if n_power_iterations < 1:
+        raise ValueError('n_power_iterations must be >= 1, got %d'
+                         % n_power_iterations)
+    w = getattr(layer, name)
+    if dim is None:
+        # reference hook: Linear and the transposed convs keep the output
+        # axis at position 1; everything else at 0
+        from .layer.common import Linear as _Linear
+        transposed = type(layer).__name__ in (
+            'Conv1DTranspose', 'Conv2DTranspose', 'Conv3DTranspose')
+        dim = 1 if isinstance(layer, _Linear) or transposed else 0
+    wd = w._data
+    h = wd.shape[dim]
+    import numpy as _np
+    rng = _np.random.RandomState(0)
+    u0 = _l2_normalize(jnp.asarray(rng.randn(h).astype(_np.float32)))
+    v = Parameter(wd)
+    layer.add_parameter(name + '_orig', v)
+    del layer._parameters[name]
+    layer.register_buffer(name + '_u', Tensor(u0), persistable=True)
+
+    def hook(lyr, inputs):
+        import jax
+        vv = lyr._parameters[name + '_orig']
+        u0_now = lyr._buffers[name + '_u']._data
+
+        def fn(x):
+            wmat = jnp.moveaxis(x, dim, 0).reshape(h, -1)
+            u, vvec = _sn_power_iterate(wmat, u0_now, n_power_iterations,
+                                        eps)
+            sigma = u @ (wmat @ vvec)
+            return x / sigma
+        w_new = run_op('spectral_norm', fn, vv)
+        if not isinstance(vv._data, jax.core.Tracer):
+            # eager path: persist the advanced u. Under an outer trace the
+            # buffer is left untouched — writing a tracer into persistent
+            # state would escape the trace.
+            wmat = jnp.moveaxis(vv._data, dim, 0).reshape(h, -1)
+            u, _ = _sn_power_iterate(wmat, u0_now, n_power_iterations, eps)
+            lyr._buffers[name + '_u']._data = u
+        lyr.__dict__[name] = w_new
+        return None
+    layer._sn_hook = layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_spectral_norm(layer, name='weight'):
+    v = layer._parameters.pop(name + '_orig')
+    layer._buffers.pop(name + '_u', None)
+    layer.__dict__.pop(name, None)
+    layer.add_parameter(name, Parameter(v._data))
+    if hasattr(layer, '_sn_hook'):
+        layer._sn_hook.remove()
     return layer

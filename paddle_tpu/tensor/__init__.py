@@ -12,6 +12,7 @@ from ..framework.core import Tensor, run_op, wrap_out, to_tensor
 from ..framework import dtype as dtype_mod
 
 from .creation import *  # noqa: F401,F403
+from .inplace import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
@@ -166,3 +167,8 @@ def _inplace(fn):
 
 _patch_operators()
 _patch_methods()
+
+
+def set_printoptions(**kwargs):
+    from .. import set_printoptions as _sp
+    _sp(**kwargs)

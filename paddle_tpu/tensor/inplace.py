@@ -1,0 +1,85 @@
+"""Inplace-op function forms + LoDTensorArray ops (reference
+tensor/__init__.py exports add_ / ceil_ / ... and the fluid
+array_read/array_write family).
+
+Inplace here means paddle's API contract — x is updated and returned —
+implemented as out-of-place compute plus handle rebind (XLA arrays are
+immutable; the tape linkage moves with the result so autograd agrees
+with the reference's inplace grads).
+"""
+from ..framework.core import Tensor
+
+__all__ = ['add_', 'subtract_', 'clip_', 'ceil_', 'exp_', 'floor_',
+           'reciprocal_', 'round_', 'rsqrt_', 'scale_', 'sqrt_',
+           'flatten_', 'create_array', 'array_write', 'array_read',
+           'array_length']
+
+
+def _make(op_name):
+    def fn(x, *args, **kwargs):
+        from . import math as M
+        from . import manipulation as MA
+        mod = M if hasattr(M, op_name) else MA
+        if not x.stop_gradient and x._grad_node is None:
+            # paddle parity: inplace on a grad-requiring LEAF is an error
+            # (its pre-op value would be unrecoverable for backward)
+            raise RuntimeError(
+                'a leaf Tensor that requires grad is being used in an '
+                'in-place operation (%s_)' % op_name)
+        # record the op against a detached alias carrying x's history, so
+        # rebinding x to the result cannot create a tape cycle
+        src = Tensor(x._data, stop_gradient=x.stop_gradient)
+        src._grad_node = x._grad_node
+        src._node_out_idx = x._node_out_idx
+        res = getattr(mod, op_name)(src, *args, **kwargs)
+        x._data = res._data
+        x._grad_node = res._grad_node
+        x._node_out_idx = res._node_out_idx
+        x.stop_gradient = res.stop_gradient
+        return x
+    fn.__name__ = op_name + '_'
+    fn.__doc__ = 'Inplace form of paddle.%s (updates and returns x).' % op_name
+    return fn
+
+
+add_ = _make('add')
+subtract_ = _make('subtract')
+clip_ = _make('clip')
+ceil_ = _make('ceil')
+exp_ = _make('exp')
+floor_ = _make('floor')
+reciprocal_ = _make('reciprocal')
+round_ = _make('round')
+rsqrt_ = _make('rsqrt')
+scale_ = _make('scale')
+sqrt_ = _make('sqrt')
+flatten_ = _make('flatten')
+
+
+# -- LoDTensorArray ops (reference fluid/layers/tensor.py) -------------------
+# TPU-native stance: the dynamic array is a host-side python list (static
+# control flow uses lax.scan instead); these exist for ported fluid code.
+
+def create_array(dtype='float32', initialized_list=None):
+    arr = list(initialized_list or [])
+    return arr
+
+
+def array_write(x, i, array=None):
+    i = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    if array is None:
+        array = create_array()
+    while len(array) <= i:
+        array.append(None)
+    array[i] = x if isinstance(x, Tensor) else Tensor(x)
+    return array
+
+
+def array_read(array, i):
+    i = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array[i]
+
+
+def array_length(array):
+    import numpy as np
+    return Tensor(np.asarray(len(array), np.int64))

@@ -1,0 +1,166 @@
+"""API-surface audit against the reference's own import lists.
+
+Parses the reference package's __init__ files (when the reference tree
+is present — skipped elsewhere) and asserts every public name they
+import exists on our namespaces. This is the committed, reproducible
+form of the round-3 surface audits.
+"""
+import pathlib
+import re
+
+import pytest
+
+REF = pathlib.Path('/root/reference/python/paddle')
+
+pytestmark = pytest.mark.skipif(not REF.exists(),
+                                reason='reference tree not available')
+
+# names that are deliberately absent (documented decisions)
+WAIVED = {
+    # fluid two-level namespace itself is superseded by paddle.static
+    'fluid',
+    # compiled-proto plumbing with no python-visible behavior
+    'core', 'core_avx', 'core_noavx',
+}
+
+
+def _ref_names(init_path):
+    txt = init_path.read_text(errors='ignore')
+    names = set()
+    for m in re.finditer(
+            r"^from [.\w]+ import ([\w, #\\\n]+?)(?:  #|$)", txt, re.M):
+        for n in m.group(1).replace('\\', ' ').replace('\n', ' ').split(','):
+            n = n.strip()
+            if n and n.isidentifier() and not n.startswith('_'):
+                names.add(n)
+    return names - WAIVED
+
+
+def _missing(ns, names):
+    return sorted(n for n in names if not hasattr(ns, n))
+
+
+def test_paddle_top_level_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle, _ref_names(REF / '__init__.py'))
+    assert not missing, missing
+
+
+def test_paddle_nn_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle.nn, _ref_names(REF / 'nn' / '__init__.py'))
+    assert not missing, missing
+
+
+def test_paddle_nn_functional_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle.nn.functional,
+                       _ref_names(REF / 'nn' / 'functional' / '__init__.py'))
+    assert not missing, missing
+
+
+def test_paddle_tensor_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle.tensor,
+                       _ref_names(REF / 'tensor' / '__init__.py'))
+    assert not missing, missing
+
+
+def test_paddle_static_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle.static,
+                       _ref_names(REF / 'static' / '__init__.py'))
+    assert not missing, missing
+
+
+def test_paddle_vision_and_io_surfaces():
+    import paddle_tpu as paddle
+    for sub, ns in [('vision', paddle.vision), ('io', paddle.io),
+                    ('optimizer', paddle.optimizer),
+                    ('metric', paddle.metric), ('amp', paddle.amp)]:
+        missing = _missing(ns, _ref_names(REF / sub / '__init__.py'))
+        assert not missing, (sub, missing)
+
+
+def test_paddle_distributed_surface():
+    import paddle_tpu as paddle
+    missing = _missing(paddle.distributed,
+                       _ref_names(REF / 'distributed' / '__init__.py'))
+    assert not missing, missing
+
+
+def test_inplace_fns_and_tensor_array_behavior():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.asarray([1.0, 4.0], np.float32))
+    y = paddle.tensor.sqrt_(x)
+    assert y is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 2.0])
+
+    # paddle parity: inplace on a grad-requiring leaf raises...
+    leaf = paddle.to_tensor(np.asarray([1.0], np.float32),
+                            stop_gradient=False)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match='in-place'):
+        paddle.tensor.exp_(leaf)
+    # ...but a non-leaf keeps full backward history through the rebind
+    h = leaf * 4.0
+    paddle.tensor.sqrt_(h)
+    h.sum().backward()            # d sqrt(4 l) / dl = 2 / (2 sqrt(l)) = 1
+    np.testing.assert_allclose(leaf.grad.numpy(), [1.0], rtol=1e-6)
+
+    arr = paddle.tensor.create_array()
+    paddle.tensor.array_write(paddle.to_tensor([1.0]), 0, arr)
+    paddle.tensor.array_write(paddle.to_tensor([2.0]), 1, arr)
+    assert int(paddle.tensor.array_length(arr).numpy()) == 2
+    np.testing.assert_allclose(
+        paddle.tensor.array_read(arr, 1).numpy(), [2.0])
+
+
+def test_spectral_norm_normalizes_sigma():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    lin = paddle.nn.Linear(16, 8)
+    paddle.nn.utils.spectral_norm(lin, n_power_iterations=20)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 16).astype(np.float32))
+    lin(x)
+    eff = lin.__dict__['weight'].numpy()
+    sigma = np.linalg.svd(eff, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 1e-2, sigma
+    paddle.nn.utils.remove_spectral_norm(lin)
+    assert 'weight' in dict(lin.named_parameters())
+
+
+def test_spectral_norm_gradient_has_sigma_term():
+    """d(W/sigma)/dW must include the -W (u v^T)/sigma^2 term — compare
+    the recorded-op gradient against a numeric one."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    paddle.seed(1)
+    lin = paddle.nn.Linear(5, 3)
+    paddle.nn.utils.spectral_norm(lin, n_power_iterations=30)
+    x_np = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+
+    def loss_for(w_np):
+        lin._parameters['weight_orig']._data = \
+            paddle.to_tensor(w_np)._data
+        return float(lin(paddle.to_tensor(x_np)).numpy().sum())
+
+    w0 = lin._parameters['weight_orig'].numpy().copy()
+    lin._parameters['weight_orig'].stop_gradient = False
+    out = lin(paddle.to_tensor(x_np))
+    out.sum().backward()
+    analytic = lin._parameters['weight_orig'].grad.numpy()
+
+    h = 1e-3
+    i, j = 2, 1
+    wp = w0.copy(); wp[i, j] += h
+    wm = w0.copy(); wm[i, j] -= h
+    numeric = (loss_for(wp) - loss_for(wm)) / (2 * h)
+    assert abs(analytic[i, j] - numeric) < 5e-2 * max(1, abs(numeric)), \
+        (analytic[i, j], numeric)

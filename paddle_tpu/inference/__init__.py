@@ -11,7 +11,50 @@ maps to compile options.
 """
 from .predictor import (Config, AnalysisConfig, Predictor,  # noqa: F401
                         AnalysisPredictor, create_predictor,
-                        PrecisionType, PlaceType, Tensor as PaddleInferTensor)
+                        PrecisionType, PlaceType, Tensor,
+                        Tensor as PaddleInferTensor, get_version)
+
+
+class DataType:
+    """reference paddle_infer::DataType enum."""
+    FLOAT32 = 'float32'
+    INT64 = 'int64'
+    INT32 = 'int32'
+    UINT8 = 'uint8'
+    INT8 = 'int8'
+    FLOAT16 = 'float16'
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    return _DTYPE_BYTES[dtype]
+
+
+class PredictorPool:
+    """reference paddle_infer::services::PredictorPool: `size` predictors
+    over one config for concurrent serving. The jitted executable cache is
+    shared per-process by XLA, so the pool is cheap; each Retrieve(i)
+    hands an independent Predictor (its own IO buffers)."""
+
+    def __init__(self, config, size=1):
+        if size < 1:
+            raise ValueError('pool size must be >= 1')
+        self._preds = [create_predictor(config) for _ in range(size)]
+
+    def retrive(self, idx):  # (sic) the reference binding's spelling
+        if not 0 <= idx < len(self._preds):
+            raise IndexError('predictor index %d out of range [0, %d)'
+                             % (idx, len(self._preds)))
+        return self._preds[idx]
+
+    retrieve = retrive
+    Retrieve = retrive
+
 
 __all__ = ['Config', 'AnalysisConfig', 'Predictor', 'AnalysisPredictor',
-           'create_predictor', 'PrecisionType', 'PlaceType']
+           'create_predictor', 'PrecisionType', 'PlaceType', 'DataType',
+           'Tensor', 'get_version', 'get_num_bytes_of_data_type',
+           'PredictorPool']

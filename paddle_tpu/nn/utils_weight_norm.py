@@ -75,18 +75,23 @@ def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12,
     w = getattr(layer, name)
     if dim is None:
         # reference hook: Linear and the transposed convs keep the output
-        # axis at position 1; everything else at 0
+        # axis at position 1; everything else at 0 (isinstance, so
+        # subclasses inherit the right default)
         from .layer.common import Linear as _Linear
-        transposed = type(layer).__name__ in (
-            'Conv1DTranspose', 'Conv2DTranspose', 'Conv3DTranspose')
-        dim = 1 if isinstance(layer, _Linear) or transposed else 0
+        from .layer import conv as _conv
+        transposed_classes = tuple(
+            getattr(_conv, c) for c in ('Conv1DTranspose', 'Conv2DTranspose',
+                                        'Conv3DTranspose')
+            if hasattr(_conv, c))
+        dim = 1 if isinstance(layer, (_Linear,) + transposed_classes) else 0
     wd = w._data
     h = wd.shape[dim]
     import numpy as _np
     rng = _np.random.RandomState(0)
     u0 = _l2_normalize(jnp.asarray(rng.randn(h).astype(_np.float32)))
-    v = Parameter(wd)
-    layer.add_parameter(name + '_orig', v)
+    # keep the ORIGINAL Parameter object as _orig so trainable /
+    # stop_gradient state survives the reparameterization
+    layer.add_parameter(name + '_orig', w)
     del layer._parameters[name]
     layer.register_buffer(name + '_u', Tensor(u0), persistable=True)
 
@@ -100,15 +105,13 @@ def spectral_norm(layer, name='weight', n_power_iterations=1, eps=1e-12,
             u, vvec = _sn_power_iterate(wmat, u0_now, n_power_iterations,
                                         eps)
             sigma = u @ (wmat @ vvec)
-            return x / sigma
-        w_new = run_op('spectral_norm', fn, vv)
-        if not isinstance(vv._data, jax.core.Tracer):
-            # eager path: persist the advanced u. Under an outer trace the
-            # buffer is left untouched — writing a tracer into persistent
-            # state would escape the trace.
-            wmat = jnp.moveaxis(vv._data, dim, 0).reshape(h, -1)
-            u, _ = _sn_power_iterate(wmat, u0_now, n_power_iterations, eps)
-            lyr._buffers[name + '_u']._data = u
+            return x / sigma, u
+        w_new, u_new = run_op('spectral_norm', fn, vv)
+        if not isinstance(u_new._data, jax.core.Tracer):
+            # eager path: persist the advanced u (computed once, inside
+            # the op). Under an outer trace the buffer is left untouched —
+            # writing a tracer into persistent state would escape it.
+            lyr._buffers[name + '_u']._data = u_new._data
         lyr.__dict__[name] = w_new
         return None
     layer._sn_hook = layer.register_forward_pre_hook(hook)
@@ -120,7 +123,7 @@ def remove_spectral_norm(layer, name='weight'):
     v = layer._parameters.pop(name + '_orig')
     layer._buffers.pop(name + '_u', None)
     layer.__dict__.pop(name, None)
-    layer.add_parameter(name, Parameter(v._data))
+    layer.add_parameter(name, v)  # same object: flags preserved
     if hasattr(layer, '_sn_hook'):
         layer._sn_hook.remove()
     return layer

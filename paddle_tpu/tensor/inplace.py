@@ -27,10 +27,13 @@ def _make(op_name):
                 'a leaf Tensor that requires grad is being used in an '
                 'in-place operation (%s_)' % op_name)
         # record the op against a detached alias carrying x's history, so
-        # rebinding x to the result cannot create a tape cycle
+        # rebinding x to the result cannot create a tape cycle; any other
+        # argument that IS x aliases to the same src for the same reason
         src = Tensor(x._data, stop_gradient=x.stop_gradient)
         src._grad_node = x._grad_node
         src._node_out_idx = x._node_out_idx
+        args = tuple(src if a is x else a for a in args)
+        kwargs = {k: (src if v is x else v) for k, v in kwargs.items()}
         res = getattr(mod, op_name)(src, *args, **kwargs)
         x._data = res._data
         x._grad_node = res._grad_node

@@ -164,3 +164,32 @@ def test_spectral_norm_gradient_has_sigma_term():
     numeric = (loss_for(wp) - loss_for(wm)) / (2 * h)
     assert abs(analytic[i, j] - numeric) < 5e-2 * max(1, abs(numeric)), \
         (analytic[i, j], numeric)
+
+
+def test_paddle_inference_surface():
+    import paddle_tpu.inference as inf
+    names = _ref_names(REF / 'inference' / '__init__.py')
+    missing = _missing(inf, names)
+    assert not missing, missing
+    assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT32) == 4
+
+
+def test_inplace_same_object_second_arg_and_frozen_spectral_norm():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    # add_(y, y): both branches' grads must survive the handle rebind
+    leaf = paddle.to_tensor(np.asarray([3.0], np.float32),
+                            stop_gradient=False)
+    y = leaf * 2.0
+    paddle.tensor.add_(y, y)        # y := 2x + 2x = 4x
+    y.sum().backward()
+    np.testing.assert_allclose(leaf.grad.numpy(), [4.0])
+
+    # spectral_norm on a frozen layer must not resurrect trainability
+    lin = paddle.nn.Linear(4, 3)
+    lin.weight.stop_gradient = True
+    paddle.nn.utils.spectral_norm(lin)
+    assert lin._parameters['weight_orig'].stop_gradient
+    paddle.nn.utils.remove_spectral_norm(lin)
+    assert lin.weight.stop_gradient

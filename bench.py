@@ -266,7 +266,15 @@ def _orchestrate(errors):
             pallas_ok, perr = _probe_pallas()
             if not pallas_ok:
                 errors.append(perr)
-                ladder = ladder[-1:]  # flash rungs are doomed; skip them
+                # flash rungs are doomed; go straight to the XLA path,
+                # largest batch first (amortizes the tunnel's per-dispatch
+                # overhead — the dominant off-ideal term when flash is
+                # out). Remat keeps the doubled batch inside HBM despite
+                # the quadratic jnp attention; derived from the safe rung
+                # so the flash-disable contract stays in one place.
+                b64 = dict(ladder[-1][0], PADDLE_TPU_BENCH_BATCH='64',
+                           PADDLE_TPU_BENCH_REMAT='1')
+                ladder = ((b64, 'flash_disabled_b64_remat'), ladder[-1])
         for attempt, (extra, label) in enumerate(ladder):
             result, err = _spawn_child(extra_env=extra)
             if result is not None:

@@ -328,3 +328,59 @@ def test_fleet_wrapper_behaviors(tmp_path):
         pass
     with pytest.raises(TypeError):
         DataParallel('not a layer')
+
+
+def test_ring_attention_long_context_8k():
+    """Long-context evidence: seq 8192 sharded sp=8 (1024 tokens/device)
+    through ring attention, fwd + grads, against a blocked numpy
+    reference. The full [n, n] score matrix (8192^2 = 67M entries per
+    head) never materializes on any one device."""
+    from paddle_tpu.ops.ring_attention import ring_attention_sharded
+    mesh = _mesh((8,), ('sp',))
+    rng = np.random.RandomState(0)
+    b, n, h, d = 1, 8192, 1, 8
+    q = jnp.asarray(rng.standard_normal((b, n, h, d)) * 0.2, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, n, h, d)) * 0.2, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, n, h, d)) * 0.2, jnp.float32)
+
+    out = ring_attention_sharded(q, k, v, mesh, causal=True)
+
+    # blocked reference (numpy, streaming over k-chunks to stay small)
+    qf = np.asarray(q[0, :, 0]); kf = np.asarray(k[0, :, 0])
+    vf = np.asarray(v[0, :, 0])
+    scale = 1.0 / np.sqrt(d)
+    m = np.full(n, -np.inf); l = np.zeros(n); acc = np.zeros((n, d))
+    for start in range(0, n, 1024):
+        kb = kf[start:start + 1024]; vb = vf[start:start + 1024]
+        s = qf @ kb.T * scale
+        col = np.arange(start, start + 1024)
+        s = np.where(col[None, :] <= np.arange(n)[:, None], s, -np.inf)
+        m_new = np.maximum(m, s.max(-1))
+        p = np.exp(s - m_new[:, None])
+        corr = np.exp(m - m_new)
+        l = l * corr + p.sum(-1)
+        acc = acc * corr[:, None] + p @ vb
+        m = m_new
+    ref_out = acc / l[:, None]
+    np.testing.assert_allclose(np.asarray(out)[0, :, 0], ref_out,
+                               atol=3e-4)
+
+    # gradients flow through the ring
+    def loss(q, k, v):
+        return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True)
+                       .astype(jnp.float32) ** 2)
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # check dq against the dense jnp reference gradient (fits on CPU)
+    def dense_loss(q, k, v):
+        s = jnp.einsum('bqhd,bkhd->bhqk', q, k) / np.sqrt(d)
+        mask = jnp.tril(jnp.ones((n, n), bool))
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum('bhqk,bkhd->bqhd', p, v)
+        return jnp.sum(o ** 2)
+    dq_ref = jax.grad(dense_loss)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g[0]), np.asarray(dq_ref),
+                               atol=3e-4)
+    for gi in g[1:]:
+        arr = np.asarray(gi)
+        assert np.isfinite(arr).all() and np.abs(arr).max() > 0

@@ -107,6 +107,26 @@ def _conv_transpose(name, nd, x, weight, bias, stride, padding, output_padding,
     dilation = _norm_tuple(dilation, nd)
     pad = _padding_arg(padding, nd)
     out_pad = _norm_tuple(output_padding, nd) if output_padding is not None else (0,) * nd
+    if output_size is not None:
+        # reference semantics: output_size overrides output_padding by
+        # out_pad_d = output_size_d - ((in_d-1)*s - p0 - p1 + d*(k-1) + 1)
+        want = [int(v) for v in (output_size if not isinstance(
+            output_size, int) else (output_size,) * nd)][-nd:]
+        spatial = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
+        k_sp = weight.shape[2:2 + nd]
+        if isinstance(pad, str):
+            raise ValueError('output_size with string padding is not '
+                             'supported — pass numeric padding')
+        base = [(si - 1) * st - p0 - p1 + dl * (kk - 1) + 1
+                for si, st, (p0, p1), dl, kk in zip(spatial, stride, pad,
+                                                    dilation, k_sp)]
+        out_pad = tuple(w_ - b_ for w_, b_ in zip(want, base))
+        for op_, st in zip(out_pad, stride):
+            if not 0 <= op_ < max(st, 1):
+                raise ValueError(
+                    'requested output_size %r unreachable: derived '
+                    'output_padding %r must lie in [0, stride)' %
+                    (want, out_pad))
 
     lhs_spec, rhs_spec, out_spec = _dimnums(nd, channel_last)
     dn = lax.conv_dimension_numbers((1,) * (nd + 2), (1,) * (nd + 2),
@@ -161,18 +181,21 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      data_format='NCL', name=None):
     fmt = 'NWC' if data_format in ('NLC',) else 'NCW'
     return _conv_transpose('conv1d_transpose', 1, x, weight, bias, stride,
-                           padding, output_padding, dilation, groups, fmt)
+                           padding, output_padding, dilation, groups, fmt,
+                           output_size)
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1, output_size=None,
                      data_format='NCHW', name=None):
     return _conv_transpose('conv2d_transpose', 2, x, weight, bias, stride,
-                           padding, output_padding, dilation, groups, data_format)
+                           padding, output_padding, dilation, groups,
+                           data_format, output_size)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1, output_size=None,
                      data_format='NCDHW', name=None):
     return _conv_transpose('conv3d_transpose', 3, x, weight, bias, stride,
-                           padding, output_padding, dilation, groups, data_format)
+                           padding, output_padding, dilation, groups,
+                           data_format, output_size)

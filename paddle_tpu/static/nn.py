@@ -26,6 +26,7 @@ __all__ = ['fc', 'cond', 'case', 'switch_case', 'while_loop', 'embedding',
            'batch_norm', 'layer_norm', 'instance_norm', 'group_norm',
            'prelu', 'conv2d', 'conv2d_transpose', 'conv3d', 'spectral_norm',
            'create_parameter', 'py_func', 'data_norm', 'nce',
+           'conv3d_transpose',
            'sparse_embedding', 'bilinear_tensor_product', 'deform_conv2d']
 
 
@@ -318,6 +319,14 @@ def conv3d(input, num_filters, filter_size, **kw):
     return _nn.Conv3D(input.shape[1], num_filters, filter_size)(input)
 
 
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, **kw):
+    from .. import nn as _nn
+    return _nn.Conv3DTranspose(input.shape[1], num_filters,
+                               filter_size or 4, stride=stride,
+                               padding=padding)(input, output_size)
+
+
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, **kw):
     raise NotImplementedError(
         'spectral_norm: use nn.utils.spectral_norm on the Layer instead')
@@ -387,7 +396,8 @@ for _n in ('sequence_conv', 'sequence_softmax', 'sequence_pool',
            'sequence_concat', 'sequence_first_step', 'sequence_last_step',
            'sequence_slice', 'sequence_expand', 'sequence_expand_as',
            'sequence_pad', 'sequence_unpad', 'sequence_reshape',
-           'sequence_scatter', 'sequence_enumerate', 'multi_box_head'):
+           'sequence_scatter', 'sequence_enumerate', 'sequence_reverse',
+           'multi_box_head'):
     globals()[_n] = _sequence_unsupported(_n)
     __all__.append(_n)
 

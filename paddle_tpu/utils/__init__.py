@@ -2,7 +2,8 @@
 from . import cpp_extension  # noqa: F401
 
 __all__ = ['cpp_extension', 'try_import', 'require_version', 'deprecated',
-           'run_check', 'download', 'unique_name']
+           'run_check', 'download', 'unique_name',
+           'profiler', 'ProfilerOptions', 'get_profiler']
 
 
 def try_import(module_name, err_msg=None):
@@ -86,3 +87,43 @@ class unique_name:
 from .. import profiler as _profiler_mod  # noqa: E402
 Profiler = _profiler_mod.Profiler if hasattr(_profiler_mod, 'Profiler') \
     else None
+
+
+class profiler:
+    """paddle.utils.profiler shim (reference utils/profiler.py wraps the
+    fluid profiler): maps onto the jax-backed paddle_tpu.profiler."""
+
+    class ProfilerOptions:
+        _DEFAULTS = {'batch_range': [10, 10], 'state': 'All',
+                     'sorted_key': 'total', 'tracer_option': 'Default',
+                     'profile_path': '/tmp/profile',
+                     'exit_on_finished': True, 'timer_only': True}
+
+        def __init__(self, options=None):
+            self._options = dict(self._DEFAULTS)
+            self._options.update(options or {})
+
+        def __getitem__(self, name):
+            if name not in self._options:
+                raise ValueError('ProfilerOptions does not have an option '
+                                 'named %s' % name)
+            return self._options[name]
+
+    @staticmethod
+    def get_profiler(*a, **k):
+        from .. import profiler as _p
+        return _p
+
+    @staticmethod
+    def start_profiler(*a, **k):
+        from .. import profiler as _p
+        return _p.start_profiler(*a, **k)
+
+    @staticmethod
+    def stop_profiler(*a, **k):
+        from .. import profiler as _p
+        return _p.stop_profiler(*a, **k)
+
+
+ProfilerOptions = profiler.ProfilerOptions
+get_profiler = profiler.get_profiler

@@ -292,3 +292,29 @@ def test_functional_extension_surface():
     assert losses[-1] < losses[0]
 
     assert F.elu_ is not None and F.softmax_ is not None
+
+
+def test_conv_transpose_output_size():
+    """output_size selects among the stride-ambiguous output shapes
+    (reference conv2d_transpose semantics); unreachable sizes raise."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 2, 4, 4).astype(np.float32))
+    w = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(2, 3, 3, 3).astype(np.float32))
+    assert tuple(F.conv2d_transpose(x, w, stride=2).shape) == (1, 3, 9, 9)
+    assert tuple(F.conv2d_transpose(x, w, stride=2,
+                                    output_size=10).shape) == (1, 3, 10, 10)
+    # the extra row/col is zero-padding at the end: the common region
+    # must agree with the default-output result
+    a = F.conv2d_transpose(x, w, stride=2).numpy()
+    b = F.conv2d_transpose(x, w, stride=2, output_size=10).numpy()
+    np.testing.assert_allclose(b[..., :9, :9], a, rtol=1e-5)
+    import pytest
+    with pytest.raises(ValueError, match='unreachable'):
+        F.conv2d_transpose(x, w, stride=2, output_size=12)
+    lyr = paddle.nn.Conv2DTranspose(2, 3, 3, stride=2)
+    assert tuple(lyr(x, output_size=[10, 10]).shape) == (1, 3, 10, 10)

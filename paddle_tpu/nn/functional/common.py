@@ -319,15 +319,23 @@ def interpolate(x, size=None, scale_factor=None, mode='nearest',
         new_shape = list(a.shape)
         for d, s in zip(spatial, out_sizes):
             new_shape[d] = s
-        if method == 'nearest' or not align_corners:
+        asymmetric = (align_mode == 1 and not align_corners and
+                      method == 'linear')
+        if method == 'nearest' or (not align_corners and not asymmetric):
             return jax.image.resize(a, tuple(new_shape), method=method)
-        # align_corners: gather with explicit index mapping
+        # align_corners / align_mode=1: gather with explicit index mapping
+        # (reference interpolate: align_mode 1 maps src = dst * in/out with
+        # no half-pixel shift, vs jax.image.resize's half-pixel convention)
         out = a
         for d, s in zip(spatial, out_sizes):
             in_s = out.shape[d]
             if s == in_s:
                 continue
-            pos = jnp.linspace(0.0, in_s - 1.0, s)
+            if asymmetric:
+                pos = jnp.arange(s) * (in_s / s)
+                pos = jnp.clip(pos, 0.0, in_s - 1.0)
+            else:
+                pos = jnp.linspace(0.0, in_s - 1.0, s)
             i0 = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_s - 1)
             i1 = jnp.clip(i0 + 1, 0, in_s - 1)
             frac = (pos - i0).astype(a.dtype)

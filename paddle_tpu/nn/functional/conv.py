@@ -113,7 +113,7 @@ def _conv_transpose(name, nd, x, weight, bias, stride, padding, output_padding,
         want = [int(v) for v in (output_size if not isinstance(
             output_size, int) else (output_size,) * nd)][-nd:]
         spatial = x.shape[1:1 + nd] if channel_last else x.shape[2:2 + nd]
-        k_sp = weight.shape[2:2 + nd]
+        k_sp = w.shape[2:2 + nd]
         if isinstance(pad, str):
             raise ValueError('output_size with string padding is not '
                              'supported — pass numeric padding')

@@ -380,6 +380,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         end2 = jnp.where(ll > 0, alpha[bidx, jnp.maximum(2 * ll - 1, 0)], neg_inf)
         ll_total = jnp.logaddexp(end1, end2)
         loss = -ll_total
+        if norm_by_times:
+            # reference warpctc norm_by_times: gradients (not the loss
+            # VALUE) are scaled by 1/T — forward stays `loss`, backward
+            # differentiates loss/T
+            t_inv = loss / jnp.maximum(il.astype(loss.dtype), 1.0)
+            loss = t_inv + jax.lax.stop_gradient(loss - t_inv)
         if reduction == 'mean':
             return jnp.mean(loss / jnp.maximum(ll.astype(loss.dtype), 1.0))
         return _reduce(loss, reduction)

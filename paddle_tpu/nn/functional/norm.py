@@ -109,10 +109,19 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
 
     has_w = weight is not None
     has_b = bias is not None
+    if not use_input_stats and (running_mean is None or running_var is None):
+        raise ValueError('use_input_stats=False requires running_mean and '
+                         'running_var')
+    rm = ensure_tensor(running_mean)._data if not use_input_stats else None
+    rv = ensure_tensor(running_var)._data if not use_input_stats else None
 
     def fn(a, *wb):
-        m = jnp.mean(a, axis=spatial, keepdims=True)
-        v = jnp.var(a, axis=spatial, keepdims=True)
+        if use_input_stats:
+            m = jnp.mean(a, axis=spatial, keepdims=True)
+            v = jnp.var(a, axis=spatial, keepdims=True)
+        else:
+            m = rm.reshape(shape)
+            v = rv.reshape(shape)
         out = (a - m) * jax.lax.rsqrt(v + eps)
         i = 0
         if has_w:

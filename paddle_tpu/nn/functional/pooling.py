@@ -24,7 +24,12 @@ def _norm(v, n):
 
 
 def _pool(name, nd, x, kernel, stride, padding, mode, ceil_mode=False,
-          exclusive=True, data_format='NCHW'):
+          exclusive=True, data_format='NCHW', divisor_override=None):
+    if divisor_override is not None:
+        if divisor_override <= 0:
+            raise ValueError('divisor_override must be > 0, got %r'
+                             % divisor_override)
+        exclusive = False
     x = ensure_tensor(x)
     channel_last = data_format in ('NHWC', 'NWC', 'NDHWC', 'NLC')
     k = _norm(kernel, nd)
@@ -65,6 +70,10 @@ def _pool(name, nd, x, kernel, stride, padding, mode, ceil_mode=False,
             counts = lax.reduce_window(ones, 0.0, lax.add, tuple(window),
                                        tuple(strides), pads)
             return summed / counts
+        if divisor_override is not None:
+            # reference: window SUM divided by the override instead of
+            # the (padding-inclusive) window size
+            return summed / float(divisor_override)
         return summed / float(np.prod(k))
     return run_op(name, fn, x)
 
@@ -202,14 +211,16 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format='NCHW',
                name=None):
     return _pool('avg_pool2d', 2, x, kernel_size, stride, padding, 'avg',
-                 ceil_mode, exclusive, data_format=data_format)
+                 ceil_mode, exclusive, data_format=data_format,
+                 divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format='NCDHW',
                name=None):
     return _pool('avg_pool3d', 3, x, kernel_size, stride, padding, 'avg',
-                 ceil_mode, exclusive, data_format=data_format)
+                 ceil_mode, exclusive, data_format=data_format,
+                 divisor_override=divisor_override)
 
 
 def _adaptive(name, nd, x, output_size, mode, data_format):

@@ -153,7 +153,7 @@ class RNN(Layer):
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
         return _run_rnn(self.cell, inputs, initial_states, self.is_reverse,
-                        self.time_major)
+                        self.time_major, sequence_length)
 
 
 class BiRNN(Layer):
@@ -165,9 +165,9 @@ class BiRNN(Layer):
     def forward(self, inputs, initial_states=None, sequence_length=None):
         fw_st, bw_st = (None, None) if initial_states is None else initial_states
         out_f, st_f = _run_rnn(self.cell_fw, inputs, fw_st, False,
-                               self.time_major)
+                               self.time_major, sequence_length)
         out_b, st_b = _run_rnn(self.cell_bw, inputs, bw_st, True,
-                               self.time_major)
+                               self.time_major, sequence_length)
         from ...tensor.manipulation import concat
         return concat([out_f, out_b], axis=-1), (st_f, st_b)
 
@@ -176,8 +176,13 @@ def _cell_params(cell):
     return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
 
 
-def _run_rnn(cell, inputs, initial_states, is_reverse, time_major):
-    """Scan `cell` over the time axis as ONE recorded op."""
+def _run_rnn(cell, inputs, initial_states, is_reverse, time_major,
+             sequence_length=None):
+    """Scan `cell` over the time axis as ONE recorded op.
+
+    sequence_length (reference rnn.py semantics): steps at t >=
+    sequence_length[b] emit zeros and do not advance row b's state; the
+    reverse direction reverses only each row's valid prefix."""
     x = ensure_tensor(inputs)
     time_axis = 0 if time_major else 1
     batch = x.shape[1 if time_major else 0]
@@ -225,20 +230,57 @@ def _run_rnn(cell, inputs, initial_states, is_reverse, time_major):
         h_new = a(x_t @ wi.T + bi + h @ wh.T + bh)
         return h_new, h_new
 
-    def fn(xa, wi, wh, bi, bh):
+    lens_arr = None
+    if sequence_length is not None:
+        lens_arr = ensure_tensor(sequence_length)._data.astype(jnp.int32)
+
+    def _rev_within(seq, lens):
+        """Reverse each batch row's valid prefix along time (axis 0);
+        involution, so it also un-reverses scan outputs."""
+        T = seq.shape[0]
+        t = jnp.arange(T, dtype=jnp.int32)[:, None]           # [T, 1]
+        idx = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)
+        idx = idx.reshape(idx.shape + (1,) * (seq.ndim - 2))
+        return jnp.take_along_axis(
+            seq, jnp.broadcast_to(idx, seq.shape).astype(jnp.int32), axis=0)
+
+    def fn(xa, wi, wh, bi, bh, *maybe_lens):
         xs = jnp.moveaxis(xa, time_axis, 0)
+        lens = maybe_lens[0] if maybe_lens else None
         if is_reverse:
-            xs = jnp.flip(xs, axis=0)
-        carry, ys = jax.lax.scan(
-            lambda c, x_t: step_fn(c, x_t, wi, wh, bi, bh), init, xs)
+            xs = _rev_within(xs, lens) if lens is not None \
+                else jnp.flip(xs, axis=0)
+
+        if lens is None:
+            carry, ys = jax.lax.scan(
+                lambda c, x_t: step_fn(c, x_t, wi, wh, bi, bh), init, xs)
+        else:
+            def masked_step(c_t, inp):
+                c, t = c_t
+                x_t = inp
+                alive = (t < lens)[:, None]                    # [B, 1]
+                new_c, y = step_fn(c, x_t, wi, wh, bi, bh)
+                if is_lstm:
+                    held = (jnp.where(alive, new_c[0], c[0]),
+                            jnp.where(alive, new_c[1], c[1]))
+                else:
+                    held = jnp.where(alive, new_c, c)
+                return (held, t + 1), jnp.where(alive, y, 0.0)
+            (carry, _), ys = jax.lax.scan(
+                masked_step, (init, jnp.zeros((), jnp.int32)), xs)
+
         if is_reverse:
-            ys = jnp.flip(ys, axis=0)
+            ys = _rev_within(ys, lens) if lens is not None \
+                else jnp.flip(ys, axis=0)
         out = jnp.moveaxis(ys, 0, time_axis)
         if is_lstm:
             return out, carry[0], carry[1]
         return out, carry
 
-    outs = run_op('rnn_scan', fn, x, *params)
+    op_args = (x,) + tuple(params)
+    if lens_arr is not None:
+        op_args = op_args + (Tensor(lens_arr),)
+    outs = run_op('rnn_scan', fn, *op_args)
     if is_lstm:
         out, h, c = outs
         return out, (h, c)
@@ -290,14 +332,17 @@ class _StackedRNNBase(Layer):
             if self.num_directions == 2:
                 cell_f, cell_b = self._cells[idx], self._cells[idx + 1]
                 idx += 2
-                of, sf = _run_rnn(cell_f, out, None, False, self.time_major)
-                ob, sb = _run_rnn(cell_b, out, None, True, self.time_major)
+                of, sf = _run_rnn(cell_f, out, None, False, self.time_major,
+                                  sequence_length)
+                ob, sb = _run_rnn(cell_b, out, None, True, self.time_major,
+                                  sequence_length)
                 out = concat([of, ob], axis=-1)
                 states = [sf, sb]
             else:
                 cell = self._cells[idx]
                 idx += 1
-                out, st = _run_rnn(cell, out, None, False, self.time_major)
+                out, st = _run_rnn(cell, out, None, False, self.time_major,
+                                   sequence_length)
                 states = [st]
             for st in states:
                 if self.mode == 'LSTM':

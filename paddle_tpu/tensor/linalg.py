@@ -144,6 +144,12 @@ def matrix_power(x, n, name=None):
 def matrix_rank(x, tol=None, hermitian=False, name=None):
     x = ensure_tensor(x)
     t = tol._data if isinstance(tol, Tensor) else tol
+    if hermitian:
+        # rank from |eigvalsh| (reference uses syevd for hermitian=True)
+        w = jnp.abs(jnp.linalg.eigvalsh(x._data))
+        if t is None:
+            t = w.max(-1) * max(x.shape[-2], x.shape[-1]) *                 jnp.finfo(x._data.dtype).eps
+        return wrap_out(jnp.sum(w > t, axis=-1))
     return wrap_out(jnp.linalg.matrix_rank(x._data, tol=t))
 
 
@@ -195,6 +201,9 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    if not pivot:
+        raise NotImplementedError(
+            'lu(pivot=False): XLA exposes partial-pivoting LU only')
     x = ensure_tensor(x)
     lu_, piv = jax.scipy.linalg.lu_factor(x._data)
     outs = (wrap_out(lu_), wrap_out(piv.astype(jnp.int32) + 1))

@@ -435,13 +435,25 @@ def crop(x, shape=None, offsets=None, name=None):
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
     x = ensure_tensor(x)
-    n = min(x.shape[-2], x.shape[-1])
+    rows, cols = x.shape[-2], x.shape[-1]
+    n = min(rows, cols)
     i = jnp.arange(n - (offset if offset > 0 else 0))
 
     def fn(a):
         r = i + (-offset if offset < 0 else 0)
         c = i + (offset if offset > 0 else 0)
-        return a.at[..., r, c].set(value)
+        out = a.at[..., r, c].set(value)
+        if wrap and rows > cols and offset == 0:
+            # numpy fill_diagonal(wrap=True): tall matrices restart the
+            # diagonal after a one-row gap, every (cols+1) rows
+            start = cols + 1
+            while start < rows:
+                m = min(cols, rows - start)
+                rr = jnp.arange(m) + start
+                cc = jnp.arange(m)
+                out = out.at[..., rr, cc].set(value)
+                start += cols + 1
+        return out
     out = run_op('fill_diagonal_', fn, x)
     x._data, x._grad_node, x._node_out_idx = out._data, out._grad_node, out._node_out_idx
     x.stop_gradient = out.stop_gradient

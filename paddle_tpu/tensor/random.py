@@ -96,7 +96,10 @@ def poisson(x, name=None):
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
     x = ensure_tensor(x)
-    x._data = jax.random.uniform(rng.next_key(), tuple(x._data.shape),
+    # seed != 0 gives a reproducible draw independent of the global
+    # stream (reference uniform_random_inplace semantics)
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    x._data = jax.random.uniform(key, tuple(x._data.shape),
                                  x._data.dtype, minval=min, maxval=max)
     return x
 

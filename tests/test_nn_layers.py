@@ -318,3 +318,134 @@ def test_conv_transpose_output_size():
         F.conv2d_transpose(x, w, stride=2, output_size=12)
     lyr = paddle.nn.Conv2DTranspose(2, 3, 3, stride=2)
     assert tuple(lyr(x, output_size=[10, 10]).shape) == (1, 3, 10, 10)
+
+
+def test_rnn_sequence_length_masking():
+    """sequence_length semantics (reference rnn.py): steps past a row's
+    length emit zeros and freeze the state; reverse direction reverses
+    only the valid prefix."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    b, t, din, h = 3, 6, 4, 5
+    lstm = nn.LSTM(din, h)
+    x = np.random.RandomState(0).randn(b, t, din).astype(np.float32)
+    lens = np.asarray([6, 3, 1], np.int32)
+    out, (hn, cn) = lstm(paddle.to_tensor(x),
+                         sequence_length=paddle.to_tensor(lens))
+    out = out.numpy()
+    # past-length steps emit zeros
+    assert np.abs(out[1, 3:]).max() == 0 and np.abs(out[2, 1:]).max() == 0
+    # each row matches running its truncated prefix alone
+    for i, n in enumerate(lens):
+        o_i, (h_i, c_i) = lstm(paddle.to_tensor(x[i:i + 1, :n]))
+        np.testing.assert_allclose(out[i, :n], o_i.numpy()[0], rtol=1e-5,
+                                   atol=1e-6)
+        # final state froze at the last valid step
+        np.testing.assert_allclose(hn.numpy()[0, i], h_i.numpy()[0, 0],
+                                   rtol=1e-5, atol=1e-6)
+
+    # bidirectional: the backward half at t=0 equals running the REVERSED
+    # valid prefix, i.e. final-state of reverse pass over row prefix
+    bi = nn.LSTM(din, h, direction='bidirect')
+    out_bi, _ = bi(paddle.to_tensor(x),
+                   sequence_length=paddle.to_tensor(lens))
+    out_bi = out_bi.numpy()
+    for i, n in enumerate(lens):
+        o_i, _ = bi(paddle.to_tensor(x[i:i + 1, :n]))
+        np.testing.assert_allclose(out_bi[i, :n], o_i.numpy()[0],
+                                   rtol=1e-5, atol=1e-6)
+        if n < t:
+            assert np.abs(out_bi[i, n:]).max() == 0
+
+
+def test_dropped_param_fixes():
+    """Batch of parameters that were accepted but silently ignored
+    (found by AST sweep): instance_norm running stats, interpolate
+    align_mode, avg_pool divisor_override, matrix_rank hermitian,
+    lu pivot guard, fill_diagonal_ wrap, ctc norm_by_times,
+    uniform_ seed."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    # instance_norm with provided stats (use_input_stats=False)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 4, 4).astype(np.float32))
+    rm = paddle.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+    rv = paddle.to_tensor(np.asarray([4.0, 4.0, 4.0], np.float32))
+    out = F.instance_norm(x, running_mean=rm, running_var=rv,
+                          use_input_stats=False, eps=0.0).numpy()
+    want = (x.numpy() - np.asarray([1, 2, 3], np.float32)
+            .reshape(1, 3, 1, 1)) / 2.0
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+    with pytest.raises(ValueError, match='use_input_stats'):
+        F.instance_norm(x, use_input_stats=False)
+
+    # interpolate align_mode=1 (asymmetric) differs from half-pixel
+    img = paddle.to_tensor(np.arange(4, dtype=np.float32)
+                           .reshape(1, 1, 1, 4))
+    up0 = F.interpolate(img, size=[1, 8], mode='bilinear',
+                        align_mode=0).numpy()
+    up1 = F.interpolate(img, size=[1, 8], mode='bilinear',
+                        align_mode=1).numpy()
+    assert not np.allclose(up0, up1)
+    # align_mode=1: src = dst * 0.5 exactly -> first two outputs 0, 0.5
+    np.testing.assert_allclose(up1[0, 0, 0, :3], [0.0, 0.5, 1.0],
+                               atol=1e-6)
+
+    # avg_pool divisor_override
+    a = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+    o = F.avg_pool2d(a, 2, 2, divisor_override=8).numpy()
+    np.testing.assert_allclose(o, 0.5)  # sum 4 / 8
+
+    # matrix_rank hermitian
+    m = np.diag([5.0, 3.0, 0.0]).astype(np.float32)
+    assert int(paddle.linalg.matrix_rank(
+        paddle.to_tensor(m), hermitian=True).numpy()) == 2
+
+    with pytest.raises(NotImplementedError):
+        paddle.linalg.lu(paddle.to_tensor(np.eye(3, dtype=np.float32)),
+                         pivot=False)
+
+    # fill_diagonal_ wrap on a tall matrix
+    tall = paddle.to_tensor(np.zeros((7, 3), np.float32))
+    paddle.tensor.manipulation.fill_diagonal_(tall, 1.0, wrap=True)
+    got = tall.numpy()
+    assert got[0, 0] == got[1, 1] == got[2, 2] == 1.0
+    assert got[4, 0] == got[5, 1] == got[6, 2] == 1.0
+    assert got[3].sum() == 0  # the gap row
+
+    # uniform_ with a fixed seed is reproducible
+    t1 = paddle.tensor.random.uniform_(
+        paddle.to_tensor(np.zeros(8, np.float32)), seed=5).numpy()
+    t2 = paddle.tensor.random.uniform_(
+        paddle.to_tensor(np.zeros(8, np.float32)), seed=5).numpy()
+    np.testing.assert_array_equal(t1, t2)
+
+    # ctc norm_by_times: loss VALUE unchanged, gradients scaled by 1/T
+    # (reference warpctc normalizes only the gradients)
+    T, B, C = 4, 1, 3
+    lp_np = np.random.RandomState(1).randn(T, B, C).astype(np.float32)
+    lab = paddle.to_tensor(np.asarray([[1, 2]], np.int64))
+    il = paddle.to_tensor(np.asarray([4], np.int64))
+    ll = paddle.to_tensor(np.asarray([2], np.int64))
+
+    lp1 = paddle.to_tensor(lp_np, stop_gradient=False)
+    base = F.ctc_loss(lp1, lab, il, ll, reduction='sum')
+    base.backward()
+    lp2 = paddle.to_tensor(lp_np, stop_gradient=False)
+    normed = F.ctc_loss(lp2, lab, il, ll, reduction='sum',
+                        norm_by_times=True)
+    normed.backward()
+    np.testing.assert_allclose(float(normed.numpy()), float(base.numpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(lp2.grad.numpy(), lp1.grad.numpy() / 4.0,
+                               rtol=1e-5, atol=1e-7)
+
+    # divisor_override must be positive
+    with pytest.raises(ValueError, match='divisor_override'):
+        F.avg_pool2d(a, 2, 2, divisor_override=0)

@@ -237,7 +237,7 @@ class ReduceOnPlateau(LRScheduler):
             current = float(metrics)
         except TypeError:
             current = float(metrics.numpy())
-        self.last_epoch += 1
+        self.last_epoch = epoch if epoch is not None else             self.last_epoch + 1
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.num_bad_epochs = 0

@@ -108,6 +108,11 @@ class Optimizer:
         if self._parameter_list:
             for p in self._parameter_list:
                 p.clear_grad()
+                if set_to_zero and not p.stop_gradient:
+                    # paddle parity: grads become zero tensors, so step()
+                    # applies decay/momentum to every listed param — the
+                    # reference behaves the same for zero-grad params
+                    p._grad = Tensor(jnp.zeros_like(p._data))
 
     clear_gradients = clear_grad
 

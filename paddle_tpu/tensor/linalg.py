@@ -148,7 +148,11 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
         # rank from |eigvalsh| (reference uses syevd for hermitian=True)
         w = jnp.abs(jnp.linalg.eigvalsh(x._data))
         if t is None:
-            t = w.max(-1) * max(x.shape[-2], x.shape[-1]) *                 jnp.finfo(x._data.dtype).eps
+            t = w.max(-1, keepdims=True) * \
+                max(x.shape[-2], x.shape[-1]) * jnp.finfo(x._data.dtype).eps
+        else:
+            t = jnp.asarray(t)
+            t = t[..., None] if t.ndim else t
         return wrap_out(jnp.sum(w > t, axis=-1))
     return wrap_out(jnp.linalg.matrix_rank(x._data, tol=t))
 

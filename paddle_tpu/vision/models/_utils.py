@@ -1,0 +1,10 @@
+"""Shared model-factory helpers."""
+
+
+def _no_pretrained(arch, pretrained):
+    if pretrained:
+        raise ValueError(
+            '%s: pretrained=True is not available in this environment '
+            '(no weight download); construct the model and load a local '
+            'checkpoint via set_state_dict(paddle.load(path)) instead'
+            % arch)

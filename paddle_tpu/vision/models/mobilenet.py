@@ -145,8 +145,12 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    from ._utils import _no_pretrained
+    _no_pretrained('mobilenet_v1', pretrained)
     return MobileNetV1(scale=scale, **kwargs)
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    from ._utils import _no_pretrained
+    _no_pretrained('mobilenet_v2', pretrained)
     return MobileNetV2(scale=scale, **kwargs)

@@ -126,7 +126,11 @@ class ResNet(nn.Layer):
         return x
 
 
+from ._utils import _no_pretrained
+
+
 def _resnet(arch, Block, depth, pretrained, **kwargs):
+    _no_pretrained(arch, pretrained)
     model = ResNet(Block, depth, **kwargs)
     return model
 

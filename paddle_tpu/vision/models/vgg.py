@@ -56,6 +56,8 @@ class VGG(nn.Layer):
 
 
 def _vgg(arch, cfg, batch_norm, pretrained, **kwargs):
+    from ._utils import _no_pretrained
+    _no_pretrained(arch, pretrained)
     return VGG(make_layers(cfgs[cfg], batch_norm=batch_norm), **kwargs)
 
 

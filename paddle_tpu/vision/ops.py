@@ -108,21 +108,58 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             mask_anchors[local_a, 1], 1e-9), 1e-9))
         scale = 2.0 - gtb[..., 2] * gtb[..., 3]
 
-        px = jax.nn.sigmoid(a[:, :, 0])
-        py = jax.nn.sigmoid(a[:, :, 1])
+        # scale_x_y (PP-YOLO trick): stretch the sigmoid box center
+        px = jax.nn.sigmoid(a[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
+        py = jax.nn.sigmoid(a[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0)
         pw = a[:, :, 2]
         ph = a[:, :, 3]
         pobj = a[:, :, 4]
         pcls = a[:, :, 5:]
 
         m = in_mask.astype(a.dtype)
+        if gt_score is not None:
+            # mixup/soft scores weight every positive term (reference
+            # yolov3_loss GTScore input)
+            m = m * ensure_tensor(gt_score)._data.astype(a.dtype)
         loss_xy = jnp.sum(m * scale * ((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2))
         loss_wh = jnp.sum(m * scale * ((pw[sel] - tw) ** 2 + (ph[sel] - th) ** 2))
         obj_target = jnp.zeros((n, na, h, w), a.dtype)
         obj_target = obj_target.at[sel].max(m)
+        # ignore_thresh (reference yolov3_loss_op.h CalcObjnessLoss):
+        # negatives whose PREDICTED box overlaps any gt above the
+        # threshold are excluded from the objectness loss
+        grid_x = jnp.arange(w, dtype=a.dtype)[None, None, None, :]
+        grid_y = jnp.arange(h, dtype=a.dtype)[None, None, :, None]
+        pbx = (grid_x + px) / w                                  # [n,na,h,w]
+        pby = (grid_y + py) / h
+        pbw = jnp.exp(jnp.clip(pw, -10, 10)) * \
+            mask_anchors[:, 0][None, :, None, None] / input_size
+        pbh = jnp.exp(jnp.clip(ph, -10, 10)) * \
+            mask_anchors[:, 1][None, :, None, None] / input_size
+        # corners, normalized coords; gt boxes are (cx, cy, w, h) norm
+        p_x0, p_x1 = pbx - pbw / 2, pbx + pbw / 2
+        p_y0, p_y1 = pby - pbh / 2, pby + pbh / 2
+        g_x0 = (gtb[..., 0] - gtb[..., 2] / 2)                   # [n, G]
+        g_x1 = (gtb[..., 0] + gtb[..., 2] / 2)
+        g_y0 = (gtb[..., 1] - gtb[..., 3] / 2)
+        g_y1 = (gtb[..., 1] + gtb[..., 3] / 2)
+        ex = (slice(None), None, None, None)  # broadcast gt over na,h,w
+        iw = jnp.maximum(jnp.minimum(p_x1[..., None], g_x1[ex]) -
+                         jnp.maximum(p_x0[..., None], g_x0[ex]), 0.0)
+        ih = jnp.maximum(jnp.minimum(p_y1[..., None], g_y1[ex]) -
+                         jnp.maximum(p_y0[..., None], g_y0[ex]), 0.0)
+        inter_pg = iw * ih
+        area_p = (pbw * pbh)[..., None]
+        area_g = (gtb[..., 2] * gtb[..., 3])[ex]
+        iou_pg = inter_pg / jnp.maximum(area_p + area_g - inter_pg, 1e-9)
+        iou_pg = jnp.where(valid[ex] > 0, iou_pg, 0.0)
+        best_iou = iou_pg.max(-1)                               # [n,na,h,w]
+        noobj_keep = (best_iou <= ignore_thresh).astype(a.dtype)
+        obj_weight = obj_target + (1.0 - jnp.minimum(obj_target, 1.0)) * \
+            noobj_keep
         bce = jnp.maximum(pobj, 0) - pobj * obj_target + \
             jnp.log1p(jnp.exp(-jnp.abs(pobj)))
-        loss_obj = jnp.sum(bce)
+        loss_obj = jnp.sum(bce * obj_weight)
         smooth = 1.0 / class_num if use_label_smooth else 0.0
         cls_target = jax.nn.one_hot(gtl, class_num, dtype=a.dtype)
         cls_target = cls_target * (1 - smooth) + smooth / 2

@@ -42,8 +42,13 @@ def normalize(img, mean, std, data_format='CHW', to_rgb=False):
     return Tensor(out) if isinstance(img, Tensor) else out
 
 
-def _interp_resize(img, size):
-    """Nearest/bilinear resize of an HWC numpy image via jax.image."""
+_INTERP = {'nearest': 'nearest', 'bilinear': 'linear', 'linear': 'linear',
+           'bicubic': 'cubic', 'cubic': 'cubic', 'lanczos': 'lanczos3',
+           'area': 'linear', 'box': 'linear'}
+
+
+def _interp_resize(img, size, interpolation='bilinear'):
+    """Resize of an HWC numpy image via jax.image (method honored)."""
     import jax
     import jax.numpy as jnp
     h, w = img.shape[:2]
@@ -56,7 +61,7 @@ def _interp_resize(img, size):
         oh, ow = size
     out_shape = (oh, ow) + img.shape[2:]
     out = jax.image.resize(jnp.asarray(img.astype(np.float32)), out_shape,
-                           method='linear')
+                           method=_INTERP.get(interpolation, 'linear'))
     res = np.asarray(out)
     if img.dtype == np.uint8:
         res = np.clip(res, 0, 255).astype(np.uint8)
@@ -65,7 +70,7 @@ def _interp_resize(img, size):
 
 def resize(img, size, interpolation='bilinear'):
     arr = _np_img(img)
-    return _interp_resize(arr, size)
+    return _interp_resize(arr, size, interpolation)
 
 
 def crop(img, top, left, height, width):
@@ -113,16 +118,33 @@ def rotate(img, angle, interpolation='nearest', expand=False, center=None,
            fill=0):
     arr = _np_img(img)
     k = int(round(angle / 90.0)) % 4
-    if abs(angle - 90 * round(angle / 90.0)) < 1e-6:
+    exact90 = abs(angle - 90 * round(angle / 90.0)) < 1e-6
+    # rot90 shortcut changes the canvas shape, which is only correct
+    # when expanding (or the image is square and the shapes coincide)
+    if exact90 and center is None and \
+            (expand or k % 2 == 0 or arr.shape[0] == arr.shape[1]):
         return np.rot90(arr, k).copy()
-    # arbitrary angles: inverse-map nearest sampling
     h, w = arr.shape[:2]
-    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else \
-        (center[1], center[0])
     theta = np.deg2rad(angle)
-    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
-    ys = (yy - cy) * np.cos(theta) - (xx - cx) * np.sin(theta) + cy
-    xs = (yy - cy) * np.sin(theta) + (xx - cx) * np.cos(theta) + cx
+    if expand:
+        # reference (PIL) expand=True: output canvas is the rotated
+        # bounding box; rotation is about the image center (center arg
+        # only shifts the pivot for expand=False, matching PIL)
+        oh = int(abs(h * np.cos(theta)) + abs(w * np.sin(theta)) + 0.5)
+        ow = int(abs(h * np.sin(theta)) + abs(w * np.cos(theta)) + 0.5)
+        cy_in, cx_in = (h - 1) / 2.0, (w - 1) / 2.0
+        cy_out, cx_out = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow = h, w
+        cy_in, cx_in = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+            else (center[1], center[0])
+        cy_out, cx_out = cy_in, cx_in
+    # inverse-map nearest sampling: output pixel -> source pixel
+    yy, xx = np.mgrid[0:oh, 0:ow].astype(np.float32)
+    ys = (yy - cy_out) * np.cos(theta) - (xx - cx_out) * np.sin(theta) \
+        + cy_in
+    xs = (yy - cy_out) * np.sin(theta) + (xx - cx_out) * np.cos(theta) \
+        + cx_in
     yi = np.clip(np.round(ys).astype(np.int64), 0, h - 1)
     xi = np.clip(np.round(xs).astype(np.int64), 0, w - 1)
     out = arr[yi, xi]

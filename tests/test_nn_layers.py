@@ -449,3 +449,24 @@ def test_dropped_param_fixes():
     # divisor_override must be positive
     with pytest.raises(ValueError, match='divisor_override'):
         F.avg_pool2d(a, 2, 2, divisor_override=0)
+
+
+def test_matrix_rank_batched_and_rotate_expand():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.transforms import functional as TF
+
+    A = np.stack([np.diag([5.0, 3.0, 0.0]), np.eye(3)]).astype(np.float32)
+    np.testing.assert_array_equal(
+        paddle.linalg.matrix_rank(paddle.to_tensor(A),
+                                  hermitian=True).numpy(), [2, 3])
+
+    img = np.ones((10, 20, 3), np.uint8) * 200
+    assert TF.rotate(img, 90, expand=True).shape == (20, 10, 3)
+    assert TF.rotate(img, 90, expand=False).shape == (10, 20, 3)
+    # nearest vs bilinear resize actually differ
+    grad_img = np.tile(np.arange(20, dtype=np.uint8)[None, :, None] * 12,
+                       (10, 1, 3))
+    near = TF.resize(grad_img, (5, 10), interpolation='nearest')
+    bil = TF.resize(grad_img, (5, 10), interpolation='bilinear')
+    assert not np.array_equal(near, bil)

@@ -69,3 +69,34 @@ def test_ppyolov2_through_predictor(tmp_path):
     # predictor output matches the eager forward
     eb, _ = model(paddle.to_tensor(x))
     np.testing.assert_allclose(boxes, eb.numpy(), rtol=2e-4, atol=2e-4)
+
+
+def test_yolo_loss_ignore_thresh_and_scale():
+    """ignore_thresh masks high-IoU negatives out of the objectness loss
+    (loss must be <= the fully-counted ignore_thresh=1.01 variant), and
+    gt_score weights positive terms."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import yolo_loss
+
+    rng = np.random.RandomState(0)
+    n, na, cls, h = 2, 3, 4, 5
+    x = paddle.to_tensor(rng.randn(n, na * (5 + cls), h, h)
+                         .astype(np.float32))
+    gtb = paddle.to_tensor(
+        np.asarray([[[0.5, 0.5, 0.3, 0.4]], [[0.3, 0.6, 0.2, 0.2]]],
+                   np.float32))
+    gtl = paddle.to_tensor(np.zeros((n, 1), np.int64))
+    anchors = [10, 13, 16, 30, 33, 23]
+    kw = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=cls,
+              downsample_ratio=32)
+    full = float(yolo_loss(x, gtb, gtl, ignore_thresh=1.01, **kw)
+                 .numpy().sum())
+    lenient = float(yolo_loss(x, gtb, gtl, ignore_thresh=0.0, **kw)
+                    .numpy().sum())
+    assert lenient < full  # thresh 0 drops every negative's obj term
+
+    half = paddle.to_tensor(np.full((n, 1), 0.5, np.float32))
+    weighted = float(yolo_loss(x, gtb, gtl, ignore_thresh=1.01,
+                               gt_score=half, **kw).numpy().sum())
+    assert weighted < full

@@ -206,6 +206,9 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         suppressed |= over
         suppressed[i] = True
     keep = np.asarray(keep, dtype=np.int64)
+    if categories is not None and category_idxs is not None:
+        # reference: `categories` lists the class ids eligible for output
+        keep = keep[np.isin(cats[keep], np.asarray(categories))]
     if top_k is not None:
         keep = keep[:top_k]
     return wrap_out(jnp.asarray(keep))

@@ -218,3 +218,19 @@ def test_matrix_nms_matches_reference(use_gaussian):
         np.testing.assert_allclose(row[2:], [x1, y1, x2, y2], rtol=1e-5)
     # padded rows carry label -1
     assert np.all(out[n:, 0] == -1)
+
+
+def test_nms_categories_filter():
+    """`categories` restricts which class ids may appear in the kept set
+    (reference vision/ops.py nms contract)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import nms
+
+    boxes = paddle.to_tensor(np.asarray(
+        [[0, 0, 10, 10], [20, 20, 30, 30], [40, 40, 50, 50]], np.float32))
+    scores = paddle.to_tensor(np.asarray([0.9, 0.8, 0.7], np.float32))
+    cats = paddle.to_tensor(np.asarray([0, 1, 2], np.int64))
+    keep = nms(boxes, 0.5, scores=scores, category_idxs=cats,
+               categories=[0, 2]).numpy()
+    assert set(keep.tolist()) == {0, 2}

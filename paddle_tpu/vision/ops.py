@@ -206,7 +206,10 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         suppressed |= over
         suppressed[i] = True
     keep = np.asarray(keep, dtype=np.int64)
-    if categories is not None and category_idxs is not None:
+    if categories is not None:
+        if category_idxs is None:
+            raise ValueError('nms: `categories` requires `category_idxs` '
+                             '(per-box class ids)')
         # reference: `categories` lists the class ids eligible for output
         keep = keep[np.isin(cats[keep], np.asarray(categories))]
     if top_k is not None:

@@ -234,3 +234,15 @@ def test_nms_categories_filter():
     keep = nms(boxes, 0.5, scores=scores, category_idxs=cats,
                categories=[0, 2]).numpy()
     assert set(keep.tolist()) == {0, 2}
+
+
+def test_nms_categories_requires_idxs():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import nms
+
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 1, 1]], np.float32))
+    with pytest.raises(ValueError, match='category_idxs'):
+        nms(boxes, 0.5, scores=paddle.to_tensor(
+            np.asarray([0.5], np.float32)), categories=[0])

@@ -21,11 +21,12 @@ from .. import nn
 from ..framework.core import Tensor, run_op
 from ..nn import functional as F
 
-__all__ = ['SwitchMoE']
+__all__ = ['SwitchMoE', 'GShardMoE']
 
 
 class SwitchMoE(nn.Layer):
-    """Top-1 routed MoE FFN block: y = combine(expert_ffn(dispatch(x))).
+    """Top-k routed MoE FFN block: y = combine(expert_ffn(dispatch(x))).
+    top_k=1 is Switch; top_k=2 is the GShard configuration (see GShardMoE).
 
     hidden_size -> ffn_size -> hidden_size per expert; num_experts experts
     sharded over the 'ep' mesh axis when present (placement hints consumed
@@ -33,8 +34,13 @@ class SwitchMoE(nn.Layer):
     """
 
     def __init__(self, hidden_size, ffn_size=None, num_experts=4,
-                 capacity_factor=1.5, aux_loss_weight=0.01, name=None):
+                 capacity_factor=1.5, aux_loss_weight=0.01, top_k=1,
+                 name=None):
         super().__init__()
+        if int(top_k) != top_k or not 1 <= int(top_k) <= num_experts:
+            raise ValueError('top_k must be an integer in '
+                             '[1, num_experts], got %r' % (top_k,))
+        self.top_k = int(top_k)
         self.hidden_size = hidden_size
         self.ffn_size = ffn_size or 4 * hidden_size
         self.num_experts = num_experts
@@ -71,16 +77,34 @@ class SwitchMoE(nn.Layer):
             cap = max(1, int(self.capacity_factor * t / e))
 
             probs = jax.nn.softmax(gl.astype(jnp.float32), axis=-1)
-            top_p = jnp.max(probs, axis=-1)           # [T]
-            top_e = jnp.argmax(probs, axis=-1)        # [T]
+            K = self.top_k
+            topv, topi = jax.lax.top_k(probs, K)      # [T, K]
+            if K > 1:
+                # GShard-style renormalized gates over the chosen experts
+                gates = topv / jnp.maximum(topv.sum(-1, keepdims=True),
+                                           1e-9)
+            else:
+                gates = topv  # Switch keeps the raw top-1 probability
 
-            onehot = jax.nn.one_hot(top_e, e, dtype=jnp.float32)  # [T,E]
-            pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # [T,E]
-            in_cap = (pos < cap) & (pos >= 0)
-            pos_cl = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
-            cap_oh = jax.nn.one_hot(pos_cl, cap, dtype=jnp.float32)
-            dispatch = cap_oh * in_cap[..., None]     # [T, E, C]
-            combine = dispatch * top_p[:, None, None]
+            onehot = None  # top-1 assignment, captured in the k=0 round
+            dispatch = jnp.zeros((t, e, cap), jnp.float32)
+            combine = jnp.zeros((t, e, cap), jnp.float32)
+            counts = jnp.zeros((e,), jnp.float32)
+            for k in range(K):
+                oh_k = jax.nn.one_hot(topi[:, k], e, dtype=jnp.float32)
+                if k == 0:
+                    onehot = oh_k
+                # capacity slots fill top-1 assignments first, then
+                # top-2, ... (GShard priority order)
+                pos = ((jnp.cumsum(oh_k, axis=0) - 1.0 + counts[None])
+                       * oh_k - (1.0 - oh_k))
+                in_cap = (pos < cap) & (pos >= 0)
+                pos_cl = jnp.clip(pos, 0, cap - 1).astype(jnp.int32)
+                cap_oh = jax.nn.one_hot(pos_cl, cap, dtype=jnp.float32)
+                d_k = cap_oh * in_cap[..., None]      # [T, E, C]
+                dispatch = dispatch + d_k
+                combine = combine + d_k * gates[:, k][:, None, None]
+                counts = counts + oh_k.sum(0)
 
             xin = jnp.einsum('tec,th->ech', dispatch,
                              xt.astype(jnp.float32))
@@ -102,3 +126,14 @@ class SwitchMoE(nn.Layer):
                         self.w1, self.b1, self.w2, self.b2)
         self.aux_loss = aux
         return y
+
+
+class GShardMoE(SwitchMoE):
+    """Top-2 routed MoE (GShard configuration): renormalized two-expert
+    gates, capacity filled in top-1-first priority order."""
+
+    def __init__(self, hidden_size, ffn_size=None, num_experts=4,
+                 capacity_factor=2.0, aux_loss_weight=0.01, name=None):
+        super().__init__(hidden_size, ffn_size, num_experts,
+                         capacity_factor, aux_loss_weight, top_k=2,
+                         name=name)

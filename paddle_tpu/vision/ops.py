@@ -188,6 +188,9 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
     """Host NMS (operators/detection/nms_op parity; dynamic output shape
     keeps this off-device, same as deployment practice)."""
+    if categories is not None and category_idxs is None:
+        raise ValueError('nms: `categories` requires `category_idxs` '
+                         '(per-box class ids)')
     b = ensure_tensor(boxes).numpy()
     s = ensure_tensor(scores).numpy() if scores is not None else None
     order = np.argsort(-s) if s is not None else np.arange(len(b))
@@ -207,9 +210,6 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         suppressed[i] = True
     keep = np.asarray(keep, dtype=np.int64)
     if categories is not None:
-        if category_idxs is None:
-            raise ValueError('nms: `categories` requires `category_idxs` '
-                             '(per-box class ids)')
         # reference: `categories` lists the class ids eligible for output
         keep = keep[np.isin(cats[keep], np.asarray(categories))]
     if top_k is not None:

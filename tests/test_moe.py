@@ -102,3 +102,67 @@ def test_moe_matches_dense_when_single_expert():
     y = moe(paddle.to_tensor(x)).numpy()
     assert y.shape == (2, 5, 8)
     assert np.all(np.isfinite(y))
+
+
+def test_gshard_top2_matches_dense_reference():
+    """Top-2 routing with ample capacity equals the dense two-expert
+    mixture: y = sum_k gate_k * FFN_{e_k}(x), gates renormalized over
+    the chosen pair."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.moe import GShardMoE
+
+    paddle.seed(0)
+    h, e, t = 8, 4, 10
+    moe = GShardMoE(h, ffn_size=16, num_experts=e, capacity_factor=4.0)
+    x_np = np.random.RandomState(0).randn(1, t, h).astype(np.float32)
+    y = moe(paddle.to_tensor(x_np)).numpy()[0]
+
+    # dense reference
+    gl = (x_np[0] @ moe.gate.weight.numpy() + moe.gate.bias.numpy())
+    probs = np.exp(gl) / np.exp(gl).sum(-1, keepdims=True)
+    w1, b1 = moe.w1.numpy(), moe.b1.numpy()
+    w2, b2 = moe.w2.numpy(), moe.b2.numpy()
+
+    def ffn(tok, ei):
+        h1 = np.asarray(jax.nn.gelu(tok @ w1[ei] + b1[ei]))
+        return h1 @ w2[ei] + b2[ei]
+
+    want = np.zeros_like(y)
+    for i in range(t):
+        top2 = np.argsort(-probs[i])[:2]
+        g = probs[i][top2]
+        g = g / g.sum()
+        want[i] = g[0] * ffn(x_np[0, i], top2[0]) + \
+            g[1] * ffn(x_np[0, i], top2[1])
+    np.testing.assert_allclose(y, want, rtol=2e-4, atol=2e-5)
+    assert moe.aux_loss is not None
+
+
+def test_top2_capacity_overflow_drops_second_choice_first():
+    """With capacity 1 per expert, top-1 assignments win the slots; an
+    overflowing token's contribution is partially dropped (its kept
+    gates sum to < 1)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.moe import SwitchMoE
+
+    paddle.seed(1)
+    h, e, t = 4, 2, 6
+    moe = SwitchMoE(h, ffn_size=8, num_experts=e, top_k=2,
+                    capacity_factor=0.34)  # cap = 1 slot per expert
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(1, t, h).astype(np.float32))
+    y = moe(x)
+    assert np.isfinite(y.numpy()).all()
+    # overflow is real: with 2 slots total for 6 tokens x 2 choices,
+    # some token's kept gate mass must fall below ~1, so its output
+    # norm shrinks vs the ample-capacity run
+    moe_ample = SwitchMoE(h, ffn_size=8, num_experts=e, top_k=2,
+                          capacity_factor=8.0)
+    moe_ample.set_state_dict(moe.state_dict())
+    y_full = moe_ample(x).numpy()
+    norms = np.linalg.norm(y.numpy()[0], axis=-1)
+    norms_full = np.linalg.norm(y_full[0], axis=-1)
+    assert (norms < norms_full - 1e-6).any()

@@ -249,6 +249,19 @@ class Layer:
     def set_state_dict(self, state_dict, use_structured_name=True):
         missing, unexpected = [], []
         own = self.state_dict()
+        if not use_structured_name:
+            # reference semantics: checkpoint keys are the parameters'
+            # own .name attributes instead of structured paths
+            remapped = collections.OrderedDict()
+            for key, t in own.items():
+                nm = getattr(t, 'name', None) or key
+                if nm in remapped:
+                    raise ValueError(
+                        'set_state_dict(use_structured_name=False): '
+                        'duplicate parameter name %r — names must be '
+                        'unique to load by name' % nm)
+                remapped[nm] = t
+            own = remapped
         for key, target in own.items():
             if key in state_dict:
                 v = state_dict[key]

@@ -31,6 +31,13 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
 
     def fn(a):
         n, c, h, w = a.shape
+        ioup = None
+        if iou_aware:
+            # reference yolo_box_op iou_aware layout: the first `na`
+            # channels are per-anchor IoU predictions, the rest is the
+            # standard head
+            ioup = jax.nn.sigmoid(a[:, :na].reshape(n, na, h, w))
+            a = a[:, na:]
         a = a.reshape(n, na, -1, h, w)
         grid_x = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
         grid_y = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
@@ -43,6 +50,10 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
         bh = jnp.exp(a[:, :, 3]) * anchors_arr[:, 1].reshape(1, na, 1, 1) / \
             (h * downsample_ratio)
         conf = jax.nn.sigmoid(a[:, :, 4])
+        if ioup is not None:
+            # PP-YOLO IoU-aware confidence: obj^(1-f) * iou^f
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                ioup ** iou_aware_factor
         probs = jax.nn.sigmoid(a[:, :, 5:5 + class_num])
         scores = conf[:, :, None] * probs
         img_h = imgs[:, 0].reshape(n, 1, 1, 1).astype(jnp.float32)
@@ -239,8 +250,19 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         y1 = rois[:, 3] * spatial_scale - offset
         rw = jnp.maximum(x1 - x0, 1e-3)
         rh = jnp.maximum(y1 - y0, 1e-3)
-        ys = y0[:, None] + (jnp.arange(ph) + 0.5) / ph * rh[:, None]
-        xs = x0[:, None] + (jnp.arange(pw) + 0.5) / pw * rw[:, None]
+        # sampling_ratio (reference roi_align_op.h): s^2 sample points
+        # per bin, averaged; <=0 means one centered sample per bin
+        # (the adaptive ceil(roi/bin) count is roi-dependent and thus
+        # shape-dynamic — the fixed-grid approximation keeps this
+        # jittable, biasing only very large rois)
+        s = max(1, int(sampling_ratio)) if sampling_ratio and \
+            sampling_ratio > 0 else 1
+        grid_h = (jnp.arange(ph)[:, None] +
+                  (jnp.arange(s) + 0.5)[None, :] / s).reshape(-1) / ph
+        grid_w = (jnp.arange(pw)[:, None] +
+                  (jnp.arange(s) + 0.5)[None, :] / s).reshape(-1) / pw
+        ys = y0[:, None] + grid_h[None, :] * rh[:, None]   # [R, ph*s]
+        xs = x0[:, None] + grid_w[None, :] * rw[:, None]   # [R, pw*s]
 
         def bilinear(fmap, yy, xx):
             y0i = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
@@ -258,10 +280,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
         def per_roi(bi, ys_r, xs_r):
             fmap = feat[bi]
-            yy = jnp.repeat(ys_r, pw)
-            xx = jnp.tile(xs_r, ph)
-            vals = bilinear(fmap, yy, xx)  # [C, ph*pw]
-            return vals.reshape(c, ph, pw)
+            yy = jnp.repeat(ys_r, pw * s)          # [ph*s * pw*s]
+            xx = jnp.tile(xs_r, ph * s)
+            vals = bilinear(fmap, yy, xx)          # [C, ph*s*pw*s]
+            vals = vals.reshape(c, ph, s, pw, s)
+            return vals.mean(axis=(2, 4))          # average the s^2 samples
         out = jax.vmap(per_roi)(batch_idx, ys, xs)
         return out
     return run_op('roi_align', fn, x)

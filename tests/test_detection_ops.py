@@ -246,3 +246,50 @@ def test_nms_categories_requires_idxs():
     with pytest.raises(ValueError, match='category_idxs'):
         nms(boxes, 0.5, scores=paddle.to_tensor(
             np.asarray([0.5], np.float32)), categories=[0])
+
+
+def test_roi_align_sampling_ratio_and_yolo_box_iou_aware():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import roi_align, yolo_box
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 2, 8, 8).astype(np.float32))
+    boxes = paddle.to_tensor(np.asarray([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    num = paddle.to_tensor(np.asarray([1], np.int32))
+    o1 = roi_align(x, boxes, num, 2, sampling_ratio=1).numpy()
+    o2 = roi_align(x, boxes, num, 2, sampling_ratio=4).numpy()
+    assert o1.shape == o2.shape == (1, 2, 2, 2)
+    assert not np.allclose(o1, o2)  # denser sampling changes the average
+    # averaging many samples approaches the analytic bin mean: compare
+    # s=4 and s=8 are closer together than s=1 and s=8
+    o3 = roi_align(x, boxes, num, 2, sampling_ratio=8).numpy()
+    assert np.abs(o2 - o3).mean() < np.abs(o1 - o3).mean()
+
+    na, cls, h = 2, 3, 4
+    head = np.random.RandomState(1).randn(
+        1, na * (5 + cls) + na, h, h).astype(np.float32)
+    img_size = paddle.to_tensor(np.asarray([[64, 64]], np.int32))
+    kw = dict(anchors=[10, 13, 16, 30], class_num=cls, conf_thresh=0.0,
+              downsample_ratio=16)
+    b_plain, s_plain = yolo_box(paddle.to_tensor(head[:, na:]), img_size,
+                                **kw)
+    b_iou, s_iou = yolo_box(paddle.to_tensor(head), img_size,
+                            iou_aware=True, iou_aware_factor=0.5, **kw)
+    np.testing.assert_allclose(b_iou.numpy(), b_plain.numpy(), rtol=1e-5)
+    assert not np.allclose(s_iou.numpy(), s_plain.numpy())
+
+
+def test_set_state_dict_unstructured_names():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    src = nn.Linear(3, 2)
+    dst = nn.Linear(3, 2)
+    ckpt = {getattr(p, 'name', None) or k: p
+            for k, p in src.state_dict().items()}
+    missing, unexpected = dst.set_state_dict(ckpt,
+                                             use_structured_name=False)
+    assert not missing, missing
+    np.testing.assert_allclose(dst.weight.numpy(), src.weight.numpy())

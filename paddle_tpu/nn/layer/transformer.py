@@ -56,13 +56,20 @@ class MultiHeadAttention(Layer):
         key = query if key is None else key
         value = query if value is None else value
         q = self._shape(self.q_proj(query))
-        k = self._shape(self.k_proj(key))
-        v = self._shape(self.v_proj(value))
 
-        if cache is not None:
-            k = M.concat([cache.k, k], axis=1)
-            v = M.concat([cache.v, v], axis=1)
-            new_cache = _MHACache(k, v)
+        if isinstance(cache, _MHAStaticCache):
+            # precomputed cross-attention k/v (projected ONCE in
+            # gen_cache — the point of StaticCache is skipping the
+            # per-step memory projections entirely)
+            k, v = cache.k, cache.v
+            new_cache = cache
+        else:
+            k = self._shape(self.k_proj(key))
+            v = self._shape(self.v_proj(value))
+            if cache is not None:
+                k = M.concat([cache.k, k], axis=1)
+                v = M.concat([cache.v, v], axis=1)
+                new_cache = _MHACache(k, v)
 
         mask = _convert_attention_mask(attn_mask, 'float32')
         out = F.scaled_dot_product_attention(
@@ -76,6 +83,17 @@ class MultiHeadAttention(Layer):
         return out
 
     def gen_cache(self, key, value=None, type=None):
+        if type is _MHAStaticCache:
+            # reference StaticCache: project the (fixed) memory ONCE;
+            # every decode step reuses these k/v
+            v_src = value if value is not None else key
+            return _MHAStaticCache(self._shape(self.k_proj(key)),
+                                   self._shape(self.v_proj(v_src)))
+        if value is not None:
+            # reference Cache(key, value): pre-seeded GROWING cache —
+            # key/value are existing [B, L, H, D] k/v states, appended
+            # to as decoding proceeds (UniLM-style prefix)
+            return _MHACache(key, value)
         b = key.shape[0]
         k = Tensor(jnp.zeros((b, 0, self.num_heads, self.head_dim)))
         v = Tensor(jnp.zeros((b, 0, self.num_heads, self.head_dim)))
@@ -87,7 +105,13 @@ class _MHACache:
         self.k, self.v = k, v
 
 
+class _MHAStaticCache:
+    def __init__(self, k, v):
+        self.k, self.v = k, v
+
+
 MultiHeadAttention.Cache = _MHACache
+MultiHeadAttention.StaticCache = _MHAStaticCache
 
 
 class TransformerEncoderLayer(Layer):
@@ -202,7 +226,11 @@ class TransformerDecoderLayer(Layer):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        if cache is not None and len(cache) > 1:
+            tgt, _ = self.cross_attn(tgt, memory, memory, memory_mask,
+                                     cache[1])
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
         tgt = residual + self.dropout2(tgt)
         if not self.normalize_before:
             tgt = self.norm2(tgt)
@@ -214,10 +242,17 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
-        return tgt if cache is None else (tgt, (sa_cache,))
+        if cache is None:
+            return tgt
+        new_cache = (sa_cache,) + tuple(cache[1:])
+        return tgt, new_cache
 
     def gen_cache(self, memory):
-        return (self.self_attn.gen_cache(memory),)
+        # (growing self-attn cache, static cross-attn cache) — the
+        # reference returns the same pair
+        return (self.self_attn.gen_cache(memory),
+                self.cross_attn.gen_cache(memory, memory,
+                                          type=_MHAStaticCache))
 
 
 class TransformerDecoder(Layer):

@@ -470,3 +470,33 @@ def test_matrix_rank_batched_and_rotate_expand():
     near = TF.resize(grad_img, (5, 10), interpolation='nearest')
     bil = TF.resize(grad_img, (5, 10), interpolation='bilinear')
     assert not np.array_equal(near, bil)
+
+
+def test_transformer_decoder_incremental_cache_matches_full():
+    """Step-by-step decoding with gen_cache (growing self-attn cache +
+    static cross-attn cache) must equal the full-sequence forward
+    (reference StaticCache/Cache semantics)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    d, heads, tl, ml = 16, 4, 5, 7
+    layer = nn.TransformerDecoderLayer(d, heads, 32, dropout=0.0)
+    dec = nn.TransformerDecoder(layer, 2)
+    dec.eval()
+    mem = paddle.to_tensor(np.random.RandomState(0)
+                           .randn(2, ml, d).astype(np.float32))
+    tgt = paddle.to_tensor(np.random.RandomState(1)
+                           .randn(2, tl, d).astype(np.float32))
+    causal = np.triu(np.full((tl, tl), -1e9, np.float32), 1)
+    full = dec(tgt, mem, tgt_mask=paddle.to_tensor(causal)).numpy()
+
+    cache = dec.gen_cache(mem)
+    outs = []
+    for t in range(tl):
+        step_in = paddle.to_tensor(tgt.numpy()[:, t:t + 1])
+        out, cache = dec(step_in, mem, cache=cache)
+        outs.append(out.numpy())
+    inc = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(inc, full, rtol=1e-4, atol=1e-5)

@@ -6,7 +6,54 @@ from . import callbacks  # noqa: F401
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
-    """Model.summary (reference: hapi/model_summary.py)."""
+    """Model.summary (reference: hapi/model_summary.py). With input_size
+    (or a concrete `input`), a probe forward records each sublayer's
+    output shape, like the reference's hook-based summary."""
+    out_shapes = {}
+    if input_size is not None or input is not None:
+        from ..framework.core import Tensor
+        import jax.numpy as jnp
+
+        if input is None:
+            sizes = input_size if isinstance(input_size, (list, tuple)) \
+                and input_size and isinstance(input_size[0], (list, tuple)) \
+                else [tuple(input_size)]
+            if isinstance(dtypes, (list, tuple)):
+                dts = list(dtypes) + ['float32'] * (len(sizes) - len(dtypes))
+            else:
+                dts = [dtypes or 'float32'] * len(sizes)
+
+            def _dim(d):
+                # reference _check_shape: None / -1 batch dims become 1
+                return 1 if d is None or int(d) < 0 else int(d)
+            probes = [Tensor(jnp.zeros(tuple(_dim(d) for d in s),
+                                       jnp.dtype(dt)))
+                      for s, dt in zip(sizes, dts)]
+        else:
+            probes = input if isinstance(input, (list, tuple)) else [input]
+
+        removers = []
+        for name, layer in net.named_sublayers(include_self=True):
+            def hook(lyr, ins, out, _name=name):
+                o = out[0] if isinstance(out, (list, tuple)) and out else out
+                shape = getattr(o, 'shape', None)
+                if shape is not None:
+                    out_shapes[_name] = list(shape)
+                return None
+            removers.append(layer.register_forward_post_hook(hook))
+        # snapshot PER-LAYER modes: net.train() would flatten a frozen
+        # submodule's eval state
+        modes = [(layer, layer.training)
+                 for _, layer in net.named_sublayers(include_self=True)]
+        try:
+            net.eval()
+            net(*probes)
+        finally:
+            for layer, was in modes:
+                layer.training = was
+            for r in removers:
+                r.remove()
+
     rows = []
     total_params = 0
     trainable = 0
@@ -19,22 +66,25 @@ def summary(net, input_size=None, dtypes=None, input=None):
             n_params += n
         if layer is not net:
             rows.append((name or layer.__class__.__name__,
-                         layer.__class__.__name__, n_params))
+                         layer.__class__.__name__,
+                         str(out_shapes.get(name, '-')), n_params))
     for _, p in net.named_parameters():
         n = int(np.prod(p.shape)) if p.shape else 1
         total_params += n
         if not p.stop_gradient:
             trainable += n
-    lines = ['-' * 64,
-             '%-30s %-20s %10s' % ('Layer (type)', 'Type', 'Param #'),
-             '=' * 64]
-    for name, typ, n in rows:
-        lines.append('%-30s %-20s %10d' % (name[:30], typ[:20], n))
-    lines += ['=' * 64,
+    lines = ['-' * 80,
+             '%-26s %-18s %-20s %10s' % ('Layer (type)', 'Type',
+                                         'Output Shape', 'Param #'),
+             '=' * 80]
+    for name, typ, shape, n in rows:
+        lines.append('%-26s %-18s %-20s %10d' % (name[:26], typ[:18],
+                                                 shape[:20], n))
+    lines += ['=' * 80,
               'Total params: {:,}'.format(total_params),
               'Trainable params: {:,}'.format(trainable),
               'Non-trainable params: {:,}'.format(total_params - trainable),
-              '-' * 64]
+              '-' * 80]
     print('\n'.join(lines))
     return {'total_params': total_params, 'trainable_params': trainable}
 

@@ -241,3 +241,24 @@ def test_load_reference_format_pdparams(tmp_path):
     dst.set_state_dict(paddle.load(path))
     np.testing.assert_allclose(dst(x).numpy(), src(x).numpy(), rtol=1e-6)
     assert not np.allclose(before, src(x).numpy())
+
+
+def test_summary_records_output_shapes(capsys):
+    import paddle_tpu as paddle
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    info = paddle.summary(net, (2, 8))
+    out = capsys.readouterr().out
+    assert '[2, 16]' in out and '[2, 4]' in out
+    assert info['total_params'] == 8 * 16 + 16 + 16 * 4 + 4
+    # no probe: still works, shapes dashed
+    info2 = paddle.summary(net)
+    assert info2 == info
+    # dynamic batch dims map to 1 (reference _check_shape)
+    paddle.summary(net, (None, 8))
+    paddle.summary(net, (-1, 8))
+    # per-layer eval state survives the probe
+    net[1].eval()
+    net.training = True
+    paddle.summary(net, (2, 8))
+    assert net[1].training is False and net.training is True

@@ -21,19 +21,30 @@ def _backend_of(name):
     raise ValueError("unknown device %r" % name)
 
 
-def set_device(device):
-    """Select the current device, e.g. 'tpu', 'cpu', 'tpu:0'."""
+def resolve_device(device):
+    """Any paddle device spec -> a jax.Device: 'tpu:3'/'cpu'/'cuda',
+    a Place object, or a jax.Device passthrough."""
     if isinstance(device, jax.Device):
-        _STATE['device'] = device
         return device
-    name, _, idx = str(device).partition(':')
-    backend = _backend_of(name)
+    if isinstance(device, _Place):
+        backend = 'cpu' if isinstance(device, (CPUPlace, CUDAPinnedPlace)) \
+            else 'tpu'
+        idx = device.device_id
+    else:
+        name, _, idx_s = str(device).partition(':')
+        backend = _backend_of(name)
+        idx = int(idx_s) if idx_s else 0
     try:
         devs = jax.devices(backend)
     except RuntimeError:
         # graceful fallback (e.g. asking for tpu on a cpu-only host)
         devs = jax.devices()
-    dev = devs[int(idx)] if idx else devs[0]
+    return devs[idx]  # explicit out-of-range index raises, like set_device
+
+
+def set_device(device):
+    """Select the current device, e.g. 'tpu', 'cpu', 'tpu:0'."""
+    dev = resolve_device(device)
     _STATE['device'] = dev
     return dev
 

@@ -281,7 +281,21 @@ class Layer:
     def to(self, device=None, dtype=None, blocking=None):
         if dtype is not None:
             self._to_dtype(dtype)
+        if device is not None:
+            self._to_device(device)
         return self
+
+    def _to_device(self, device):
+        """Move params/buffers to a device spec ('cpu', 'tpu:3', a Place,
+        or a jax.Device — one resolver, shared with set_device). blocking
+        is irrelevant: device_put is async and ordered for us by XLA."""
+        import jax
+        from ...framework.device import resolve_device
+        dev = resolve_device(device)
+        for _, p in self.named_parameters():
+            p._data = jax.device_put(p._data, dev)
+        for _, b in self.named_buffers():
+            b._data = jax.device_put(b._data, dev)
 
     def _to_dtype(self, dtype):
         jd = dtype_mod.to_jax_dtype(dtype)

@@ -500,3 +500,24 @@ def test_transformer_decoder_incremental_cache_matches_full():
         outs.append(out.numpy())
     inc = np.concatenate(outs, axis=1)
     np.testing.assert_allclose(inc, full, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_to_device_and_dtype():
+    """Layer.to moves params by string, Place, or jax.Device (shared
+    resolver with set_device) and casts float dtypes."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+
+    net = paddle.nn.Linear(4, 3)
+    net.to(device='cpu')
+    assert list(net.weight._data.devices())[0].platform == 'cpu'
+    net.to(device=paddle.CPUPlace())
+    assert list(net.weight._data.devices())[0].platform == 'cpu'
+    # explicit index: cpu:1 exists under the 8-device test mesh
+    net.to(device='cpu:1')
+    assert list(net.weight._data.devices())[0].id == 1
+    net.to(dtype='bfloat16')
+    assert str(net.weight._data.dtype) == 'bfloat16'
+    out = net(paddle.to_tensor(np.zeros((2, 4), np.float32)))
+    assert tuple(out.shape) == (2, 3)

@@ -19,6 +19,10 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     offs = ensure_tensor(sparse_csr_offset)._data
     cols = ensure_tensor(sparse_csr_columns)._data
 
+    kpm = ensure_tensor(key_padding_mask)._data \
+        if key_padding_mask is not None else None
+    am = ensure_tensor(attn_mask)._data if attn_mask is not None else None
+
     def fn(qq, kk, vv):
         scale = 1.0 / math.sqrt(qq.shape[-1])
         s = jnp.einsum('bhqd,bhkd->bhqk', qq, kk) * scale
@@ -27,6 +31,12 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         row_ids = jnp.repeat(jnp.arange(N), jnp.diff(offs[0, 0]),
                              total_repeat_length=cols.shape[-1])
         mask = jnp.zeros((N, M), bool).at[row_ids, cols[0, 0]].set(True)
+        mask = jnp.broadcast_to(mask, (B, H, N, M))
+        if kpm is not None:
+            # reference contract: 0 marks a masked-out key position
+            mask = mask & (kpm != 0)[:, None, None, :]
+        if am is not None:
+            mask = mask & (am != 0)[None, None]
         s = jnp.where(mask, s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         p = jnp.where(mask, p, 0.0)

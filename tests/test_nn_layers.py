@@ -521,3 +521,41 @@ def test_layer_to_device_and_dtype():
     assert str(net.weight._data.dtype) == 'bfloat16'
     out = net(paddle.to_tensor(np.zeros((2, 4), np.float32)))
     assert tuple(out.shape) == (2, 3)
+
+
+def test_sparse_attention_masks():
+    """key_padding_mask / attn_mask restrict the CSR-allowed positions
+    (0 = masked, reference sparse_attention contract)."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    B, H, N, D = 1, 1, 4, 8
+    q = paddle.to_tensor(rng.randn(B, H, N, D).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(B, H, N, D).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(B, H, N, D).astype(np.float32))
+    # full CSR: every row attends every column
+    offs = paddle.to_tensor(np.broadcast_to(
+        np.arange(0, (N + 1) * N, N, dtype=np.int32), (B, H, N + 1)).copy())
+    cols = paddle.to_tensor(np.broadcast_to(
+        np.tile(np.arange(N, dtype=np.int32), N), (B, H, N * N)).copy())
+
+    base = F.sparse_attention(q, k, v, offs, cols).numpy()
+    # mask out the last key everywhere: result must equal dense attention
+    # computed over the first N-1 keys
+    kpm = paddle.to_tensor(np.asarray([[1, 1, 1, 0]], np.float32))
+    got = F.sparse_attention(q, k, v, offs, cols,
+                             key_padding_mask=kpm).numpy()
+    s = (q.numpy() @ np.swapaxes(k.numpy(), -1, -2)) / np.sqrt(D)
+    s = s[..., :N - 1]
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = p @ v.numpy()[..., :N - 1, :]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    assert not np.allclose(got, base)
+
+    am = paddle.to_tensor(np.tril(np.ones((N, N), np.float32)))
+    causal = F.sparse_attention(q, k, v, offs, cols, attn_mask=am).numpy()
+    assert not np.allclose(causal, base)
+    # first row attends only itself -> equals v[0]
+    np.testing.assert_allclose(causal[0, 0, 0], v.numpy()[0, 0, 0],
+                               rtol=1e-5)

@@ -182,15 +182,17 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     return run_op('yolo_loss', fn, x)
 
 
-def _iou_matrix(boxes):
+def _iou_matrix(boxes, offset=0.0):
+    # offset=1 reproduces the reference's legacy pixel-inclusive overlap
+    # (JaccardOverlap with normalized=false)
     x0, y0, x1, y1 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
-    area = (x1 - x0) * (y1 - y0)
+    area = (x1 - x0 + offset) * (y1 - y0 + offset)
     ix0 = np.maximum(x0[:, None], x0[None, :])
     iy0 = np.maximum(y0[:, None], y0[None, :])
     ix1 = np.minimum(x1[:, None], x1[None, :])
     iy1 = np.minimum(y1[:, None], y1[None, :])
-    iw = np.maximum(ix1 - ix0, 0)
-    ih = np.maximum(iy1 - iy0, 0)
+    iw = np.maximum(ix1 - ix0 + offset, 0)
+    ih = np.maximum(iy1 - iy0 + offset, 0)
     inter = iw * ih
     return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-9)
 
@@ -526,6 +528,8 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     d = ensure_tensor(bbox_deltas).numpy()
     a = ensure_tensor(anchors).numpy().reshape(-1, 4)
     v = ensure_tensor(variances).numpy().reshape(-1, 4)
+    imgs = ensure_tensor(img_size).numpy()
+    off = 1.0 if pixel_offset else 0.0
     n = s.shape[0]
     all_rois, all_scores, nums = [], [], []
     for b in range(n):
@@ -533,8 +537,8 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         db = d[b].transpose(1, 2, 0).reshape(-1, 4)
         order = np.argsort(-sb)[:pre_nms_top_n]
         sb, db, ab, vb = sb[order], db[order], a[order % len(a)], v[order % len(v)]
-        aw = ab[:, 2] - ab[:, 0]
-        ah = ab[:, 3] - ab[:, 1]
+        aw = ab[:, 2] - ab[:, 0] + off
+        ah = ab[:, 3] - ab[:, 1] + off
         acx = ab[:, 0] + aw / 2
         acy = ab[:, 1] + ah / 2
         cx = db[:, 0] * vb[:, 0] * aw + acx
@@ -543,19 +547,33 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         bh = np.exp(np.minimum(db[:, 3] * vb[:, 3], 10)) * ah
         boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2],
                          axis=-1)
-        keep_mask = (bw >= min_size) & (bh >= min_size)
+        # clip to the image (reference proposal_op: boxes never exceed
+        # [0, W-offset] x [0, H-offset])
+        img_h, img_w = float(imgs[b][0]), float(imgs[b][1])
+        boxes[:, 0] = np.clip(boxes[:, 0], 0, img_w - off)
+        boxes[:, 1] = np.clip(boxes[:, 1], 0, img_h - off)
+        boxes[:, 2] = np.clip(boxes[:, 2], 0, img_w - off)
+        boxes[:, 3] = np.clip(boxes[:, 3], 0, img_h - off)
+        bw_c = boxes[:, 2] - boxes[:, 0] + off
+        bh_c = boxes[:, 3] - boxes[:, 1] + off
+        eff_min = max(float(min_size), 1.0)  # reference FilterBoxes clamp
+        keep_mask = (bw_c >= eff_min) & (bh_c >= eff_min)
         boxes, sb = boxes[keep_mask], sb[keep_mask]
-        iou = _iou_matrix(boxes)
+        iou = _iou_matrix(boxes, offset=off)
         keep = []
         supp = np.zeros(len(boxes), bool)
+        adaptive = nms_thresh
         for i in range(len(boxes)):
             if supp[i]:
                 continue
             keep.append(i)
             if len(keep) >= post_nms_top_n:
                 break
-            supp |= iou[i] > nms_thresh
+            supp |= iou[i] > adaptive
             supp[i] = True
+            if eta < 1.0 and adaptive > 0.5:
+                # reference adaptive NMS: threshold decays by eta
+                adaptive *= eta
         all_rois.append(boxes[keep])
         all_scores.append(sb[keep])
         nums.append(len(keep))

@@ -293,3 +293,44 @@ def test_set_state_dict_unstructured_names():
                                              use_structured_name=False)
     assert not missing, missing
     np.testing.assert_allclose(dst.weight.numpy(), src.weight.numpy())
+
+
+def test_generate_proposals_clips_to_image():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import generate_proposals
+
+    rng = np.random.RandomState(0)
+    n, a_count, h, w = 1, 3, 4, 4
+    scores = paddle.to_tensor(rng.rand(n, a_count, h, w).astype(np.float32))
+    # large positive deltas push raw boxes far outside the image
+    deltas = paddle.to_tensor(
+        np.full((n, a_count * 4, h, w), 2.0, np.float32))
+    anchors = rng.rand(h, w, a_count, 4).astype(np.float32) * 8
+    anchors[..., 2:] += 16
+    variances = np.ones_like(anchors)
+    img = paddle.to_tensor(np.asarray([[20.0, 24.0]], np.float32))  # H, W
+    rois, roi_scores = generate_proposals(
+        scores, deltas, img, paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), min_size=0.0)
+    r = rois.numpy()
+    assert (r[:, 0] >= 0).all() and (r[:, 1] >= 0).all()
+    assert (r[:, 2] <= 24.0).all() and (r[:, 3] <= 20.0).all()
+
+    # pixel_offset tightens the clip bound to dim-1
+    rois_po, _ = generate_proposals(
+        scores, deltas, img, paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), min_size=0.0, pixel_offset=True)
+    rp = rois_po.numpy()
+    assert (rp[:, 2] <= 23.0).all() and (rp[:, 3] <= 19.0).all()
+
+    # eta < 1 decays the NMS threshold -> at most as many survivors
+    base_n = len(r)
+    rois_eta, _ = generate_proposals(
+        scores, deltas, img, paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), min_size=0.0, nms_thresh=0.9,
+        eta=0.5)
+    rois_90, _ = generate_proposals(
+        scores, deltas, img, paddle.to_tensor(anchors),
+        paddle.to_tensor(variances), min_size=0.0, nms_thresh=0.9)
+    assert len(rois_eta.numpy()) <= len(rois_90.numpy())

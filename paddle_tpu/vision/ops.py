@@ -504,8 +504,9 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
                              refer_scale, pixel_offset=False,
                              rois_num=None, name=None):
     rois = ensure_tensor(fpn_rois).numpy()
-    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0]) *
-                               (rois[:, 3] - rois[:, 1]), 0))
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0] + off) *
+                               (rois[:, 3] - rois[:, 1] + off), 0))
     lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
     outs, idxs = [], []
@@ -515,8 +516,17 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
         idxs.append(sel)
     order = np.concatenate(idxs) if idxs else np.zeros(0, np.int64)
     restore = np.argsort(order)
-    out_num = [wrap_out(jnp.asarray(np.asarray([len(i)], np.int32)))
-               for i in idxs]
+    if rois_num is not None:
+        # batched input: per-level outputs report PER-IMAGE counts
+        # (reference rois_num_per_level), images delimited by rois_num
+        rn = ensure_tensor(rois_num).numpy().astype(np.int64).reshape(-1)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+        out_num = [wrap_out(jnp.asarray(np.bincount(
+            img_of[i], minlength=len(rn)).astype(np.int32)))
+            for i in idxs]
+    else:
+        out_num = [wrap_out(jnp.asarray(np.asarray([len(i)], np.int32)))
+                   for i in idxs]
     return outs, wrap_out(jnp.asarray(restore.reshape(-1, 1))), out_num
 
 

@@ -334,3 +334,31 @@ def test_generate_proposals_clips_to_image():
         scores, deltas, img, paddle.to_tensor(anchors),
         paddle.to_tensor(variances), min_size=0.0, nms_thresh=0.9)
     assert len(rois_eta.numpy()) <= len(rois_90.numpy())
+
+
+def test_distribute_fpn_proposals_batched_counts_and_offset():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import distribute_fpn_proposals
+
+    # two images: img0 has a small + a large roi, img1 one small roi
+    rois = paddle.to_tensor(np.asarray(
+        [[0, 0, 20, 20], [0, 0, 200, 200], [0, 0, 16, 16]], np.float32))
+    rn = paddle.to_tensor(np.asarray([2, 1], np.int32))
+    outs, restore, per_level = distribute_fpn_proposals(
+        rois, 2, 5, 4, 224, rois_num=rn)
+    counts = [n.numpy() for n in per_level]
+    # per-image counts per level: each entry has len == n_images
+    assert all(len(c) == 2 for c in counts)
+    total = np.stack(counts).sum(0)
+    np.testing.assert_array_equal(total, [2, 1])
+    # restore index is a permutation of all rois
+    assert sorted(restore.numpy().reshape(-1).tolist()) == [0, 1, 2]
+
+    # pixel_offset shifts the level split for boxes near a threshold
+    edge = paddle.to_tensor(np.asarray([[0, 0, 111.5, 111.5]], np.float32))
+    a = distribute_fpn_proposals(edge, 2, 5, 4, 112)[0]
+    b = distribute_fpn_proposals(edge, 2, 5, 4, 112, pixel_offset=True)[0]
+    sizes_a = [len(t.numpy()) for t in a]
+    sizes_b = [len(t.numpy()) for t in b]
+    assert sizes_a != sizes_b

@@ -331,20 +331,31 @@ def box_coder(prior_box, prior_box_var, target_box, code_type='encode_center_siz
         pcx = (pb[:, 0] + pb[:, 2]) / 2
         pcy = (pb[:, 1] + pb[:, 3]) / 2
         if code_type == 'encode_center_size':
-            tw = t[:, 2] - t[:, 0] + norm
-            th = t[:, 3] - t[:, 1] + norm
-            tcx = (t[:, 0] + t[:, 2]) / 2
-            tcy = (t[:, 1] + t[:, 3]) / 2
-            ox = (tcx - pcx) / pw / pbv[..., 0]
-            oy = (tcy - pcy) / ph_ / pbv[..., 1]
-            ow = jnp.log(tw / pw) / pbv[..., 2]
-            oh = jnp.log(th / ph_) / pbv[..., 3]
+            # reference: every target row encodes against EVERY prior ->
+            # [N, M, 4] (the M priors ride dim 1)
+            tw = (t[:, 2] - t[:, 0] + norm)[:, None]
+            th = (t[:, 3] - t[:, 1] + norm)[:, None]
+            tcx = ((t[:, 0] + t[:, 2]) / 2)[:, None]
+            tcy = ((t[:, 1] + t[:, 3]) / 2)[:, None]
+            pbv_e = pbv if pbv.ndim == 2 else pbv[None]
+            ox = (tcx - pcx[None]) / pw[None] / pbv_e[..., 0]
+            oy = (tcy - pcy[None]) / ph_[None] / pbv_e[..., 1]
+            ow = jnp.log(tw / pw[None]) / pbv_e[..., 2]
+            oh = jnp.log(th / ph_[None]) / pbv_e[..., 3]
             return jnp.stack([ox, oy, ow, oh], axis=-1)
-        # decode
-        ox = t[..., 0] * pbv[..., 0] * pw + pcx
-        oy = t[..., 1] * pbv[..., 1] * ph_ + pcy
-        ow = jnp.exp(t[..., 2] * pbv[..., 2]) * pw
-        oh = jnp.exp(t[..., 3] * pbv[..., 3]) * ph_
+        # decode: `axis` names the dim of a [N, M, 4] target the priors
+        # BROADCAST ALONG (reference box_coder_op): axis=0 -> priors
+        # [M, 4] ride dim 1; axis=1 -> priors ride dim 0
+        if t.ndim == 3:
+            ex = (None, slice(None)) if axis == 0 else (slice(None), None)
+            pw_b, ph_b, pcx_b, pcy_b = pw[ex], ph_[ex], pcx[ex], pcy[ex]
+            pbv_b = pbv[ex + (slice(None),)] if pbv.ndim == 2 else pbv
+        else:
+            pw_b, ph_b, pcx_b, pcy_b, pbv_b = pw, ph_, pcx, pcy, pbv
+        ox = t[..., 0] * pbv_b[..., 0] * pw_b + pcx_b
+        oy = t[..., 1] * pbv_b[..., 1] * ph_b + pcy_b
+        ow = jnp.exp(t[..., 2] * pbv_b[..., 2]) * pw_b
+        oh = jnp.exp(t[..., 3] * pbv_b[..., 3]) * ph_b
         return jnp.stack([ox - ow / 2, oy - oh / 2,
                           ox + ow / 2 - norm, oy + oh / 2 - norm], axis=-1)
     return run_op('box_coder', fn, tb)
@@ -373,7 +384,13 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.,),
             sizes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
         if max_sizes:
             mx = max_sizes[ms_i]
-            sizes.insert(1, (np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            # reference order: [min&ars..., max] by default; the
+            # min_max_aspect_ratios_order flag moves max right after the
+            # ar=1 min box (Caffe order)
+            if min_max_aspect_ratios_order:
+                sizes.insert(1, (np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            else:
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
         for (bw, bh) in sizes:
             cy, cx = np.mgrid[0:h, 0:w].astype(np.float32)
             cx = (cx + offset) * step_w

@@ -362,3 +362,52 @@ def test_distribute_fpn_proposals_batched_counts_and_offset():
     sizes_a = [len(t.numpy()) for t in a]
     sizes_b = [len(t.numpy()) for t in b]
     assert sizes_a != sizes_b
+
+
+def test_box_coder_axis_and_prior_box_order():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import box_coder, prior_box
+
+    priors = np.asarray([[0, 0, 10, 10], [10, 10, 30, 30]], np.float32)
+    var = [1.0, 1.0, 1.0, 1.0]
+    # reference axis semantics: axis=0 with target [N, M, 4] means the
+    # M priors broadcast ALONG axis 0 (priors ride dim 1)
+    deltas = np.zeros((3, 2, 4), np.float32)
+    out0 = box_coder(paddle.to_tensor(priors), var,
+                     paddle.to_tensor(deltas),
+                     code_type='decode_center_size', axis=0).numpy()
+    for i in range(3):
+        np.testing.assert_allclose(out0[i], priors, rtol=1e-5)
+    # axis=1: priors ride dim 0 of a [M, N, 4] target
+    deltas1 = np.zeros((2, 3, 4), np.float32)
+    out1 = box_coder(paddle.to_tensor(priors), var,
+                     paddle.to_tensor(deltas1),
+                     code_type='decode_center_size', axis=1).numpy()
+    for j in range(3):
+        np.testing.assert_allclose(out1[:, j], priors, rtol=1e-5)
+
+    # encode: every target against every prior -> [N, M, 4]; zero offset
+    # exactly when the target IS that prior
+    targets = np.asarray([[0, 0, 10, 10], [10, 10, 30, 30],
+                          [5, 5, 15, 15]], np.float32)
+    enc = box_coder(paddle.to_tensor(priors), var,
+                    paddle.to_tensor(targets),
+                    code_type='encode_center_size').numpy()
+    assert enc.shape == (3, 2, 4)
+    np.testing.assert_allclose(enc[0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(enc[1, 1], 0.0, atol=1e-6)
+    assert np.abs(enc[2]).sum() > 0
+
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    kw = dict(min_sizes=[16.0], max_sizes=[32.0],
+              aspect_ratios=[1.0, 2.0])
+    b_def, _ = prior_box(feat, img, **kw)
+    b_mm, _ = prior_box(feat, img, min_max_aspect_ratios_order=True, **kw)
+    d, m = b_def.numpy().reshape(-1, 4), b_mm.numpy().reshape(-1, 4)
+    assert d.shape == m.shape
+    assert not np.allclose(d, m)          # ordering differs
+    # same box SET either way
+    np.testing.assert_allclose(np.sort(d, axis=0), np.sort(m, axis=0),
+                               rtol=1e-5)

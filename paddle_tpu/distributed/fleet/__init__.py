@@ -280,12 +280,9 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
                 % amp_dtype)
     sdict['amp_dtype'] = amp_dtype
 
-    if sp_state is not None and getattr(getattr(model, 'config', None),
-                                        'dropout', 0):
-        raise ValueError(
-            'sequence_parallel requires dropout=0 in the model config '
-            '(attention-prob dropout would need sp-aware RNG); got '
-            'dropout=%r' % model.config.dropout)
+    # (dropout composes with sp since r4: non-attention dropout partitions
+    # under GSPMD, attention-prob dropout rides sp-aware folded keys in
+    # distributed/sp.py sp_attention)
 
     # recompute -> per-block remat when the model declares segments
     # (enable_recompute), else whole-forward remat in the step. Always set

@@ -55,6 +55,11 @@ def _spawn_local(args, hosts, my_host):
             'FLAGS_selected_tpus': str(local),
             'TRAINING_ROLE': 'TRAINER',
         })
+        if os.environ.get('PADDLE_TRAINER_TRACE_DIR'):
+            # per-rank trace dirs; profiler.merge_traces builds the
+            # cluster timeline from them (CrossStackProfiler analog)
+            env['PADDLE_TRAINER_TRACE_DIR'] = os.path.join(
+                os.environ['PADDLE_TRAINER_TRACE_DIR'], 'rank_%d' % rank)
         log_f = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)

@@ -25,6 +25,7 @@ eval/generation calls between steps run the plain sequential forward.
 Backward is jax AD through scan+ppermute (GPipe: all microbatches forward,
 then reverse); combine with recompute for the activation-memory win.
 """
+import contextlib
 import functools
 
 import numpy as np
@@ -34,6 +35,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..framework import functional as func_mod
+from ..framework import random as rng_mod
 from ..framework.core import Tensor
 
 __all__ = ['PipelineEngine', 'make_pp_state', 'pp_scope', 'pipeline_state',
@@ -98,15 +100,37 @@ class pp_scope:
         return False
 
 
+def _null_ctx():
+    return contextlib.nullcontext()
+
+
+def _needs_rng(layer):
+    """True when a forward of `layer` will draw RNG (active dropout) —
+    the schedules then thread per-microbatch keys through their scan."""
+    from .. import nn as nn_mod
+    for l in layer.sublayers(include_self=True):
+        if not getattr(l, 'training', True):
+            continue
+        if isinstance(l, nn_mod.Dropout) and getattr(l, 'p', 0):
+            return True
+        dp = getattr(l, 'dropout', None)
+        if isinstance(dp, float) and dp > 0:
+            return True
+    return False
+
+
 def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
-                wire_dtype=None):
+                wire_dtype=None, base_key=None):
     """The schedule: n_micro + n_stages - 1 ticks; stage 0 ingests
     microbatch t, every stage applies its segment, ppermute rotates
     activations forward; the last stage's outputs are psum-broadcast so
     the (replicated-over-pp) loss/head code downstream sees all of them.
 
-    stage_apply(x_array, stage_id) -> y_array, like-shaped with x.
-    micro: [n_micro, mb, ...]; returns [n_micro, mb, ...].
+    stage_apply(x_array, stage_id, tick_key) -> y_array, like-shaped
+    with x. micro: [n_micro, mb, ...]; returns [n_micro, mb, ...].
+    base_key (or None): per-step PRNG key; each tick derives
+    fold_in(base_key, microbatch_index) so dropout masks differ per
+    microbatch (and per step, the base key being per-step).
     """
     stage = lax.axis_index(axis)
     n_ticks = n_micro + n_stages - 1
@@ -119,7 +143,13 @@ def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
     def tick(buf, t):
         idx = jnp.clip(t, 0, n_micro - 1)
         inject = jnp.where(stage == 0, micro[idx], buf).astype(dtype_like)
-        y = stage_apply(inject, stage)
+        tick_key = None
+        if base_key is not None:
+            # key by the microbatch THIS stage is processing (t - stage),
+            # so a microbatch keeps one mask set as it moves down the pipe
+            i_mb = jnp.clip(t - stage, 0, n_micro - 1)
+            tick_key = jax.random.fold_in(base_key, i_mb)
+        y = stage_apply(inject, stage, tick_key)
         nxt = lax.ppermute(y.astype(wire), axis,
                            [(i, (i + 1) % n_stages)
                             for i in range(n_stages)])
@@ -151,9 +181,12 @@ def pipeline_blocks(blocks, x, state):
     activations must be like-shaped (transformer residual stream).
     x: Tensor [B, ...]. Returns Tensor [B, ...].
 
-    Note: inside the stage lax.scan all layers of a stage share one
-    dropout key draw (the body traces once) — use dropout=0 under pp for
-    exact parity with the sequential forward.
+    Dropout: when the blocks contain active dropout, a per-step base key
+    is threaded through the schedule and folded with (microbatch, global
+    layer) indices, so masks differ per microbatch/layer/step (the
+    reference's parallel_layers/random.py capability). Masks do NOT
+    bit-match the sequential forward's stream — parity tests run in eval
+    mode or dropout=0.
     """
     st = state
     n_stages, n_micro, axis = st['n_stages'], st['n_micro'], st['axis']
@@ -181,45 +214,66 @@ def pipeline_blocks(blocks, x, state):
 
     remat = st['remat']
 
-    def apply_layer(xb, layer_params):
-        out, _ = func_mod.functional_call(
-            template, layer_params, {},
-            args=(Tensor(xb, stop_gradient=False),))
+    def apply_layer(xb, layer_params, layer_key=None):
+        ctx = (rng_mod.key_scope(layer_key) if layer_key is not None
+               else _null_ctx())
+        with ctx:
+            out, _ = func_mod.functional_call(
+                template, layer_params, {},
+                args=(Tensor(xb, stop_gradient=False),))
         return out
 
-    def stage_apply(xb, stage_id):
+    def stage_apply(xb, stage_id, tick_key):
         # params for THIS rank's stage arrive with the pp dim localized
-        def body(c, lp):
+        def body(c, xs):
             f = apply_layer
             if remat:
                 f = jax.checkpoint(apply_layer)
-            return f(c, lp), None
-        y, _ = lax.scan(body, xb, stage_apply.params)
+            if tick_key is None:
+                return f(c, xs), None
+            lp, lk = xs
+            return f(c, lp, lk), None
+        xs = stage_apply.params
+        if tick_key is not None:
+            # decorrelate by GLOBAL layer index: stage*per + local j
+            lkeys = jax.vmap(lambda j: jax.random.fold_in(
+                tick_key, stage_id * per + j))(jnp.arange(per))
+            xs = (xs, lkeys)
+        y, _ = lax.scan(body, xb, xs)
         return y
 
     x_arr = x._data if isinstance(x, Tensor) else x
     dtype_like = x_arr.dtype
     wire = jnp.float32 if _cpu_mesh(st['mesh']) else dtype_like
+    base_key = rng_mod.next_key() if _needs_rng(template) else None
 
-    def pp_body(stacked_local, micro):
+    def pp_body(stacked_local, micro, *key_in):
         local = {n: a[0] for n, a in stacked_local.items()}  # strip pp dim
         stage_apply.params = local
         return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
-                           dtype_like, wire)
+                           dtype_like, wire,
+                           base_key=key_in[0] if key_in else None)
 
-    in_specs = ({n: P(axis) for n in stacked}, P())
-    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=in_specs,
+    in_specs = [{n: P(axis) for n in stacked}, P()]
+    operands = [stacked]
+    if base_key is not None:
+        in_specs.append(P())
+    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=tuple(in_specs),
                        out_specs=P(), axis_names={axis}, check_vma=False)
     # the replicated micro operand crosses the boundary in the wire dtype:
     # its transpose is a psum over pp (f32 on CPU, see _cpu_mesh; the
     # stacked params are pp-sharded so their transpose needs no psum)
     micro = _split_micro(x_arr, n_micro).astype(wire)
-    out = fn(stacked, micro)
+    operands.append(micro)
+    if base_key is not None:
+        operands.append(base_key)
+    out = fn(*operands)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 
 
-def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
+def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None,
+                       rng_from=None):
     """GPipe over heterogeneous per-stage callables (PipelineLayer
     segments): lax.switch picks this rank's segment each tick. Segment
     boundaries must be like-shaped (switch/ppermute need one aval).
@@ -249,8 +303,14 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
 
     branches = [wrap(f) for f in stage_fns]
 
-    def stage_apply(xb, stage_id):
-        return lax.switch(stage_id, branches, xb)
+    def stage_apply(xb, stage_id, tick_key):
+        if tick_key is None:
+            return lax.switch(stage_id, branches, xb)
+        # every branch traces under the stage-folded key scope; only this
+        # rank's branch runs, and each branch's trace advances the scoped
+        # stream at a distinct position, decorrelating stages
+        with rng_mod.key_scope(jax.random.fold_in(tick_key, stage_id)):
+            return lax.switch(stage_id, branches, xb)
 
     x_arr = x._data if isinstance(x, Tensor) else x
     dtype_like = x_arr.dtype
@@ -263,23 +323,30 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
     pdtypes = {n: a.dtype for n, a in params.items()}
     boundary = ({n: a.astype(jnp.float32) for n, a in params.items()}
                 if cpu else params)
+    base_key = (rng_mod.next_key()
+                if rng_from is not None and _needs_rng(rng_from) else None)
 
-    def pp_body(params_in, micro):
+    def pp_body(params_in, micro, *key_in):
         if cpu:
             params_in = {n: a.astype(pdtypes[n])
                          for n, a in params_in.items()}
         restore = rebind(params_in) if rebind is not None else None
         try:
             return _gpipe_loop(stage_apply, micro, n_stages, n_micro,
-                               axis, dtype_like, wire)
+                               axis, dtype_like, wire,
+                               base_key=key_in[0] if key_in else None)
         finally:
             if restore is not None:
                 restore()
 
-    fn = jax.shard_map(pp_body, mesh=st['mesh'],
-                       in_specs=({n: P() for n in params}, P()),
+    in_specs = [{n: P() for n in params}, P()]
+    operands = [boundary, _split_micro(x_arr, n_micro).astype(wire)]
+    if base_key is not None:
+        in_specs.append(P())
+        operands.append(base_key)
+    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=tuple(in_specs),
                        out_specs=P(), axis_names={axis}, check_vma=False)
-    out = fn(boundary, _split_micro(x_arr, n_micro).astype(wire))
+    out = fn(*operands)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 

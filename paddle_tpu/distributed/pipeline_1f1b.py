@@ -36,28 +36,15 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..framework import functional as func_mod
+from ..framework import random as rng_mod
 from ..framework.core import Tensor
-from .pipeline import _cpu_mesh
+from .pipeline import _cpu_mesh, _needs_rng, _null_ctx
 
 __all__ = ['one_f_one_b_loss', 'supports_1f1b']
 
 
 def supports_1f1b(model):
     return hasattr(model, 'pp_decompose')
-
-
-def _check_no_dropout(model):
-    """The schedule's scan body traces once, so a dropout draw would bake
-    one mask for every tick/step (and the RNG key would be an outer
-    tracer crossing the Manual region). Refuse rather than silently
-    degrade training."""
-    from .. import nn
-    for layer in model.sublayers(include_self=True):
-        if isinstance(layer, nn.Dropout) and getattr(layer, 'p', 0):
-            raise NotImplementedError(
-                '1F1B pipeline does not support dropout yet (a scan-traced '
-                'mask would repeat every step) — set dropout=0 or use '
-                'schedule_mode="F-then-B"')
 
 
 def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
@@ -73,7 +60,13 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
     axis = state['axis']
     pp = state['n_stages']
     n_micro = state['n_micro']
-    _check_no_dropout(model)
+    # dropout under 1F1B: a per-step base key crosses the shard_map
+    # boundary and every mask key is a pure function of (base key,
+    # microbatch index, stage, layer) — so masks differ per microbatch
+    # and per step, and the backward's stage RECOMPUTE (jax.vjp of
+    # tick_fn at the backward tick) rederives bit-identical masks from
+    # the same indices. Reference capability: parallel_layers/random.py.
+    base_key = rng_mod.next_key() if _needs_rng(model) else None
     import inspect
     takes_loss = True
     try:
@@ -117,9 +110,13 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
     micro_ids = inputs.reshape((n_micro, mb) + inputs.shape[1:])
     micro_lbl = labels.reshape((n_micro, mb) + labels.shape[1:])
 
-    # probe shapes eagerly (abstract eval only) to size the rotating bufs
-    x_shape_dtype = jax.eval_shape(
-        lambda ids: _call_pre(model, pre_fn, params, ids), micro_ids[0])
+    # probe shapes eagerly (abstract eval only) to size the rotating bufs;
+    # the key scope keeps any dropout draw inside the probe from leaking
+    # an abstract tracer into the live generator
+    def _probe(ids):
+        with rng_mod.key_scope(jax.random.PRNGKey(0)):
+            return _call_pre(model, pre_fn, params, ids)
+    x_shape_dtype = jax.eval_shape(_probe, micro_ids[0])
 
     def stacked_of(pdict):
         out = {}
@@ -137,20 +134,24 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 out[fn2] = flat[li]
         return out
 
+    # the base key rides as an EXPLICIT custom_vjp argument (a closed-over
+    # tracer inside a custom_vjp body raises UnexpectedTracerError); its
+    # cotangent is float0 (integer-typed input)
     @jax.custom_vjp
-    def pp_loss(p):
-        loss, _ = _run(p)
+    def pp_loss(p, key_in):
+        loss, _ = _run(p, key_in)
         return loss
 
-    def _fwd(p):
-        return _run(p)
+    def _fwd(p, key_in):
+        return _run(p, key_in)
 
     def _bwd(grads, g):
-        return (jax.tree_util.tree_map(lambda a: a * g, grads),)
+        key_ct = np.zeros((2,), jax.dtypes.float0)
+        return (jax.tree_util.tree_map(lambda a: a * g, grads), key_ct)
 
     pp_loss.defvjp(_fwd, lambda res, g: _bwd(res, g))
 
-    def _run(p):
+    def _run(p, key_in):
         stacked = stacked_of(p)
         outer = {n: p[n] for n in outer_names}
         pdtypes = {n: a.dtype for n, a in outer.items()}
@@ -163,11 +164,12 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
 
         wire = jnp.float32 if cpu else jnp.dtype(x_shape_dtype.dtype)
 
-        def body(stacked_local, outer_p, ids_all, lbl_all):
+        def body(stacked_local, outer_p, ids_all, lbl_all, *key_in):
             if cpu:
                 outer_p = {n: a.astype(pdtypes[n])
                            for n, a in outer_p.items()}
             local = {n: a[0] for n, a in stacked_local.items()}
+            key_b = key_in[0] if key_in else None
             r = lax.axis_index(axis)
             last = pp - 1
             T = n_micro + 2 * (pp - 1)
@@ -185,25 +187,47 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 branch stay consistent)."""
                 ids_mb = ids_all[i_mb]
                 lbl_mb = lbl_all[i_mb]
-                x0 = lax.cond(
-                    r == 0,
-                    lambda xi: _call_pre(model, pre_fn, outer_params,
-                                         ids_mb).astype(x_dtype),
-                    lambda xi: xi,
-                    x_in.astype(x_dtype))
+                key_mb = (jax.random.fold_in(key_b, i_mb)
+                          if key_b is not None else None)
+                pre_ctx = (rng_mod.key_scope(jax.random.fold_in(key_mb, 0))
+                           if key_mb is not None else _null_ctx())
+                with pre_ctx:
+                    x0 = lax.cond(
+                        r == 0,
+                        lambda xi: _call_pre(model, pre_fn, outer_params,
+                                             ids_mb).astype(x_dtype),
+                        lambda xi: xi,
+                        x_in.astype(x_dtype))
 
-                def layer(c, lp):
-                    out, _ = func_mod.functional_call(
-                        template, lp, {},
-                        args=(Tensor(c, stop_gradient=False),))
+                def layer(c, xs):
+                    if key_mb is None:
+                        lp, ctx = xs, _null_ctx()
+                    else:
+                        lp, lk = xs
+                        ctx = rng_mod.key_scope(lk)
+                    with ctx:
+                        out, _ = func_mod.functional_call(
+                            template, lp, {},
+                            args=(Tensor(c, stop_gradient=False),))
                     return out, None
-                y, _ = lax.scan(layer, x0, local_blocks)
-                mb_loss = lax.cond(
-                    r == last,
-                    lambda yy: _call_post(model, post_fn, outer_params,
-                                          yy, lbl_mb).astype(jnp.float32),
-                    lambda yy: jnp.zeros((), jnp.float32),
-                    y)
+                xs = local_blocks
+                if key_mb is not None:
+                    # decorrelate by GLOBAL layer index r*per + j
+                    lkeys = jax.vmap(lambda j: jax.random.fold_in(
+                        key_mb, 1 + r * per + j))(jnp.arange(per))
+                    xs = (local_blocks, lkeys)
+                y, _ = lax.scan(layer, x0, xs)
+                post_ctx = (rng_mod.key_scope(
+                    jax.random.fold_in(key_mb, 99991))
+                    if key_mb is not None else _null_ctx())
+                with post_ctx:
+                    mb_loss = lax.cond(
+                        r == last,
+                        lambda yy: _call_post(model, post_fn, outer_params,
+                                              yy,
+                                              lbl_mb).astype(jnp.float32),
+                        lambda yy: jnp.zeros((), jnp.float32),
+                        y)
                 return y, mb_loss
 
             zero_outer = {n: jnp.zeros(a.shape, jnp.float32)
@@ -273,14 +297,18 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
             g_blocks = {n: a[None] for n, a in carry['g_blocks'].items()}
             return loss, g_outer, g_blocks
 
-        in_specs = ({n: P(axis) for n in stacked},
-                    {n: P() for n in outer_in}, P(), P())
+        in_specs = [{n: P(axis) for n in stacked},
+                    {n: P() for n in outer_in}, P(), P()]
+        operands = [stacked, outer_in, micro_ids, micro_lbl]
+        if base_key is not None:
+            in_specs.append(P())
+            operands.append(key_in)
         out_specs = (P(), {n: P() for n in outer_in},
                      {n: P(axis) for n in stacked})
-        fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+        fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                            out_specs=out_specs, axis_names={axis},
                            check_vma=False)
-        loss, g_outer, g_blocks = fn(stacked, outer_in, micro_ids, micro_lbl)
+        loss, g_outer, g_blocks = fn(*operands)
         grads = {}
         for n, a in g_outer.items():
             grads[n] = a.astype(params[n].dtype)
@@ -292,7 +320,8 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 grads[n] = jnp.zeros_like(params[n])
         return loss, grads
 
-    return pp_loss(params)
+    return pp_loss(params, base_key if base_key is not None
+                   else jnp.zeros((2,), jnp.uint32))
 
 
 def _call_pre(model, pre_fn, pdict, ids_arr):

@@ -68,12 +68,20 @@ def sequence_parallel_state():
     return _STATE['active']
 
 
-def sp_attention(q, k, v, causal, scale, state=None):
+def sp_attention(q, k, v, causal, scale, state=None, dropout_p=0.0,
+                 dropout_key=None):
     """Attention over [B, N, H, D] with N sharded on the sp axis.
 
     Called with GLOBAL (traced) arrays inside jit; shard_map splits the
     sequence and runs the ring/Ulysses kernel per device.
+
+    dropout_p/dropout_key: attention-prob dropout; the replicated key
+    crosses the shard_map boundary and is folded with the sp rank inside,
+    so every sequence shard draws independent masks (sp-aware RNG — the
+    mp RNGStatesTracker pattern applied to the sequence axis).
     """
+    import jax
+    from jax import lax
     from ..ops import ring_attention as ra
 
     st = state or _STATE['active']
@@ -83,8 +91,19 @@ def sp_attention(q, k, v, causal, scale, state=None):
         b_ax = b_ax[0]
     spec = P(b_ax, axis, st['head_axis'], None)
     # ring mode prefers the Pallas-block ring (falls back to the jnp ring
-    # internally when the kernel cannot run on this backend/shape)
+    # internally when the kernel cannot run on this backend/shape; dropout
+    # routes to the jnp ring)
     fn = ra.ring_flash_attention if mode == 'ring' else ra.ulysses_attention
+    if dropout_p and dropout_key is not None:
+        def body(qq, kk, vv, key):
+            rank_key = jax.random.fold_in(key, lax.axis_index(axis))
+            return fn(qq, kk, vv, axis_name=axis, causal=causal,
+                      scale=scale, dropout_p=dropout_p,
+                      dropout_key=rank_key)
+        wrapped = shard_map(body, mesh=mesh,
+                            in_specs=(spec, spec, spec, P()),
+                            out_specs=spec, check_rep=False)
+        return wrapped(q, k, v, dropout_key)
     wrapped = shard_map(
         functools.partial(fn, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -50,6 +50,24 @@ def _free_ports(n):
     return ports
 
 
+def _platform_env():
+    """CPU-forcing env for spawned children.
+
+    A spawn-context child re-imports the worker's module at startup —
+    including the framework — and under the axon TPU shim that import
+    wedges on the device claim. One process drives all local TPU chips in
+    the single-controller model anyway, so multi-process children default
+    to the CPU backend (JAX_PLATFORMS=cpu must ride together with an
+    empty PALLAS_AXON_POOL_IPS: the env var alone routes through the shim
+    and hangs). Set PADDLE_TPU_SPAWN_PLATFORM=tpu to opt a child into the
+    real backend (multi-host deployments where each host owns its chips).
+    """
+    plat = os.environ.get('PADDLE_TPU_SPAWN_PLATFORM', 'cpu')
+    if plat == 'cpu':
+        return {'JAX_PLATFORMS': 'cpu', 'PALLAS_AXON_POOL_IPS': ''}
+    return {}
+
+
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     if nprocs in (-1, 0, 1, None):
         func(*args)
@@ -59,16 +77,35 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
     ports = _free_ports(nprocs)
     endpoints = ','.join('127.0.0.1:%d' % p for p in ports)
     procs = []
-    for rank in range(nprocs):
-        env = {'PADDLE_TRAINER_ID': str(rank),
-               'PADDLE_TRAINERS_NUM': str(nprocs),
-               'PADDLE_CURRENT_ENDPOINT': '127.0.0.1:%d' % ports[rank],
-               'PADDLE_TRAINER_ENDPOINTS': endpoints}
-        p = ctx.Process(target=_wrap,
-                        args=(func, args, env, rank, error_queue),
-                        daemon=daemon)
-        p.start()
-        procs.append(p)
+    plat_env = _platform_env()
+    # children inherit os.environ at exec time — seat the platform env in
+    # the parent around start() so it is active BEFORE the child's module
+    # re-imports (per-rank vars still applied in _wrap, which runs after)
+    saved = {k: os.environ.get(k) for k in plat_env}
+    os.environ.update(plat_env)
+    try:
+        trace_base = os.environ.get('PADDLE_TRAINER_TRACE_DIR')
+        for rank in range(nprocs):
+            env = {'PADDLE_TRAINER_ID': str(rank),
+                   'PADDLE_TRAINERS_NUM': str(nprocs),
+                   'PADDLE_CURRENT_ENDPOINT': '127.0.0.1:%d' % ports[rank],
+                   'PADDLE_TRAINER_ENDPOINTS': endpoints}
+            if trace_base:
+                # per-rank trace dirs, merge_traces-ready (profiler)
+                env['PADDLE_TRAINER_TRACE_DIR'] = os.path.join(
+                    trace_base, 'rank_%d' % rank)
+            env.update(plat_env)
+            p = ctx.Process(target=_wrap,
+                            args=(func, args, env, rank, error_queue),
+                            daemon=daemon)
+            p.start()
+            procs.append(p)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     context = SpawnContext(procs, error_queue)
     if join:
         context.join()

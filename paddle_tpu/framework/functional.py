@@ -271,19 +271,21 @@ class TrainStep:
                 if use_1f1b:
                     # micro-level loss lives inside the pipelined region
                     # (pipeline_1f1b.py); loss_fn is forwarded into the
-                    # model's pp_decompose post stage
+                    # model's pp_decompose post stage. The step key is
+                    # seated around it so the schedule's dropout base key
+                    # derives from the traced per-step key (and the split
+                    # tracer cannot leak into the live generator)
                     from ..distributed.pipeline_1f1b import one_f_one_b_loss
-                    loss_val = one_f_one_b_loss(
-                        model, all_params, call_inputs[0], labels[0],
-                        self._pp_state, loss_fn=loss_fn).astype(jnp.float32)
+                    with rng_mod.key_scope(key):
+                        loss_val = one_f_one_b_loss(
+                            model, all_params, call_inputs[0], labels[0],
+                            self._pp_state,
+                            loss_fn=loss_fn).astype(jnp.float32)
                     if loss_scaling:
                         return loss_val * opt_state['loss_scale'], \
                             ({}, loss_val)
                     return loss_val, {}
-                gen = rng_mod.default_generator()
-                saved_key = gen._key
-                gen._key = key
-                try:
+                with rng_mod.key_scope(key):
                     out, new_buf = functional_call(model, all_params,
                                                    call_buffers,
                                                    args=call_inputs,
@@ -292,8 +294,6 @@ class TrainStep:
                     t_outs = [Tensor(o, stop_gradient=False) for o in outs]
                     t_labels = [Tensor(l) for l in labels]
                     loss_t = loss_fn(*t_outs, *t_labels)
-                finally:
-                    gen._key = saved_key
                 loss_val = loss_t._data
                 if amp_dtype is not None:
                     loss_val = loss_val.astype(jnp.float32)
@@ -483,6 +483,105 @@ class TrainStep:
             jaxpr = jax.make_jaxpr(self._pure_step)(
                 params, buffers, opt_state, (in_arrays, lab_arrays), lr, key)
         return str(jaxpr)
+
+    def _build_multi(self):
+        pure_step = self._pure_step
+        jit_kwargs = {}
+        if self._donate:
+            jit_kwargs['donate_argnums'] = (0, 2)
+        if self._out_shardings is not None:
+            # same pytree as the single step: (params, buffers, opt_state,
+            # loss) — the strategy's layout contract holds across the scan
+            # (the loss entry's replicated spec covers the [K] losses too)
+            jit_kwargs['out_shardings'] = self._out_shardings
+
+        def multi(params, buffers, opt_state, batches, lr, keys):
+            def body(carry, xs):
+                p, b, o = carry
+                batch, key = xs
+                np_, nb, no, loss = pure_step(p, b, o, batch, lr, key)
+                return (np_, nb, no), loss
+            (p, b, o), losses = jax.lax.scan(
+                body, (params, buffers, opt_state), (batches, keys))
+            return p, b, o, losses
+        return jax.jit(multi, **jit_kwargs)
+
+    def multi_step(self, inputs, labels):
+        """K training steps in ONE dispatch: `lax.scan` over the step body.
+
+        Every input/label array carries a leading K axis. The device runs
+        all K fwd+bwd+update iterations without returning to the host —
+        the XLA-native analog of the reference's executor-driven
+        multi-iteration `Run` (fluid Executor runs a whole program once
+        per call), and the lever that amortizes per-dispatch latency on
+        relayed/tunneled accelerators. Returns the K losses as a Tensor.
+        """
+        in_arrays, lab_arrays = self._step_args(inputs, labels)
+        if self._batch_sharding is not None:
+            # the per-step batch sharding shards dim 0 = batch; here dim 0
+            # is the K scan axis, so prepend None to keep the batch dim
+            # (now dim 1) on the dp axis
+            bs = self._batch_sharding
+            try:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                ks = NamedSharding(bs.mesh, P(None, *tuple(bs.spec)))
+            except (AttributeError, TypeError):
+                ks = bs
+            in_arrays = tuple(jax.device_put(a, ks) for a in in_arrays)
+            lab_arrays = tuple(jax.device_put(a, ks) for a in lab_arrays)
+        k = in_arrays[0].shape[0]
+        with self._sp_scope():
+            if self._jitted is None:
+                sample = (tuple(a[0] for a in in_arrays),
+                          tuple(a[0] for a in lab_arrays))
+                self._jitted = self._build(sample)
+            if getattr(self, '_jitted_multi', None) is None:
+                self._jitted_multi = self._build_multi()
+            params = extract_params(self.model)
+            buffers = extract_buffers(self.model)
+            opt_state = self._opt_state()
+            lr = self._lr_array()
+            keys = jax.random.split(rng_mod.next_key(), k)
+            new_params, new_buffers, new_opt_state, losses = \
+                self._jitted_multi(params, buffers, opt_state,
+                                   (in_arrays, lab_arrays), lr, keys)
+        write_back_params(self.model, new_params)
+        write_back_buffers(self.model, new_buffers)
+        self._write_opt_state(new_opt_state)
+        return Tensor(losses)
+
+    def compiled_hlo(self, inputs, labels):
+        """Optimized (post-SPMD-partitioning) HLO of the step, plus the
+        compiled executable's input shardings for the params pytree.
+
+        Returns (hlo_text, param_shardings dict). Tests assert the
+        partitioner REALLY inserted the expected collectives and sharded
+        the parameters at realistic dims — the TPU analog of the
+        reference's program-transform assertions
+        (test_fleet_*_meta_optimizer.py, SURVEY §4.2)."""
+        in_arrays, lab_arrays = self._step_args(inputs, labels)
+        if self._batch_sharding is not None:
+            in_arrays = tuple(jax.device_put(a, self._batch_sharding)
+                              for a in in_arrays)
+            lab_arrays = tuple(jax.device_put(a, self._batch_sharding)
+                               for a in lab_arrays)
+        with self._sp_scope():
+            if self._jitted is None:
+                self._jitted = self._build((in_arrays, lab_arrays))
+            params = extract_params(self.model)
+            buffers = extract_buffers(self.model)
+            opt_state = self._opt_state()
+            lr = self._lr_array()
+            key = rng_mod.default_generator()._key
+            compiled = self._jitted.lower(
+                params, buffers, opt_state, (in_arrays, lab_arrays), lr,
+                key).compile()
+        hlo = compiled.as_text()
+        try:
+            pshard = compiled.input_shardings[0][0]
+        except Exception:
+            pshard = None
+        return hlo, pshard
 
     def __call__(self, inputs, labels):
         """One step; returns the loss as a Tensor."""

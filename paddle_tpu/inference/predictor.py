@@ -180,12 +180,44 @@ class Predictor:
         self._lock = threading.Lock()
         self._load()
 
+    @staticmethod
+    def _is_fluid_artifact(path):
+        """Reference-produced artifact? (__model__ / *.pdmodel ProgramDesc,
+        analysis_predictor.cc:201 PrepareProgram's input format)."""
+        if os.path.isdir(path):
+            if os.path.exists(os.path.join(path, '__model__')):
+                return True
+            return any(f.endswith('.pdmodel') for f in os.listdir(path))
+        return (path.endswith('.pdmodel')
+                or os.path.basename(path) == '__model__')
+
+    def _load_fluid(self, path):
+        """Serve a reference-format model: ProgramDesc block 0 lowers to
+        one XLA module via the fluid op table (fluid_program.py)."""
+        from .fluid_program import load_fluid_model
+        prog = load_fluid_model(path, self._config.params_file())
+        self._fluid = prog
+        self._layer = None
+        self._translated = None
+        self._buffers = {}
+        self._params = prog.params
+        self._input_names = list(prog.feed_names)
+
+        def pure(params, *arrays):
+            feeds = dict(zip(prog.feed_names, arrays))
+            outs = prog._run_block(params, feeds)
+            return tuple(outs) if len(outs) != 1 else outs[0]
+        self._fn = pure
+
     def _load(self):
         from .. import jit as jit_mod
         from ..framework import functional as func_mod
         path = self._config.model_dir()
         if path is None:
             raise ValueError('Config.set_model(path) required')
+        if self._is_fluid_artifact(path):
+            self._load_fluid(path)
+            return
         self._translated = jit_mod.load(path)
         layer = self._translated._layer
         if layer is None:

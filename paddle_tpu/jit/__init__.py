@@ -54,6 +54,13 @@ class StaticFunction:
         return tuple((tuple(a.shape), str(a.dtype)) for a in arrays) + (training,)
 
     def __call__(self, *args, **kwargs):
+        # JAX trace errors re-frame to the user's source line
+        # (dygraph_to_static/error.py capability; jit/error.py)
+        from .error import trace_error_scope
+        with trace_error_scope(self._fn):
+            return self._call_impl(*args, **kwargs)
+
+    def _call_impl(self, *args, **kwargs):
         layer = self._bound_layer
         in_arrays = []
         struct = []
@@ -216,10 +223,12 @@ def save(layer, path, input_spec=None, **configs):
     # compatibility)
     import jax as _jax
     from .. import __version__ as _fw_version
+    from ..framework import op_version as _opv
     meta = {'input_spec': None, 'stablehlo': None,
             'format_version': _FORMAT_VERSION,
             'framework_version': _fw_version,
-            'jax_version': _jax.__version__}
+            'jax_version': _jax.__version__,
+            'op_versions': _opv.snapshot()}
     if input_spec:
         specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
                  for s in input_spec]
@@ -325,6 +334,11 @@ def load(path, **configs):
         warnings.warn('artifact %s uses the older format %s (current %s); '
                       'loading with best-effort compatibility'
                       % (path, tuple(fmt), _FORMAT_VERSION))
+    # per-op semantic versions (framework/op_version.py; reference
+    # op_version_registry.h) — refuse ops saved at newer semantics
+    from ..framework import op_version as _opv
+    _opv.check_compatible(
+        (model_payload.get('meta') or {}).get('op_versions'), artifact=path)
     layer = None
     if model_payload.get('arch') is not None:
         layer = pickle.loads(model_payload['arch'])

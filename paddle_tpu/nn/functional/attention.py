@@ -6,12 +6,28 @@ traffic of the naive path dominates. Reference parity:
 paddle incubate sparse_attention / nn.MultiHeadAttention core.
 """
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 from ...framework.core import run_op
 from ...tensor._helpers import ensure_tensor
+
+
+def _attn_impl():
+    """PADDLE_TPU_ATTN_IMPL: auto (default) | flash | blockwise | quadratic.
+
+    'auto' prefers the Pallas flash kernel when it can run, then blockwise
+    (pure-XLA online softmax, ops/blockwise_attention.py) for sequences
+    long enough that the quadratic path's [B,H,N,N] recompute dominates,
+    then the quadratic + jax.checkpoint reference body.
+    """
+    return os.environ.get('PADDLE_TPU_ATTN_IMPL', 'auto')
+
+
+def _blockwise_min_seq():
+    return int(os.environ.get('PADDLE_TPU_BLOCKWISE_MIN_SEQ', 1024))
 
 
 def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, drop_key=None):
@@ -42,6 +58,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     k = ensure_tensor(key)
     v = ensure_tensor(value)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    if not training:
+        # eval-mode dropout is a no-op; normalizing here keeps the
+        # flash/blockwise fast paths eligible during inference
+        dropout_p = 0.0
 
     # sequence-parallel routing: when the fleet strategy activated the sp
     # context, attention is the one op that mixes tokens across the
@@ -55,23 +75,28 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if attn_mask is not None:
             raise ValueError('sequence-parallel attention supports causal/'
                              'full masks only (attn_mask must be None)')
-        if dropout_p:
-            raise ValueError('sequence-parallel attention requires '
-                             'dropout_p=0 (attention-prob dropout would '
-                             'need sp-aware RNG)')
+        sp_drop_key = None
+        if dropout_p and training:
+            from ...framework import random as rng
+            sp_drop_key = rng.next_key()
 
         def fn(qq, kk, vv):
             return sp_attention(qq, kk, vv, causal=is_causal, scale=scale,
-                                state=sp_state)
+                                state=sp_state,
+                                dropout_p=dropout_p if sp_drop_key is not None
+                                else 0.0,
+                                dropout_key=sp_drop_key)
         return run_op('sp_attention', fn, q, k, v)
 
+    impl = _attn_impl()
     use_flash = False
-    try:
-        from ...ops import flash_attention as fa
-        if q._data.ndim == 4 and q.shape[1] >= 512 and q.shape[-1] <= 256:
-            use_flash = fa.is_available()
-    except Exception:
-        use_flash = False
+    if impl in ('auto', 'flash'):
+        try:
+            from ...ops import flash_attention as fa
+            if q._data.ndim == 4 and q.shape[1] >= 512 and q.shape[-1] <= 256:
+                use_flash = fa.is_available()
+        except Exception:
+            use_flash = False
 
     mask_arr = ensure_tensor(attn_mask)._data if attn_mask is not None else None
 
@@ -82,6 +107,18 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             return fa.flash_attention_bnhd(qq, kk, vv, causal=is_causal,
                                            scale=scale)
         return run_op('flash_attention', fn, q, k, v)
+
+    use_blockwise = (impl == 'blockwise' or
+                     (impl == 'auto' and q._data.ndim == 4 and
+                      q.shape[1] >= _blockwise_min_seq()))
+    if use_blockwise and q._data.ndim == 4 and mask_arr is None and \
+            dropout_p == 0.0:
+        from ...ops import blockwise_attention as bw
+
+        def fn(qq, kk, vv):
+            return bw.blockwise_attention(qq, kk, vv, causal=is_causal,
+                                          scale=scale)
+        return run_op('blockwise_attention', fn, q, k, v)
 
     # attention-prob dropout rides the framework RNG stream (same
     # convention as F.dropout: key drawn outside the pure fn); the remat

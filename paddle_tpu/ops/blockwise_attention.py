@@ -1,0 +1,107 @@
+"""Blockwise (chunked) attention in pure XLA — flash's O(N) memory shape
+without Mosaic.
+
+Online-softmax over KV blocks (Dao et al. / Liu et al. "Blockwise Parallel
+Transformer"), written as a `lax.scan` whose body is `jax.checkpoint`ed:
+the scan's saved residuals are only the per-block running (m, l, acc)
+carries, so neither forward nor backward ever materializes the [N, M]
+score matrix. This is the fallback for hardware where the Pallas flash
+kernels (ops/flash_attention.py) cannot compile — e.g. a relay whose
+remote Mosaic service is unavailable — and the long-sequence path when
+quadratic + jax.checkpoint would exceed HBM.
+
+Reference counterpart: the fused attention family
+/root/reference/paddle/fluid/operators/fused/fused_attention_op.cu (spec
+only — that is a cuBLAS/cuDNN kernel; this is an XLA-native algorithm).
+"""
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _pick_block(n, target):
+    """Largest power-of-two-ish divisor of n that is <= target."""
+    b = min(target, n)
+    while b > 1 and n % b:
+        b //= 2
+    return max(b, 1)
+
+
+def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
+                             block_q=512, block_k=512):
+    """Attention over [batch, heads, seq, head_dim] arrays.
+
+    Numerically matches softmax(q k^T * scale) v with f32 accumulation;
+    memory is O(seq * head_dim) instead of O(seq^2).
+
+    Known cost: causal mode computes (then masks) the future KV blocks —
+    the q-block loop is vmapped for MXU parallelism, so a lax.cond skip
+    would lower to select and save nothing. The quadratic reference path
+    pays the same 2x on masked flops; the Pallas flash kernels
+    (flash_attention.py) are the zero-waste causal path when Mosaic is
+    available. This op's win is the O(N) memory shape.
+    """
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bq = _pick_block(n, block_q)
+    bk = _pick_block(m, block_k)
+    tq, tk = n // bq, m // bk
+
+    qb = q.reshape(b, h, tq, bq, d)
+    kb = jnp.moveaxis(k.reshape(b, h, tk, bk, d), 2, 0)  # [tk, b, h, bk, d]
+    vb = jnp.moveaxis(v.reshape(b, h, tk, bk, d), 2, 0)
+
+    def one_qblock(qi, i):
+        # qi: [b, h, bq, d]; i: scalar q-block index
+        q32 = qi.astype(jnp.float32) * scale
+
+        def body(carry, xs):
+            m_prev, l_prev, acc = carry
+            kj, vj, j = xs
+            s = jnp.einsum('bhqd,bhkd->bhqk', q32, kj.astype(jnp.float32))
+            if causal:
+                qpos = i * bq + jnp.arange(bq)
+                kpos = j * bk + jnp.arange(bk)
+                keep = qpos[:, None] >= kpos[None, :]
+                s = jnp.where(keep, s, _NEG_INF)
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_cur[..., None])
+            if causal:
+                # -1e30 sentinel rows: exp(-1e30 - -1e30) = 1 would leak
+                # masked weight; zero them explicitly
+                p = jnp.where(keep[None, None], p, 0.0)
+            corr = jnp.exp(m_prev - m_cur)
+            l_cur = l_prev * corr + jnp.sum(p, axis=-1)
+            acc = acc * corr[..., None] + jnp.einsum(
+                'bhqk,bhkd->bhqd', p, vj.astype(jnp.float32))
+            return (m_cur, l_cur, acc), None
+
+        init = (jnp.full((b, h, bq), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, bq), jnp.float32),
+                jnp.zeros((b, h, bq, d), jnp.float32))
+        (m_f, l_f, acc), _ = lax.scan(jax.checkpoint(body), init,
+                                      (kb, vb, jnp.arange(tk)))
+        out = acc / jnp.maximum(l_f, 1e-30)[..., None]
+        return out.astype(q.dtype)
+
+    out = jax.vmap(one_qblock, in_axes=(2, 0), out_axes=2)(
+        qb, jnp.arange(tq))
+    return out.reshape(b, h, n, d)
+
+
+def blockwise_attention(q, k, v, causal=False, scale=None,
+                        block_q=512, block_k=512):
+    """Paddle-layout entry: [batch, seq, heads, head_dim]."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = blockwise_attention_bnhd(qt, kt, vt, causal=causal, scale=scale,
+                                 block_q=block_q, block_k=block_k)
+    return jnp.swapaxes(o, 1, 2)

@@ -21,11 +21,16 @@ __all__ = ['ring_attention', 'ulysses_attention', 'ring_attention_sharded',
            'ring_flash_attention_sharded']
 
 
-def _block_attn(q, k, v, scale, mask):
+def _block_attn(q, k, v, scale, mask, drop_p=0.0, drop_key=None):
     """One blockwise attention step in f32 accumulators.
 
     q: [B, Nq, H, D]; k/v: [B, Nk, H, D]; mask: [Nq, Nk] bool or None.
-    Returns (scores_max [B,H,Nq], exp-sum [B,H,Nq], acc [B,Nq,H,D])."""
+    Returns (scores_max [B,H,Nq], exp-sum [B,H,Nq], acc [B,Nq,H,D]).
+
+    drop_p/drop_key: attention-prob dropout. The exp-sum `l` accumulates
+    the UNdropped weights (dropout applies after softmax normalization:
+    out_i = sum_j mask_ij p_ij v_j / (keep * sum_j p_ij)), so only the
+    value accumulation sees the mask."""
     s = jnp.einsum('bqhd,bkhd->bhqk', q, k,
                    preferred_element_type=jnp.float32) * scale
     if mask is not None:
@@ -33,17 +38,27 @@ def _block_attn(q, k, v, scale, mask):
     m = jnp.max(s, axis=-1)
     p = jnp.exp(s - m[..., None])
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v,
+    p_v = p
+    if drop_p and drop_key is not None:
+        keep = jax.random.bernoulli(drop_key, 1.0 - drop_p, p.shape)
+        p_v = jnp.where(keep, p / (1.0 - drop_p), 0.0)
+    acc = jnp.einsum('bhqk,bkhd->bqhd', p_v.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return m, l, acc
 
 
-def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
+def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
+                   dropout_p=0.0, dropout_key=None):
     """Exact attention with K/V rotating around the ring.
 
     All inputs are the LOCAL sequence shard [B, N_local, H, D]; output is
     the local shard of the attention result. Call inside shard_map with
     `axis_name` bound to the sequence mesh axis.
+
+    dropout_p/dropout_key: attention-prob dropout; the caller passes a
+    key already folded per q-shard rank, and each ring step folds the kv
+    source rank in, so every (q-block, kv-block) pair draws an
+    independent mask.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -65,7 +80,10 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = None
-        m_blk, l_blk, acc_blk = _block_attn(q32, k_cur, v_cur, scale, mask)
+        blk_key = (jax.random.fold_in(dropout_key, src)
+                   if dropout_p and dropout_key is not None else None)
+        m_blk, l_blk, acc_blk = _block_attn(q32, k_cur, v_cur, scale, mask,
+                                            dropout_p, blk_key)
         m_new = jnp.maximum(m_prev, m_blk)
         alpha = jnp.exp(m_prev - m_new)
         beta = jnp.exp(m_blk - m_new)
@@ -88,7 +106,7 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None):
 
 
 def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
-                      attn_fn=None):
+                      attn_fn=None, dropout_p=0.0, dropout_key=None):
     """Ulysses (DeepSpeed) sequence parallelism: all_to_all swaps the
     sequence shard for a head shard, runs full-sequence attention on H/sp
     heads locally, and swaps back. Heads must divide the axis size."""
@@ -121,6 +139,11 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
             cm = jnp.tril(jnp.ones((n, n), bool))
             s = jnp.where(cm[None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
+        if dropout_p and dropout_key is not None:
+            # the caller folds the rank in; local heads draw iid masks
+            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                        p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         of = jnp.einsum('bhqk,bkhd->bqhd', p.astype(vf.dtype), vf)
     else:
         of = attn_fn(qf, kf, vf)
@@ -173,11 +196,17 @@ def _lse_merge(o1, lse1, o2, lse2, w2):
     return o, m_safe + jnp.log(denom)
 
 
-def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None):
+def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None,
+                         dropout_p=0.0, dropout_key=None):
     """Drop-in for ring_attention ([B, N_local, H, D] shards) running the
     Pallas flash kernels per block. Falls back to the jnp ring when the
-    kernel cannot run (shape/backend)."""
+    kernel cannot run (shape/backend), and routes attention-prob dropout
+    to the jnp ring (the Pallas kernels are dropout-free)."""
     from . import flash_attention as fa
+    if dropout_p and dropout_key is not None:
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              scale=scale, dropout_p=dropout_p,
+                              dropout_key=dropout_key)
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]

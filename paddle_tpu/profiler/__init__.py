@@ -12,7 +12,7 @@ import jax
 
 __all__ = ['RecordEvent', 'profiler', 'start_profiler', 'stop_profiler',
            'Profiler', 'ProfilerTarget', 'ProfilerState',
-           'export_chrome_tracing', 'load_profiler_result']
+           'export_chrome_tracing', 'load_profiler_result', 'merge_traces']
 
 
 class RecordEvent:
@@ -81,8 +81,13 @@ class Profiler:
 
     def __init__(self, targets=None, scheduler=None,
                  on_trace_ready=None, timer_only=False,
-                 log_dir='/tmp/paddle_tpu_profile'):
-        self.log_dir = log_dir
+                 log_dir=None):
+        import os
+        # launcher/spawn seat a per-rank trace dir so a distributed run's
+        # traces land rank-separated, ready for merge_traces
+        self.log_dir = (log_dir
+                        or os.environ.get('PADDLE_TRAINER_TRACE_DIR')
+                        or '/tmp/paddle_tpu_profile')
         self.timer_only = timer_only
         self._on_trace_ready = on_trace_ready
         self._times = []
@@ -134,6 +139,84 @@ def export_chrome_tracing(dir_name, worker_name=None):
     def handler(prof):
         prof.log_dir = dir_name
     return handler
+
+
+def merge_traces(rank_dirs, out_path, rank_names=None):
+    """Merge per-rank chrome-tracing outputs into ONE timeline with
+    per-rank lanes (reference: tools/CrossStackProfiler/ — merges
+    per-trainer timelines into a cluster view).
+
+    rank_dirs: ordered per-rank trace dirs (each a jax.profiler/Profiler
+    log_dir, holding *.trace.json[.gz] chrome traces). out_path: merged
+    chrome-tracing JSON, loadable in Perfetto/chrome://tracing. Every
+    rank's processes are remapped into a disjoint pid range and labeled
+    'rank N: <process>', so lanes group by rank.
+    """
+    import gzip
+    import json
+    import os
+
+    _PID_STRIDE = 1 << 20
+    merged = []
+    total = 0
+    for rank, d in enumerate(rank_dirs):
+        label = (rank_names[rank] if rank_names else 'rank %d' % rank)
+        events = []
+        for f in load_profiler_result(d):
+            if f.endswith('.trace.json.gz'):
+                try:
+                    with gzip.open(f, 'rt') as fh:
+                        data = json.load(fh)
+                except (OSError, EOFError, ValueError):
+                    continue  # truncated trace (run killed mid-write)
+            elif f.endswith(('.trace.json', '.json')):
+                with open(f) as fh:
+                    try:
+                        data = json.load(fh)
+                    except ValueError:
+                        continue
+            else:
+                continue
+            evs = data.get('traceEvents', data) if isinstance(data, dict) \
+                else data
+            if isinstance(evs, list):
+                events.extend(e for e in evs if isinstance(e, dict))
+        pnames = {e.get('pid'): e.get('args', {}).get('name')
+                  for e in events
+                  if e.get('ph') == 'M' and e.get('name') == 'process_name'}
+        # collision-free remap: sequential index per distinct source pid
+        pid_map = {}
+
+        def _remap(pid):
+            if pid not in pid_map:
+                pid_map[pid] = rank * _PID_STRIDE + len(pid_map)
+            return pid_map[pid]
+
+        seen_pids = set()
+        for e in events:
+            e = dict(e)
+            pid = e.get('pid', 0)
+            e['pid'] = _remap(pid)
+            if e.get('ph') == 'M' and e.get('name') == 'process_name':
+                orig = e.get('args', {}).get('name') or str(pid)
+                e['args'] = {'name': '%s: %s' % (label, orig)}
+            seen_pids.add((pid, e['pid']))
+            merged.append(e)
+        for orig_pid, new_pid in seen_pids:
+            if orig_pid not in pnames:
+                merged.append({'ph': 'M', 'name': 'process_name',
+                               'pid': new_pid,
+                               'args': {'name': '%s: pid %s'
+                                        % (label, orig_pid)}})
+            merged.append({'ph': 'M', 'name': 'process_sort_index',
+                           'pid': new_pid, 'args': {'sort_index': rank}})
+        total += len(events)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, 'w') as fh:
+        json.dump({'traceEvents': merged,
+                   'metadata': {'merged_ranks': len(rank_dirs),
+                                'source_events': total}}, fh)
+    return out_path
 
 
 def load_profiler_result(path):

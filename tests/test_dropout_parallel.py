@@ -1,0 +1,209 @@
+"""Dropout under pipeline and sequence parallelism (VERDICT r3 item 4).
+
+The schedules thread per-step base keys folded with (microbatch, stage,
+layer) indices (framework/random.key_scope), so:
+  (a) masks differ across microbatches within a step,
+  (b) eval mode stays bit-parity with the sequential forward,
+  (c) the 1F1B backward's stage recompute rederives identical masks
+      (training converges instead of silently corrupting grads).
+Reference capability: fleet/meta_parallel/parallel_layers/random.py
+(Megatron-style RNG state isolation under pp/mp).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.pipeline import make_pp_state, pipeline_blocks
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+
+def _gpt(seed=0, layers=4, dropout=0.1, **kw):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=layers,
+                    num_heads=4, max_position_embeddings=32,
+                    dropout=dropout, **kw)
+    return GPTForCausalLM(cfg)
+
+
+def _batch(b=8, s=32, vocab=128, seed=3):
+    rng = np.random.RandomState(seed)
+    ids = paddle.to_tensor(rng.randint(0, vocab, (b, s)).astype(np.int32))
+    lbl = paddle.to_tensor(rng.randint(0, vocab, (b, s)).astype(np.int32))
+    return ids, lbl
+
+
+def _strategy(**hybrid):
+    s = fleet.DistributedStrategy()
+    cfg = {'dp_degree': 8, 'mp_degree': 1, 'pp_degree': 1,
+           'sharding_degree': 1, 'sp_degree': 1}
+    cfg.update(hybrid)
+    s.hybrid_configs = cfg
+    return s
+
+
+def _fleet_step(model, strategy, schedule=None):
+    if schedule is not None:
+        strategy.pipeline = True
+        strategy.pipeline_configs['schedule_mode'] = schedule
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    return fleet.fleet_train_step(
+        model, lambda lg, lb: model.loss(lg, lb), opt, strategy=strategy)
+
+
+class _DropBlock(nn.Layer):
+    """Homogeneous block whose only nondeterminism is dropout."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(16, 16)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        return self.drop(self.lin(x))
+
+
+def _pp_mesh(pp=2):
+    import jax
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:pp])
+    return Mesh(devs, ('pp',))
+
+
+def test_gpipe_dropout_masks_differ_per_microbatch():
+    """Identical microbatch contents -> different outputs per microbatch
+    iff the mask is folded per microbatch (the r3 behavior repeated one
+    mask for every tick)."""
+    paddle.seed(11)
+    blocks = [_DropBlock() for _ in range(2)]
+    for b in blocks:
+        b.train()
+    state = make_pp_state(_pp_mesh(2), n_stages=2, n_micro=4)
+    rng = np.random.RandomState(0)
+    row = rng.randn(2, 16).astype(np.float32)
+    x = paddle.to_tensor(np.tile(row, (4, 1)))  # 4 identical microbatches
+    out = pipeline_blocks(blocks, x, state).numpy()
+    mbs = out.reshape(4, 2, 16)
+    diffs = [not np.allclose(mbs[i], mbs[j])
+             for i in range(4) for j in range(i + 1, 4)]
+    assert all(diffs), 'dropout masks repeated across microbatches'
+
+
+def test_gpipe_dropout_step_dependent_and_deterministic():
+    """Same seed -> same masks; advancing the stream -> different masks."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+
+    def run(seed):
+        paddle.seed(seed)
+        blocks = [_DropBlock() for _ in range(2)]
+        for b in blocks:
+            b.train()
+        state = make_pp_state(_pp_mesh(2), n_stages=2, n_micro=4)
+        first = pipeline_blocks(blocks, x, state).numpy()
+        second = pipeline_blocks(blocks, x, state).numpy()
+        return first, second
+
+    a1, a2 = run(5)
+    b1, b2 = run(5)
+    np.testing.assert_array_equal(a1, b1)   # deterministic per seed
+    np.testing.assert_array_equal(a2, b2)
+    assert not np.allclose(a1, a2)          # masks advance per call/step
+
+
+def test_gpipe_dropout_eval_parity():
+    """eval() blocks: pipelined forward == sequential forward exactly."""
+    paddle.seed(7)
+    blocks = [_DropBlock() for _ in range(2)]
+    for b in blocks:
+        b.eval()
+    state = make_pp_state(_pp_mesh(2), n_stages=2, n_micro=4)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+    out_pp = pipeline_blocks(blocks, x, state).numpy()
+    ref = x
+    for b in blocks:
+        ref = b(ref)
+    np.testing.assert_allclose(out_pp, ref.numpy(), rtol=1e-6, atol=1e-6)
+
+
+def test_gpt_pp2_gpipe_dropout_trains():
+    """GPipe pp=2 with full dropout (residual + attention-prob) trains:
+    finite losses, loss moves, and the run is seed-deterministic."""
+    ids, lbl = _batch()
+
+    def run():
+        model = _gpt(seed=3, dropout=0.2)
+        step = _fleet_step(model, _strategy(dp_degree=4, pp_degree=2))
+        return [float(step(ids, lbl).numpy()) for _ in range(3)]
+
+    losses = run()
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # dropout varies per step: consecutive losses must not be identical
+    assert len({round(l, 9) for l in losses}) == 3
+    np.testing.assert_allclose(run(), losses, rtol=1e-6)
+
+
+def test_gpt_pp2_1f1b_dropout_trains():
+    """1F1B pp=2 with dropout: the build-time raise is gone, masks are
+    recompute-consistent (loss decreases over steps), deterministic."""
+    ids, lbl = _batch()
+
+    def run():
+        model = _gpt(seed=3, dropout=0.2)
+        step = _fleet_step(model, _strategy(dp_degree=4, pp_degree=2),
+                           schedule='1F1B')
+        return [float(step(ids, lbl).numpy()) for _ in range(4)]
+
+    losses = run()
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert len({round(l, 9) for l in losses}) == 4
+    np.testing.assert_allclose(run(), losses, rtol=1e-6)
+
+
+def test_gpt_pp2_1f1b_dropout_eval_matches_dropout_free_train_shape():
+    """With dropout config present, eval/generation outside the step is
+    the plain sequential forward (pp_scope is step-scoped) and must be
+    deterministic — two eval calls agree exactly."""
+    model = _gpt(seed=3, dropout=0.2)
+    _fleet_step(model, _strategy(dp_degree=4, pp_degree=2),
+                schedule='1F1B')
+    model.eval()
+    ids, _ = _batch(b=2)
+    a = model(ids).numpy()
+    b = model(ids).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_sp_dropout_trains():
+    """sp=4 ring attention with dropout (attention-prob + residual):
+    builds (the r3 ValueError is gone) and trains with finite losses."""
+    ids, lbl = _batch()
+    s = _strategy(dp_degree=2, sp_degree=4)
+    s.sequence_parallel = True
+    model = _gpt(seed=5, dropout=0.2)
+    step = _fleet_step(model, s)
+    losses = [float(step(ids, lbl).numpy()) for _ in range(3)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert len({round(l, 9) for l in losses}) == 3
+
+
+def test_sp_dropout_eval_parity_with_dp():
+    """eval forward of the sp-built model == eval forward of a dp model
+    with identical weights (dropout off, no sp context outside steps)."""
+    s = _strategy(dp_degree=2, sp_degree=4)
+    s.sequence_parallel = True
+    model = _gpt(seed=5, dropout=0.2)
+    _fleet_step(model, s)
+    ref = _gpt(seed=5, dropout=0.2)  # same seed -> same init weights
+    model.eval()
+    ref.eval()
+    ids, _ = _batch(b=2)
+    np.testing.assert_allclose(model(ids).numpy(), ref(ids).numpy(),
+                               rtol=1e-5, atol=1e-5)

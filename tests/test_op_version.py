@@ -1,0 +1,62 @@
+"""Op-version compatibility registry (VERDICT r3 missing #6; reference:
+framework/op_version_registry.h): artifacts embed per-op semantic
+versions; loads refuse newer-than-runtime ops and warn on older."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import op_version as opv
+from paddle_tpu.jit import InputSpec
+
+
+def test_registry_defaults_and_snapshot():
+    assert opv.get_op_version('some_unregistered_op') == 1
+    snap = opv.snapshot()
+    assert snap.get('flash_attention', 0) >= 2
+    opv.check_compatible(snap)  # identity snapshot always compatible
+
+
+def test_newer_saved_version_refused_older_warns():
+    snap = {'flash_attention': opv.get_op_version('flash_attention') + 1}
+    with pytest.raises(opv.OpVersionError, match='newer|upgrade'):
+        opv.check_compatible(snap, artifact='m')
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        opv.check_compatible({'flash_attention': 1}, artifact='m')
+    assert any('version' in str(x.message) for x in w)
+
+
+class _M(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = paddle.nn.Linear(4, 2)
+
+    def forward(self, x):
+        return self.lin(x)
+
+
+def test_jit_artifact_embeds_and_checks_op_versions(tmp_path):
+    m = _M()
+    path = str(tmp_path / 'm')
+    paddle.jit.save(m, path,
+                    input_spec=[InputSpec([None, 4], 'float32', 'x')])
+
+    import pickle
+    with open(path + '.pdmodel', 'rb') as f:
+        payload = pickle.load(f)
+    assert payload['meta']['op_versions'] == opv.snapshot()
+
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), m(x).numpy(), rtol=1e-6)
+
+    # tamper: claim a future op version -> load must refuse
+    payload['meta']['op_versions'] = dict(
+        payload['meta']['op_versions'],
+        flash_attention=opv.get_op_version('flash_attention') + 5)
+    with open(path + '.pdmodel', 'wb') as f:
+        pickle.dump(payload, f, protocol=4)
+    with pytest.raises(opv.OpVersionError):
+        paddle.jit.load(path)

@@ -170,7 +170,9 @@ def test_fp16_amp_dynamic_loss_scaling():
         np.testing.assert_array_equal(before, after)
 
 
-def test_sp_with_dropout_fails_at_build_time():
+def test_sp_with_dropout_builds_and_steps():
+    """r3 raised at build time; since r4 sp composes with dropout via
+    sp-aware folded keys (full coverage: tests/test_dropout_parallel.py)."""
     s = _dp_strategy(dp_degree=2, sp_degree=4)
     s.sequence_parallel = True
     paddle.seed(0)
@@ -180,9 +182,11 @@ def test_sp_with_dropout_fails_at_build_time():
     fleet.init(is_collective=True, strategy=s)
     opt = paddle.optimizer.AdamW(learning_rate=1e-3,
                                  parameters=model.parameters())
-    with pytest.raises(ValueError, match='dropout'):
-        fleet.fleet_train_step(model, lambda lg, lb: model.loss(lg, lb),
-                               opt, strategy=s)
+    step = fleet.fleet_train_step(model, lambda lg, lb: model.loss(lg, lb),
+                                  opt, strategy=s)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 32)).astype(np.int32))
+    assert np.isfinite(float(step(ids, ids).numpy()))
 
 
 def test_recompute_propagates_buffer_updates():

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Probe the axon TPU every 3 minutes; log transitions; on an up-window,
+# fire tools/tpu_warmer.py (lockfile-serialized) so the persistent compile
+# cache + an in-window bench number get captured without supervision.
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+while true; do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" 2>/dev/null; then
+    echo "$(date -u +%H:%M:%S) TPU OK" >> /tmp/tpu_probe.log
+    nohup python "$REPO/tools/tpu_warmer.py" >> /tmp/tpu_warmer.out 2>&1 &
+  else
+    echo "$(date -u +%H:%M:%S) TPU DOWN" >> /tmp/tpu_probe.log
+  fi
+  sleep 180
+done

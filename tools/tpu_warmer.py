@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Opportunistic TPU bench runner + compile-cache warmer.
+
+The axon TPU pool wedges for hours at a time (memory: every backend touch
+must live in a child process with a hard timeout). This script is invoked
+by the probe loop (tools/tpu_probe.sh) the moment a probe sees the pool
+up. It then:
+
+1. runs the SAME bench.py child configs the driver's end-of-round bench
+   ladder uses — with the repo-local persistent compilation cache enabled
+   (bench.py `_enable_persistent_cache`), so every XLA executable compiled
+   in this up-window is a warm artifact for the driver's later run even if
+   the pool wedges again in between;
+2. records every result (+ timestamp + config label) to
+   docs/bench_inwindow_r4.jsonl for PERF_NOTES;
+3. compares configs (scan-K device loop vs single dispatch, flash vs
+   blockwise vs quadratic attention) so the ladder ordering in bench.py
+   can be tuned from data.
+
+A lockfile serializes warmers (probe fires every ~3 min; a warm run takes
+longer). Never touches the backend in-process.
+"""
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, 'bench.py')
+OUT = os.path.join(REPO, 'docs', 'bench_inwindow_r4.jsonl')
+LOCK = '/tmp/tpu_warmer.lock'
+
+# config ladder: label -> extra env. Ordered so the most valuable
+# measurement (the expected driver rung) lands first in case the window
+# closes mid-run.
+CONFIGS = [
+    ('flash_disabled_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                              'PADDLE_TPU_FLASH_STRICT': '0',
+                              'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('flash_disabled_b64_remat_scan4', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                                        'PADDLE_TPU_FLASH_STRICT': '0',
+                                        'PADDLE_TPU_BENCH_BATCH': '64',
+                                        'PADDLE_TPU_BENCH_REMAT': '1',
+                                        'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
+    ('flash_disabled_plain', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                              'PADDLE_TPU_FLASH_STRICT': '0'}),
+    ('blockwise_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                         'PADDLE_TPU_FLASH_STRICT': '0',
+                         'PADDLE_TPU_ATTN_IMPL': 'blockwise',
+                         'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('flash_disabled_scan8_b64', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                                  'PADDLE_TPU_FLASH_STRICT': '0',
+                                  'PADDLE_TPU_BENCH_BATCH': '64',
+                                  'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+]
+
+
+def log(msg):
+    line = '%s %s' % (time.strftime('%H:%M:%S'), msg)
+    print(line, flush=True)
+    with open('/tmp/tpu_warmer.log', 'a') as f:
+        f.write(line + '\n')
+
+
+def run_child(label, extra_env, timeout=1500):
+    env = dict(os.environ)
+    env['PADDLE_TPU_BENCH_CHILD'] = '1'
+    env.update(extra_env)
+    t0 = time.time()
+    try:
+        proc = subprocess.run([sys.executable, BENCH], capture_output=True,
+                              text=True, env=env, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return None, 'timeout>%ds' % timeout, time.time() - t0
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                return json.loads(line), None, time.time() - t0
+            except ValueError:
+                continue
+    return None, 'rc=%d: %s' % (proc.returncode,
+                                (proc.stderr or '')[-300:]), time.time() - t0
+
+
+def record(label, result, err, wall):
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    entry = {'ts': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+             'label': label, 'wall_s': round(wall, 1)}
+    if result is not None:
+        entry.update(result)
+    else:
+        entry['error'] = err
+    with open(OUT, 'a') as f:
+        f.write(json.dumps(entry) + '\n')
+
+
+def probe_tpu(timeout=90):
+    src = "import jax; assert jax.devices()[0].platform == 'tpu'"
+    try:
+        return subprocess.run([sys.executable, '-c', src],
+                              timeout=timeout).returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main():
+    lock = open(LOCK, 'w')
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        log('another warmer holds the lock; exiting')
+        return
+    if not probe_tpu():
+        log('TPU not up at warmer start; exiting')
+        return
+    log('TPU up — warming')
+    for label, extra in CONFIGS:
+        result, err, wall = run_child(label, extra)
+        record(label, result, err, wall)
+        if result is not None:
+            log('%s: %.1fms/step mfu=%.4f (%.0fs)' % (
+                label, result.get('step_ms', -1), result.get('mfu', 0),
+                wall))
+        else:
+            log('%s: FAILED %s (%.0fs)' % (label, err, wall))
+            # if the pool wedged mid-window, stop burning child timeouts
+            if not probe_tpu():
+                log('pool went down mid-window; stopping')
+                break
+    log('warmer done')
+
+
+if __name__ == '__main__':
+    main()

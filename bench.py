@@ -181,16 +181,31 @@ def _run_measurement():
     profile_dir = os.environ.get('PADDLE_TPU_BENCH_PROFILE')
     if profile_dir:
         jax.profiler.start_trace(profile_dir)
-    t0 = time.time()
+    # per-dispatch variance view (costs one host fetch per dispatch —
+    # ~77ms each through the relay — so it is opt-in; the headline number
+    # keeps the single end-of-loop fetch)
+    per_dispatch = os.environ.get('PADDLE_TPU_BENCH_PER_DISPATCH') == '1'
+    dispatch_ms = []
+    t0 = last = time.time()
     if scan_k > 1:
         n_dispatch = max(1, steps // scan_k)
         for _ in range(n_dispatch):
             losses = step.multi_step(ids_k, labels_k)
+            if per_dispatch:
+                _ = losses.numpy()
+                now = time.time()
+                dispatch_ms.append(round(1000 * (now - last), 2))
+                last = now
         _ = losses.numpy()
         steps = scan_k * n_dispatch
     else:
         for _ in range(steps):
             loss = step(ids, labels)
+            if per_dispatch:
+                _ = loss.numpy()
+                now = time.time()
+                dispatch_ms.append(round(1000 * (now - last), 2))
+                last = now
         _ = loss.numpy()
     dt = time.time() - t0
     if profile_dir:
@@ -218,6 +233,7 @@ def _run_measurement():
         'attn_impl': os.environ.get('PADDLE_TPU_ATTN_IMPL', 'auto'),
         'platform': platform,
         'degraded': not on_tpu,
+        **({'dispatch_ms': dispatch_ms} if dispatch_ms else {}),
     }))
 
 

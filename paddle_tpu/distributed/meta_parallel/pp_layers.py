@@ -159,7 +159,7 @@ class PipelineLayer(nn.Layer):
 
             return pp_mod.pipeline_stage_fns(self.get_stage_fns(), x,
                                              pp_state, params=params,
-                                             rebind=rebind, rng_from=self)
+                                             rebind=rebind)
         for f in self.run_function:
             x = f(x)
         return x

@@ -104,21 +104,6 @@ def _null_ctx():
     return contextlib.nullcontext()
 
 
-def _needs_rng(layer):
-    """True when a forward of `layer` will draw RNG (active dropout) —
-    the schedules then thread per-microbatch keys through their scan."""
-    from .. import nn as nn_mod
-    for l in layer.sublayers(include_self=True):
-        if not getattr(l, 'training', True):
-            continue
-        if isinstance(l, nn_mod.Dropout) and getattr(l, 'p', 0):
-            return True
-        dp = getattr(l, 'dropout', None)
-        if isinstance(dp, float) and dp > 0:
-            return True
-    return False
-
-
 def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
                 wire_dtype=None, base_key=None):
     """The schedule: n_micro + n_stages - 1 ticks; stage 0 ingests
@@ -245,7 +230,11 @@ def pipeline_blocks(blocks, x, state):
     x_arr = x._data if isinstance(x, Tensor) else x
     dtype_like = x_arr.dtype
     wire = jnp.float32 if _cpu_mesh(st['mesh']) else dtype_like
-    base_key = rng_mod.next_key() if _needs_rng(template) else None
+    # the key ALWAYS threads (a heuristic "does this model draw RNG?"
+    # check would silently bake one mask per trace for any dropout form
+    # it missed — e.g. a direct F.dropout call); unused keys cost a few
+    # fold_ins per tick and are DCE'd by XLA
+    base_key = rng_mod.next_key()
 
     def pp_body(stacked_local, micro, *key_in):
         local = {n: a[0] for n, a in stacked_local.items()}  # strip pp dim
@@ -272,8 +261,7 @@ def pipeline_blocks(blocks, x, state):
     return Tensor(out, stop_gradient=False)
 
 
-def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None,
-                       rng_from=None):
+def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
     """GPipe over heterogeneous per-stage callables (PipelineLayer
     segments): lax.switch picks this rank's segment each tick. Segment
     boundaries must be like-shaped (switch/ppermute need one aval).
@@ -323,8 +311,7 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None,
     pdtypes = {n: a.dtype for n, a in params.items()}
     boundary = ({n: a.astype(jnp.float32) for n, a in params.items()}
                 if cpu else params)
-    base_key = (rng_mod.next_key()
-                if rng_from is not None and _needs_rng(rng_from) else None)
+    base_key = rng_mod.next_key()  # always threads; see pipeline_blocks
 
     def pp_body(params_in, micro, *key_in):
         if cpu:

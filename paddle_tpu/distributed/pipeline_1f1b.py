@@ -38,7 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..framework import functional as func_mod
 from ..framework import random as rng_mod
 from ..framework.core import Tensor
-from .pipeline import _cpu_mesh, _needs_rng, _null_ctx
+from .pipeline import _cpu_mesh, _null_ctx
 
 __all__ = ['one_f_one_b_loss', 'supports_1f1b']
 
@@ -66,7 +66,9 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
     # and per step, and the backward's stage RECOMPUTE (jax.vjp of
     # tick_fn at the backward tick) rederives bit-identical masks from
     # the same indices. Reference capability: parallel_layers/random.py.
-    base_key = rng_mod.next_key() if _needs_rng(model) else None
+    # Always threaded: a "does the model draw RNG?" heuristic would
+    # silently bake one mask per trace for any dropout form it missed.
+    base_key = rng_mod.next_key()
     import inspect
     takes_loss = True
     try:

@@ -71,6 +71,16 @@ def _zigzag_i64(v):
     return v - (1 << 64) if v >= (1 << 63) else v
 
 
+def _to_i32(v):
+    """proto2 int32: negatives arrive sign-extended as 64-bit varints
+    (e.g. -1 is 2^64-1), so the 64-bit correction must apply FIRST."""
+    if v >= (1 << 63):
+        v -= (1 << 64)
+    elif v >= (1 << 31):
+        v -= (1 << 32)
+    return v
+
+
 def _f32(raw):
     return struct.unpack('<f', raw)[0]
 
@@ -98,13 +108,8 @@ def _parse_attr(buf):
             name = val.decode()
         elif field == 2:
             atype = val
-        elif field == 3:  # int32 i (negatives arrive as 64-bit varints)
-            v = val
-            if v >= (1 << 63):
-                v -= (1 << 64)
-            elif v >= (1 << 31):
-                v -= (1 << 32)
-            scalar = v
+        elif field == 3:
+            scalar = _to_i32(val)
         elif field == 4:
             scalar = _f32(val)
         elif field == 5:
@@ -114,9 +119,9 @@ def _parse_attr(buf):
                 p = 0
                 while p < len(val):
                     v, p = _read_varint(val, p)
-                    ints.append(v - (1 << 32) if v >= (1 << 31) else v)
+                    ints.append(_to_i32(v))
             else:
-                ints.append(val - (1 << 32) if val >= (1 << 31) else val)
+                ints.append(_to_i32(val))
         elif field == 7:
             if wire == 2 and len(val) != 4:
                 floats.extend(struct.unpack('<%df' % (len(val) // 4), val))
@@ -664,11 +669,17 @@ def _init_table():
         x = scope[op.input('Input')[0]]     # NCHW
         w = scope[op.input('Filter')[0]]    # OIHW
         strides = tuple(op.attr('strides', [1, 1]))
-        pads = op.attr('paddings', [0, 0])
-        if len(pads) == 2:
-            padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+        algo = op.attr('padding_algorithm', 'EXPLICIT')
+        if algo == 'SAME':
+            padding = 'SAME'
+        elif algo == 'VALID':
+            padding = 'VALID'
         else:
-            padding = [(pads[0], pads[1]), (pads[2], pads[3])]
+            pads = op.attr('paddings', [0, 0])
+            if len(pads) == 2:
+                padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+            else:
+                padding = [(pads[0], pads[1]), (pads[2], pads[3])]
         dil = tuple(op.attr('dilations', [1, 1]))
         groups = op.attr('groups', 1)
         out = lax.conv_general_dilated(
@@ -784,7 +795,12 @@ def _init_table():
         idx = [slice(None)] * x.ndim
         for ax, st, en in zip(axes, starts, ends):
             idx[ax] = slice(st, en)
-        scope[op.output('Out')[0]] = x[tuple(idx)]
+        out = x[tuple(idx)]
+        dec = op.attr('decrease_axis', [])
+        if dec:
+            # dygraph-exported x[0]-style slices squeeze the unit dims
+            out = jnp.squeeze(out, axis=tuple(dec))
+        scope[op.output('Out')[0]] = out
 
 
 _init_table()

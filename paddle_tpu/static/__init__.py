@@ -359,9 +359,57 @@ class LoadedProgram:
         return self._exported.call(args)
 
 
+class FluidLoadedProgram(LoadedProgram):
+    """A reference-produced inference model (__model__ ProgramDesc +
+    LoDTensor params, inference/fluid_program.py) served through the same
+    Executor.run contract as our own artifacts — the fluid
+    load_inference_model + executor path of the reference book tests
+    (fluid/io.py load_inference_model; analysis_predictor.cc:201)."""
+
+    def __init__(self, fluid_prog):
+        self.feed_names = list(fluid_prog.feed_names)
+        self.n_fetch = len(fluid_prog.fetch_names)
+        self._fluid = fluid_prog
+
+    def __call__(self, feed):
+        return self._fluid.run(feed)
+
+
+def _fluid_artifact_candidate(path_prefix, model_filename=None):
+    """Path of a reference-format ProgramDesc under `path_prefix`, or
+    None when path_prefix is one of our own artifact prefixes."""
+    if os.path.isdir(path_prefix):
+        if model_filename:
+            return os.path.join(path_prefix, model_filename)
+        if os.path.exists(os.path.join(path_prefix, '__model__')):
+            return path_prefix
+        if any(f.endswith('.pdmodel') for f in os.listdir(path_prefix)):
+            return path_prefix
+        return None
+    if path_prefix.endswith('.pdmodel') or \
+            os.path.basename(path_prefix) == '__model__':
+        return path_prefix
+    return None
+
+
 def load_inference_model(path_prefix, executor, **kwargs):
     """Returns [program, feed_target_names, fetch_targets] (paddle order);
-    run via exe.run(program, feed={...}, fetch_list=fetch_targets)."""
+    run via exe.run(program, feed={...}, fetch_list=fetch_targets).
+
+    Accepts BOTH our own save_inference_model artifacts (path prefix) and
+    reference-produced model directories (__model__ / *.pdmodel +
+    LoDTensor params; pass model_filename/params_filename for combined
+    layouts, as in the reference API)."""
+    model_filename = kwargs.get('model_filename')
+    params_filename = kwargs.get('params_filename')
+    cand = _fluid_artifact_candidate(path_prefix, model_filename)
+    if cand is not None:
+        from ..inference.fluid_program import load_fluid_model
+        pp = params_filename
+        if pp and os.path.isdir(path_prefix):
+            pp = os.path.join(path_prefix, pp)
+        prog = FluidLoadedProgram(load_fluid_model(cand, pp))
+        return [prog, list(prog.feed_names), list(range(prog.n_fetch))]
     from ..framework.io_save import load as _load
     payload = _load(path_prefix + '.pdmodel')
     prog = LoadedProgram(payload['feed_names'], payload['exported'],

@@ -73,6 +73,39 @@ def _pp_mesh(pp=2):
     return Mesh(devs, ('pp',))
 
 
+class _FnDropBlock(nn.Layer):
+    """Dropout via a DIRECT functional call — no nn.Dropout module, no
+    float attr. The key threading must not depend on detecting dropout
+    structurally (r4 review regression)."""
+
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(16, 16)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+        return F.dropout(self.lin(x), p=0.5,
+                         training=self.training)
+
+
+def test_gpipe_functional_dropout_masks_differ_per_microbatch():
+    """F.dropout called directly inside a pp block still gets per-
+    microbatch masks (keys thread unconditionally, not by heuristic)."""
+    paddle.seed(13)
+    blocks = [_FnDropBlock() for _ in range(2)]
+    for b in blocks:
+        b.train()
+    state = make_pp_state(_pp_mesh(2), n_stages=2, n_micro=4)
+    rng = np.random.RandomState(2)
+    row = rng.randn(2, 16).astype(np.float32)
+    x = paddle.to_tensor(np.tile(row, (4, 1)))
+    out = pipeline_blocks(blocks, x, state).numpy()
+    mbs = out.reshape(4, 2, 16)
+    assert all(not np.allclose(mbs[i], mbs[j])
+               for i in range(4) for j in range(i + 1, 4)), \
+        'functional dropout repeated masks across microbatches'
+
+
 def test_gpipe_dropout_masks_differ_per_microbatch():
     """Identical microbatch contents -> different outputs per microbatch
     iff the mask is folded per microbatch (the r3 behavior repeated one

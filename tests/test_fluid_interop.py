@@ -327,11 +327,32 @@ def test_loader_direct_api_and_trailing_byte_guard(tmp_path):
         load_fluid_model(str(d / '__model__'), str(d / '__params__'))
 
 
+def test_executor_load_inference_model_serves_reference_dir(tmp_path):
+    """The fluid-era path: static.load_inference_model on a reference
+    model dir + Executor.run (the reference book tests' serving idiom)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    d, w, b = _fit_a_line_dir(tmp_path, combined=False)
+    exe = static.Executor()
+    prog, feeds, fetches = static.load_inference_model(str(d), exe)
+    assert feeds == ['x']
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 13).astype(np.float32)
+    out, = exe.run(prog, feed={'x': x}, fetch_list=fetches)
+    np.testing.assert_allclose(out, x @ w + b, rtol=1e-5, atol=1e-6)
+
+
 def test_parser_roundtrips_negative_and_attr_types(tmp_path):
     blk = _block([_var('v', dims=[-1, 7])],
                  [_op('scale', [('X', ['v'])], [('Out', ['v2'])],
                       [('scale', 1, 2.5), ('bias', 1, -1.0),
-                       ('bias_after_scale', 6, True)])])
+                       ('bias_after_scale', 6, True)]),
+                  _op('reshape2', [('X', ['v2'])], [('Out', ['v3'])],
+                      [('shape', 3, [-1, 7])]),
+                  _op('slice', [('Input', ['v3'])], [('Out', ['v4'])],
+                      [('axes', 3, [0]), ('starts', 3, [0]),
+                       ('ends', 3, [1]), ('decrease_axis', 3, [0])])])
     blocks = parse_program_desc(_program([blk]))
     v = blocks[0].vars['v']
     assert v.shape == [-1, 7]
@@ -340,3 +361,38 @@ def test_parser_roundtrips_negative_and_attr_types(tmp_path):
     assert op.attr('scale') == pytest.approx(2.5)
     assert op.attr('bias') == pytest.approx(-1.0)
     assert op.attr('bias_after_scale') is True
+    # negative INTS arrive sign-extended as 64-bit varints (proto2):
+    # the common reshape2(shape=[-1, C]) case must decode to -1
+    assert blocks[0].ops[1].attr('shape') == [-1, 7]
+    assert blocks[0].ops[2].attr('decrease_axis') == [0]
+
+
+def test_reshape_neg1_and_decrease_axis_execute(tmp_path):
+    """End-to-end: a program using reshape2([-1, C]) and a
+    decrease_axis slice runs and matches numpy."""
+    variables = [
+        _var('feed', vtype=9, persistable=True),
+        _var('fetch', vtype=10, persistable=True),
+        _var('x', dims=[-1, 2, 6]),
+        _var('r', dims=[-1, 6]),
+        _var('row', dims=[6]),
+    ]
+    ops = [
+        _op('feed', [('X', ['feed'])], [('Out', ['x'])], [('col', 0, 0)]),
+        _op('reshape2', [('X', ['x'])], [('Out', ['r'])],
+            [('shape', 3, [-1, 6])]),
+        _op('slice', [('Input', ['r'])], [('Out', ['row'])],
+            [('axes', 3, [0]), ('starts', 3, [0]), ('ends', 3, [1]),
+             ('decrease_axis', 3, [0])]),
+        _op('fetch', [('X', ['row'])], [('Out', ['fetch'])],
+            [('col', 0, 0)]),
+    ]
+    d = tmp_path / 'negshape'
+    d.mkdir()
+    (d / '__model__').write_bytes(_program([_block(variables, ops)]))
+    prog = load_fluid_model(str(d))
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 2, 6).astype(np.float32)
+    out, = prog.run({'x': x})
+    assert out.shape == (6,)
+    np.testing.assert_allclose(out, x.reshape(-1, 6)[0], rtol=1e-6)

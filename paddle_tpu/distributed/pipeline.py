@@ -113,9 +113,9 @@ def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
 
     stage_apply(x_array, stage_id, tick_key) -> y_array, like-shaped
     with x. micro: [n_micro, mb, ...]; returns [n_micro, mb, ...].
-    base_key (or None): per-step PRNG key; each tick derives
-    fold_in(base_key, microbatch_index) so dropout masks differ per
-    microbatch (and per step, the base key being per-step).
+    base_key: per-step PRNG key (callers always thread one); each tick
+    derives fold_in(base_key, microbatch_index) so dropout masks differ
+    per microbatch (and per step, the base key being per-step).
     """
     stage = lax.axis_index(axis)
     n_ticks = n_micro + n_stages - 1
@@ -128,12 +128,10 @@ def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
     def tick(buf, t):
         idx = jnp.clip(t, 0, n_micro - 1)
         inject = jnp.where(stage == 0, micro[idx], buf).astype(dtype_like)
-        tick_key = None
-        if base_key is not None:
-            # key by the microbatch THIS stage is processing (t - stage),
-            # so a microbatch keeps one mask set as it moves down the pipe
-            i_mb = jnp.clip(t - stage, 0, n_micro - 1)
-            tick_key = jax.random.fold_in(base_key, i_mb)
+        # key by the microbatch THIS stage is processing (t - stage),
+        # so a microbatch keeps one mask set as it moves down the pipe
+        i_mb = jnp.clip(t - stage, 0, n_micro - 1)
+        tick_key = jax.random.fold_in(base_key, i_mb)
         y = stage_apply(inject, stage, tick_key)
         nxt = lax.ppermute(y.astype(wire), axis,
                            [(i, (i + 1) % n_stages)
@@ -177,10 +175,13 @@ def pipeline_blocks(blocks, x, state):
     n_stages, n_micro, axis = st['n_stages'], st['n_micro'], st['axis']
     blocks = list(blocks)
     n_layers = len(blocks)
-    if n_layers % n_stages:
-        raise ValueError('n_layers %d %% pp %d != 0'
-                         % (n_layers, n_stages))
-    per = n_layers // n_stages
+    # uneven layer counts: pad the stack to pp*ceil(n/pp) with zero
+    # "ghost" layers masked to identity in the stage scan (their compute
+    # is wasted but their output — and gradient contribution — is
+    # discarded by the select; the reference's seg_method splits layer
+    # counts unevenly the same way, pp_layers.py:76)
+    per = -(-n_layers // n_stages)
+    n_pad = n_stages * per - n_layers
     template = blocks[0]
     if any(b is not None for _, b in template.named_buffers()):
         raise NotImplementedError(
@@ -190,19 +191,21 @@ def pipeline_blocks(blocks, x, state):
 
     # stack per-layer params: {name: [pp, per, ...]}. The storage params
     # stay ordinary named entries (optimizer/shardings unchanged); the
-    # stack happens in-graph, and its transpose un-stacks the grads.
+    # stack happens in-graph, and its transpose un-stacks the grads
+    # (ghost entries are constants — no grad flows to them).
     stacked = {}
     for n in pnames:
         arrs = [dict(b.named_parameters())[n]._data for b in blocks]
         a = jnp.stack(arrs)
+        if n_pad:
+            a = jnp.concatenate(
+                [a, jnp.zeros((n_pad,) + a.shape[1:], a.dtype)])
         stacked[n] = a.reshape((n_stages, per) + a.shape[1:])
 
     remat = st['remat']
 
-    def apply_layer(xb, layer_params, layer_key=None):
-        ctx = (rng_mod.key_scope(layer_key) if layer_key is not None
-               else _null_ctx())
-        with ctx:
+    def apply_layer(xb, layer_params, layer_key):
+        with rng_mod.key_scope(layer_key):
             out, _ = func_mod.functional_call(
                 template, layer_params, {},
                 args=(Tensor(xb, stop_gradient=False),))
@@ -211,20 +214,17 @@ def pipeline_blocks(blocks, x, state):
     def stage_apply(xb, stage_id, tick_key):
         # params for THIS rank's stage arrive with the pp dim localized
         def body(c, xs):
-            f = apply_layer
-            if remat:
-                f = jax.checkpoint(apply_layer)
-            if tick_key is None:
-                return f(c, xs), None
-            lp, lk = xs
-            return f(c, lp, lk), None
-        xs = stage_apply.params
-        if tick_key is not None:
-            # decorrelate by GLOBAL layer index: stage*per + local j
-            lkeys = jax.vmap(lambda j: jax.random.fold_in(
-                tick_key, stage_id * per + j))(jnp.arange(per))
-            xs = (xs, lkeys)
-        y, _ = lax.scan(body, xb, xs)
+            lp, lk, j = xs
+            f = jax.checkpoint(apply_layer) if remat else apply_layer
+            out = f(c, lp, lk)
+            if n_pad:
+                out = jnp.where(stage_id * per + j < n_layers, out, c)
+            return out, None
+        # decorrelate by GLOBAL layer index: stage*per + local j
+        lkeys = jax.vmap(lambda j: jax.random.fold_in(
+            tick_key, stage_id * per + j))(jnp.arange(per))
+        y, _ = lax.scan(body, xb,
+                        (stage_apply.params, lkeys, jnp.arange(per)))
         return y
 
     x_arr = x._data if isinstance(x, Tensor) else x
@@ -236,27 +236,20 @@ def pipeline_blocks(blocks, x, state):
     # fold_ins per tick and are DCE'd by XLA
     base_key = rng_mod.next_key()
 
-    def pp_body(stacked_local, micro, *key_in):
+    def pp_body(stacked_local, micro, key_in):
         local = {n: a[0] for n, a in stacked_local.items()}  # strip pp dim
         stage_apply.params = local
         return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
-                           dtype_like, wire,
-                           base_key=key_in[0] if key_in else None)
+                           dtype_like, wire, base_key=key_in)
 
-    in_specs = [{n: P(axis) for n in stacked}, P()]
-    operands = [stacked]
-    if base_key is not None:
-        in_specs.append(P())
-    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=tuple(in_specs),
+    fn = jax.shard_map(pp_body, mesh=st['mesh'],
+                       in_specs=({n: P(axis) for n in stacked}, P(), P()),
                        out_specs=P(), axis_names={axis}, check_vma=False)
     # the replicated micro operand crosses the boundary in the wire dtype:
     # its transpose is a psum over pp (f32 on CPU, see _cpu_mesh; the
     # stacked params are pp-sharded so their transpose needs no psum)
     micro = _split_micro(x_arr, n_micro).astype(wire)
-    operands.append(micro)
-    if base_key is not None:
-        operands.append(base_key)
-    out = fn(*operands)
+    out = fn(stacked, micro, base_key)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 
@@ -313,27 +306,22 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
                 if cpu else params)
     base_key = rng_mod.next_key()  # always threads; see pipeline_blocks
 
-    def pp_body(params_in, micro, *key_in):
+    def pp_body(params_in, micro, key_in):
         if cpu:
             params_in = {n: a.astype(pdtypes[n])
                          for n, a in params_in.items()}
         restore = rebind(params_in) if rebind is not None else None
         try:
             return _gpipe_loop(stage_apply, micro, n_stages, n_micro,
-                               axis, dtype_like, wire,
-                               base_key=key_in[0] if key_in else None)
+                               axis, dtype_like, wire, base_key=key_in)
         finally:
             if restore is not None:
                 restore()
 
-    in_specs = [{n: P() for n in params}, P()]
-    operands = [boundary, _split_micro(x_arr, n_micro).astype(wire)]
-    if base_key is not None:
-        in_specs.append(P())
-        operands.append(base_key)
-    fn = jax.shard_map(pp_body, mesh=st['mesh'], in_specs=tuple(in_specs),
+    fn = jax.shard_map(pp_body, mesh=st['mesh'],
+                       in_specs=({n: P() for n in params}, P(), P()),
                        out_specs=P(), axis_names={axis}, check_vma=False)
-    out = fn(*operands)
+    out = fn(boundary, _split_micro(x_arr, n_micro).astype(wire), base_key)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
     return Tensor(out, stop_gradient=False)
 

@@ -38,7 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..framework import functional as func_mod
 from ..framework import random as rng_mod
 from ..framework.core import Tensor
-from .pipeline import _cpu_mesh, _null_ctx
+from .pipeline import _cpu_mesh
 
 __all__ = ['one_f_one_b_loss', 'supports_1f1b']
 
@@ -87,9 +87,12 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 'passed to the train step' % type(model).__name__)
         pre_fn, blocks, post_fn = model.pp_decompose()
     blocks = list(blocks)
-    if len(blocks) % pp:
-        raise ValueError('n_layers %d %% pp %d != 0' % (len(blocks), pp))
-    per = len(blocks) // pp
+    n_layers = len(blocks)
+    # uneven layer counts pad to pp*ceil(n/pp) with zero ghost layers
+    # masked to identity (see pipeline.pipeline_blocks; grads to ghosts
+    # are discarded — unstack_grads reads only the real entries)
+    per = -(-n_layers // pp)
+    n_pad = pp * per - n_layers
     template = blocks[0]
     block_pnames = {}  # stacked name -> [per-layer full names]
     tmpl_names = [n for n, _ in template.named_parameters()]
@@ -125,6 +128,9 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
         for n in tmpl_names:
             arrs = [pdict[fn2] for fn2 in full_names[n]]
             a = jnp.stack(arrs)
+            if n_pad:
+                a = jnp.concatenate(
+                    [a, jnp.zeros((n_pad,) + a.shape[1:], a.dtype)])
             out[n] = a.reshape((pp, per) + a.shape[1:])
         return out
 
@@ -166,12 +172,11 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
 
         wire = jnp.float32 if cpu else jnp.dtype(x_shape_dtype.dtype)
 
-        def body(stacked_local, outer_p, ids_all, lbl_all, *key_in):
+        def body(stacked_local, outer_p, ids_all, lbl_all, key_b):
             if cpu:
                 outer_p = {n: a.astype(pdtypes[n])
                            for n, a in outer_p.items()}
             local = {n: a[0] for n, a in stacked_local.items()}
-            key_b = key_in[0] if key_in else None
             r = lax.axis_index(axis)
             last = pp - 1
             T = n_micro + 2 * (pp - 1)
@@ -189,11 +194,8 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 branch stay consistent)."""
                 ids_mb = ids_all[i_mb]
                 lbl_mb = lbl_all[i_mb]
-                key_mb = (jax.random.fold_in(key_b, i_mb)
-                          if key_b is not None else None)
-                pre_ctx = (rng_mod.key_scope(jax.random.fold_in(key_mb, 0))
-                           if key_mb is not None else _null_ctx())
-                with pre_ctx:
+                key_mb = jax.random.fold_in(key_b, i_mb)
+                with rng_mod.key_scope(jax.random.fold_in(key_mb, 0)):
                     x0 = lax.cond(
                         r == 0,
                         lambda xi: _call_pre(model, pre_fn, outer_params,
@@ -202,27 +204,22 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                         x_in.astype(x_dtype))
 
                 def layer(c, xs):
-                    if key_mb is None:
-                        lp, ctx = xs, _null_ctx()
-                    else:
-                        lp, lk = xs
-                        ctx = rng_mod.key_scope(lk)
-                    with ctx:
+                    lp, lk, j = xs
+                    with rng_mod.key_scope(lk):
                         out, _ = func_mod.functional_call(
                             template, lp, {},
                             args=(Tensor(c, stop_gradient=False),))
+                    if n_pad:
+                        # ghost (padding) layers act as identity
+                        out = jnp.where(r * per + j < n_layers, out, c)
                     return out, None
-                xs = local_blocks
-                if key_mb is not None:
-                    # decorrelate by GLOBAL layer index r*per + j
-                    lkeys = jax.vmap(lambda j: jax.random.fold_in(
-                        key_mb, 1 + r * per + j))(jnp.arange(per))
-                    xs = (local_blocks, lkeys)
-                y, _ = lax.scan(layer, x0, xs)
-                post_ctx = (rng_mod.key_scope(
-                    jax.random.fold_in(key_mb, 99991))
-                    if key_mb is not None else _null_ctx())
-                with post_ctx:
+                # decorrelate by GLOBAL layer index r*per + j
+                lkeys = jax.vmap(lambda j: jax.random.fold_in(
+                    key_mb, 1 + r * per + j))(jnp.arange(per))
+                y, _ = lax.scan(layer, x0,
+                                (local_blocks, lkeys, jnp.arange(per)))
+                with rng_mod.key_scope(jax.random.fold_in(key_mb,
+                                                          99991)):
                     mb_loss = lax.cond(
                         r == last,
                         lambda yy: _call_post(model, post_fn, outer_params,
@@ -299,18 +296,15 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
             g_blocks = {n: a[None] for n, a in carry['g_blocks'].items()}
             return loss, g_outer, g_blocks
 
-        in_specs = [{n: P(axis) for n in stacked},
-                    {n: P() for n in outer_in}, P(), P()]
-        operands = [stacked, outer_in, micro_ids, micro_lbl]
-        if base_key is not None:
-            in_specs.append(P())
-            operands.append(key_in)
+        in_specs = ({n: P(axis) for n in stacked},
+                    {n: P() for n in outer_in}, P(), P(), P())
         out_specs = (P(), {n: P() for n in outer_in},
                      {n: P(axis) for n in stacked})
-        fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+        fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
                            out_specs=out_specs, axis_names={axis},
                            check_vma=False)
-        loss, g_outer, g_blocks = fn(*operands)
+        loss, g_outer, g_blocks = fn(stacked, outer_in, micro_ids,
+                                     micro_lbl, key_in)
         grads = {}
         for n, a in g_outer.items():
             grads[n] = a.astype(params[n].dtype)
@@ -322,8 +316,7 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                 grads[n] = jnp.zeros_like(params[n])
         return loss, grads
 
-    return pp_loss(params, base_key if base_key is not None
-                   else jnp.zeros((2,), jnp.uint32))
+    return pp_loss(params, base_key)
 
 
 def _call_pre(model, pre_fn, pdict, ids_arr):

@@ -18,6 +18,7 @@ The protobuf wire parsing is hand-rolled (proto2 subset: varint / 64-bit /
 length-delimited / 32-bit fields) like onnx.py's hand-rolled writer — no
 protobuf runtime dependency.
 """
+import functools
 import os
 import struct
 
@@ -525,9 +526,275 @@ def _init_table():
     _act('square', jnp.square)
     _act('abs', jnp.abs)
     _act('relu6', lambda x: jnp.clip(x, 0, 6))
-    _act('leaky_relu', lambda x: jnp.where(x > 0, x, 0.02 * x))
     _act('hard_swish', lambda x: x * jnp.clip(x + 3, 0, 6) / 6)
     _act('hard_sigmoid', lambda x: jnp.clip(0.2 * x + 0.5, 0, 1))
+    _act('swish', lambda x: x * jax.nn.sigmoid(x))
+    _act('mish', lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+    _act('softplus', jax.nn.softplus)
+    _act('log_softmax', lambda x: jax.nn.log_softmax(x, axis=-1))
+    _act('floor', jnp.floor)
+    _act('ceil', jnp.ceil)
+    _act('round', jnp.round)
+    _act('sign', jnp.sign)
+    _act('reciprocal', lambda x: 1.0 / x)
+    _act('logical_not', jnp.logical_not)
+
+    @_op('leaky_relu')
+    def _leaky_relu(op, scope):
+        x = scope[op.input('X')[0]]
+        a = op.attr('alpha', 0.02)
+        scope[op.output('Out')[0]] = jnp.where(x > 0, x, a * x)
+
+    @_op('gelu')
+    def _gelu(op, scope):
+        x = scope[op.input('X')[0]]
+        approx = 'tanh' if op.attr('approximate', False) else 'none'
+        scope[op.output('Out')[0]] = jax.nn.gelu(
+            x, approximate=(approx == 'tanh'))
+
+    @_op('elu')
+    def _elu(op, scope):
+        x = scope[op.input('X')[0]]
+        a = op.attr('alpha', 1.0)
+        scope[op.output('Out')[0]] = jnp.where(
+            x > 0, x, a * (jnp.exp(x) - 1))
+
+    @_op('prelu')
+    def _prelu(op, scope):
+        x = scope[op.input('X')[0]]
+        alpha = scope[op.input('Alpha')[0]]
+        if op.attr('mode', 'all') == 'channel' and x.ndim >= 2:
+            alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+        scope[op.output('Out')[0]] = jnp.where(x > 0, x, alpha * x)
+
+    @_op('pow')
+    def _pow(op, scope):
+        x = scope[op.input('X')[0]]
+        scope[op.output('Out')[0]] = x ** op.attr('factor', 1.0)
+
+    # -- comparison (Out is bool) -------------------------------------------
+    for cmp_name, cmp_fn in (('equal', jnp.equal),
+                             ('not_equal', jnp.not_equal),
+                             ('greater_than', jnp.greater),
+                             ('greater_equal', jnp.greater_equal),
+                             ('less_than', jnp.less),
+                             ('less_equal', jnp.less_equal)):
+        def _cmp(op, scope, fn=cmp_fn):
+            scope[op.output('Out')[0]] = fn(scope[op.input('X')[0]],
+                                            scope[op.input('Y')[0]])
+        FLUID_OP_TABLE[cmp_name] = _cmp
+    _ew('elementwise_floordiv', jnp.floor_divide)
+    _ew('elementwise_mod', jnp.mod)
+
+    # -- reductions ---------------------------------------------------------
+    for red_name, red_fn in (('reduce_max', jnp.max),
+                             ('reduce_min', jnp.min),
+                             ('reduce_prod', jnp.prod)):
+        def _red(op, scope, fn=red_fn):
+            x = scope[op.input('X')[0]]
+            dims = tuple(op.attr('dim', [0])) or None
+            if op.attr('reduce_all', False):
+                dims = None
+            scope[op.output('Out')[0]] = fn(
+                x, axis=dims, keepdims=op.attr('keep_dim', False))
+        FLUID_OP_TABLE[red_name] = _red
+
+    @_op('stack')
+    def _stack(op, scope):
+        xs = [scope[n] for n in op.input('X')]
+        scope[op.output('Y')[0]] = jnp.stack(xs, axis=op.attr('axis', 0))
+
+    @_op('split')
+    def _split(op, scope):
+        x = scope[op.input('X')[0]]
+        axis = op.attr('axis', 0)
+        sections = list(op.attr('sections', []))
+        outs = op.output('Out')
+        if sections:
+            if sections.count(-1) > 1:
+                raise ValueError('split: at most one -1 section')
+            if -1 in sections:
+                known = sum(s for s in sections if s != -1)
+                sections[sections.index(-1)] = x.shape[axis] - known
+            idx = np.cumsum(sections[:-1]).tolist()
+            parts = jnp.split(x, idx, axis=axis)
+        else:
+            parts = jnp.split(x, op.attr('num', len(outs)), axis=axis)
+        for name, part in zip(outs, parts):
+            scope[name] = part
+
+    @_op('shape')
+    def _shape(op, scope):
+        x = scope[op.input('Input')[0]]
+        scope[op.output('Out')[0]] = jnp.asarray(x.shape, jnp.int32)
+
+    @_op('fill_constant')
+    def _fill_constant(op, scope):
+        shape = [int(s) for s in op.attr('shape', [])]
+        dtype = _np_dtype(op.attr('dtype', 5))
+        scope[op.output('Out')[0]] = jnp.full(shape, op.attr('value', 0.0),
+                                              dtype)
+
+    @_op('expand_v2')
+    def _expand_v2(op, scope):
+        x = scope[op.input('X')[0]]
+        shape = [int(s) for s in op.attr('shape', [])]
+        # paddle aligns x to the target from the RIGHT when the target
+        # rank exceeds x's; -1/0 entries keep x's corresponding dim
+        off = len(shape) - x.ndim
+        if off < 0:
+            raise ValueError('expand_v2: target rank %d < input rank %d'
+                             % (len(shape), x.ndim))
+        full = []
+        for i, s in enumerate(shape):
+            if s in (-1, 0):
+                if i < off:
+                    raise ValueError(
+                        'expand_v2: -1/0 in a dim (%d) with no '
+                        'corresponding input dim' % i)
+                full.append(x.shape[i - off])
+            else:
+                full.append(s)
+        scope[op.output('Out')[0]] = jnp.broadcast_to(x, full)
+
+    @_op('tile')
+    def _tile(op, scope):
+        x = scope[op.input('X')[0]]
+        scope[op.output('Out')[0]] = jnp.tile(
+            x, tuple(op.attr('repeat_times', [1])))
+
+    @_op('clip')
+    def _clip(op, scope):
+        x = scope[op.input('X')[0]]
+        scope[op.output('Out')[0]] = jnp.clip(
+            x, op.attr('min', float('-inf')), op.attr('max', float('inf')))
+
+    @_op('one_hot_v2')
+    def _one_hot_v2(op, scope):
+        x = scope[op.input('X')[0]]
+        depth = op.attr('depth', 1)
+        scope[op.output('Out')[0]] = jax.nn.one_hot(x, depth,
+                                                    dtype=jnp.float32)
+
+    @_op('layer_norm')
+    def _layer_norm(op, scope):
+        x = scope[op.input('X')[0]]
+        ax = op.attr('begin_norm_axis', 1)
+        eps = op.attr('epsilon', 1e-5)
+        red = tuple(range(ax, x.ndim))
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + eps)
+        shape = (1,) * ax + x.shape[ax:]
+        if op.input('Scale'):
+            y = y * scope[op.input('Scale')[0]].reshape(shape)
+        if op.input('Bias'):
+            y = y + scope[op.input('Bias')[0]].reshape(shape)
+        scope[op.output('Y')[0]] = y
+
+    @_op('instance_norm')
+    def _instance_norm(op, scope):
+        x = scope[op.input('X')[0]]  # NCHW
+        eps = op.attr('epsilon', 1e-5)
+        red = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=red, keepdims=True)
+        var = jnp.var(x, axis=red, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + eps)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        if op.input('Scale'):
+            y = y * scope[op.input('Scale')[0]].reshape(shape)
+        if op.input('Bias'):
+            y = y + scope[op.input('Bias')[0]].reshape(shape)
+        scope[op.output('Y')[0]] = y
+
+    def _bilinear_asym(x, out_h, out_w):
+        """align_corners=False, align_mode=1 (asymmetric): src = dst*scale
+        — the fluid-era default, which jax.image.resize (half-pixel)
+        does not implement."""
+        n, c, h, w = x.shape
+        fy = jnp.arange(out_h) * (h / out_h)
+        fx = jnp.arange(out_w) * (w / out_w)
+        y0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (fy - y0).astype(x.dtype)[:, None]
+        wx = (fx - x0).astype(x.dtype)[None, :]
+        g = lambda yy, xx: x[:, :, yy][:, :, :, xx]
+        top = g(y0, x0) * (1 - wx) + g(y0, x1) * wx
+        bot = g(y1, x0) * (1 - wx) + g(y1, x1) * wx
+        return top * (1 - wy) + bot * wy
+
+    def _interp(op, scope, method):
+        x = scope[op.input('Input')[0] if op.input('Input')
+                  else op.input('X')[0]]  # NCHW
+        if op.attr('data_layout', 'NCHW') != 'NCHW':
+            raise NotImplementedError('interp: NCHW only')
+        if op.input('OutSize') or op.input('SizeTensor'):
+            raise NotImplementedError(
+                'interp: dynamic OutSize/SizeTensor inputs are not '
+                'supported — re-export with static out_h/out_w attrs')
+        out_h = op.attr('out_h', -1)
+        out_w = op.attr('out_w', -1)
+        scale = op.attr('scale', [])
+        if (out_h is None or out_h <= 0) and scale:
+            if isinstance(scale, (int, float)):
+                scale = [scale, scale]
+            out_h = int(x.shape[2] * scale[0])
+            out_w = int(x.shape[3] * scale[-1])
+        if not out_h or out_h <= 0 or not out_w or out_w <= 0:
+            raise NotImplementedError(
+                'interp: no usable out_h/out_w attrs or scale')
+        if op.attr('align_corners', False) and method != 'nearest':
+            raise NotImplementedError('interp: align_corners=True not '
+                                      'supported — export with '
+                                      'align_corners=False')
+        if method == 'linear' and op.attr('align_mode', 1) == 1:
+            out = _bilinear_asym(x, out_h, out_w)
+        else:
+            out = jax.image.resize(x, x.shape[:2] + (out_h, out_w),
+                                   method=method)
+        scope[op.output('Out')[0]] = out.astype(x.dtype)
+
+    for iname, imethod in (('nearest_interp', 'nearest'),
+                           ('nearest_interp_v2', 'nearest'),
+                           ('bilinear_interp', 'linear'),
+                           ('bilinear_interp_v2', 'linear')):
+        FLUID_OP_TABLE[iname] = functools.partial(_interp, method=imethod)
+
+    @_op('pad3d')
+    def _pad3d(op, scope):
+        x = scope[op.input('X')[0]]  # NCDHW or NCHW-style use
+        pads = op.attr('paddings', [0] * 6)
+        if op.attr('mode', 'constant') != 'constant':
+            raise NotImplementedError('pad3d: constant mode only')
+        # paddle order: [front, back] per spatial dim, last dim first
+        cfg = [(0, 0), (0, 0)]
+        spatial = x.ndim - 2
+        for d in range(spatial):
+            lo = pads[2 * (spatial - 1 - d)]
+            hi = pads[2 * (spatial - 1 - d) + 1]
+            cfg.append((lo, hi))
+        scope[op.output('Out')[0]] = jnp.pad(
+            x, cfg, constant_values=op.attr('value', 0.0))
+
+    @_op('pad2d')
+    def _pad2d(op, scope):
+        x = scope[op.input('X')[0]]  # NCHW
+        pads = op.attr('paddings', [0, 0, 0, 0])  # t, b, l, r
+        if op.attr('mode', 'constant') != 'constant':
+            raise NotImplementedError('pad2d: constant mode only')
+        scope[op.output('Out')[0]] = jnp.pad(
+            x, [(0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])],
+            constant_values=op.attr('pad_value', 0.0))
+
+    @_op('norm')
+    def _norm(op, scope):
+        x = scope[op.input('X')[0]]
+        ax = op.attr('axis', -1)
+        eps = op.attr('epsilon', 1e-10)
+        scope[op.output('Out')[0]] = x / jnp.sqrt(
+            jnp.sum(x * x, axis=ax, keepdims=True) + eps)
 
     @_op('mul')
     def _mul(op, scope):

@@ -343,6 +343,54 @@ def test_executor_load_inference_model_serves_reference_dir(tmp_path):
     np.testing.assert_allclose(out, x @ w + b, rtol=1e-5, atol=1e-6)
 
 
+def test_extended_op_table_executes(tmp_path):
+    """CNN-era ops beyond the book models: leaky_relu(alpha),
+    layer_norm, nearest_interp_v2, pad2d, split + stack — vs numpy."""
+    variables = [
+        _var('feed', vtype=9, persistable=True),
+        _var('fetch', vtype=10, persistable=True),
+        _var('x', dims=[-1, 2, 4, 4]),
+        _var('lr_out', dims=[-1, 2, 4, 4]),
+        _var('up', dims=[-1, 2, 8, 8]),
+        _var('padded', dims=[-1, 2, 10, 10]),
+        _var('s0', dims=[-1, 1, 10, 10]),
+        _var('s1', dims=[-1, 1, 10, 10]),
+        _var('stacked', dims=[-1, 2, 1, 10, 10]),
+    ]
+    ops = [
+        _op('feed', [('X', ['feed'])], [('Out', ['x'])], [('col', 0, 0)]),
+        _op('leaky_relu', [('X', ['x'])], [('Out', ['lr_out'])],
+            [('alpha', 1, 0.1)]),
+        _op('nearest_interp_v2', [('X', ['lr_out'])], [('Out', ['up'])],
+            [('out_h', 0, 8), ('out_w', 0, 8),
+             ('align_corners', 6, False)]),
+        _op('pad2d', [('X', ['up'])], [('Out', ['padded'])],
+            [('paddings', 3, [1, 1, 1, 1]), ('mode', 2, 'constant'),
+             ('pad_value', 1, 0.0)]),
+        _op('split', [('X', ['padded'])], [('Out', ['s0', 's1'])],
+            [('axis', 0, 1), ('num', 0, 2)]),
+        _op('stack', [('X', ['s0', 's1'])], [('Y', ['stacked'])],
+            [('axis', 0, 1)]),
+        _op('fetch', [('X', ['stacked'])], [('Out', ['fetch'])],
+            [('col', 0, 0)]),
+    ]
+    d = tmp_path / 'ext_ops'
+    d.mkdir()
+    (d / '__model__').write_bytes(_program([_block(variables, ops)]))
+    prog = load_fluid_model(str(d))
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 2, 4, 4).astype(np.float32)
+    out, = prog.run({'x': x})
+
+    ref = np.where(x > 0, x, 0.1 * x)
+    ref = ref.repeat(2, axis=2).repeat(2, axis=3)      # nearest 2x
+    ref = np.pad(ref, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    parts = np.split(ref, 2, axis=1)
+    ref = np.stack(parts, axis=1)
+    assert out.shape == (2, 2, 1, 10, 10)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_parser_roundtrips_negative_and_attr_types(tmp_path):
     blk = _block([_var('v', dims=[-1, 7])],
                  [_op('scale', [('X', ['v'])], [('Out', ['v2'])],

@@ -51,6 +51,18 @@ def _fleet_step(model, strategy):
         model, lambda lg, lb: model.loss(lg, lb), opt, strategy=strategy)
 
 
+def test_gpt_pp4_uneven_layers_matches_dp():
+    """pp=4 over 6 layers (not divisible): ghost identity padding keeps
+    loss parity with dp (reference uneven seg_method, pp_layers.py:76)."""
+    ids, lbl = _batch()
+    ref = _fleet_step(_model(seed=17, layers=6), _strategy())
+    ref_losses = [float(ref(ids, lbl).numpy()) for _ in range(2)]
+    m = _model(seed=17, layers=6)
+    step = _fleet_step(m, _strategy(dp_degree=2, pp_degree=4))
+    losses = [float(step(ids, lbl).numpy()) for _ in range(2)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+
 def test_gpt_pp4_matches_dp():
     """pp=4 GPT fleet step: same losses as the plain dp run."""
     ids, lbl = _batch()
@@ -128,11 +140,20 @@ def test_pipeline_layer_engine_trains():
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-5)
 
 
-def test_pipeline_blocks_rejects_bad_split():
+def test_pipeline_blocks_uneven_split_matches_sequential():
+    """4 layers over 3 stages (r3 raised here): ghost identity padding
+    keeps the pipelined forward equal to the sequential one."""
+    import jax
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.pipeline import pipeline_blocks
     model = _model(layers=4)
-    hcg = HybridCommunicateGroup(dp_degree=2, pp_degree=4)
-    st = make_pp_state(hcg.mesh, n_stages=3)
-    x = paddle.to_tensor(np.zeros((4, 8, 64), np.float32))
-    with pytest.raises(ValueError, match='pp'):
-        from paddle_tpu.distributed.pipeline import pipeline_blocks
-        pipeline_blocks(model.gpt.h, x, st)
+    model.eval()
+    mesh = Mesh(np.array(jax.devices()[:3]), ('pp',))
+    st = make_pp_state(mesh, n_stages=3)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(6, 8, 64).astype(np.float32))
+    out = pipeline_blocks(model.gpt.h, x, st).numpy()
+    ref = x
+    for blk in model.gpt.h:
+        ref = blk(ref)
+    np.testing.assert_allclose(out, ref.numpy(), rtol=2e-4, atol=2e-5)

@@ -70,6 +70,21 @@ def test_1f1b_matches_dp(tie):
     np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4, atol=2e-5)
 
 
+def test_1f1b_uneven_layers_matches_dp():
+    """n_layers=3 with pp=2 (not divisible): the stack pads with a ghost
+    identity layer and still matches the plain dp run — the reference's
+    uneven seg_method capability (pp_layers.py:76)."""
+    ids, lbl = _batch()
+    ref = _fleet_step(_model(seed=21, layers=3), _strategy())
+    ref_losses = [float(ref(ids, lbl).numpy()) for _ in range(2)]
+
+    m = _model(seed=21, layers=3)
+    step = _fleet_step(m, _strategy(schedule='1F1B', dp_degree=4,
+                                    pp_degree=2))
+    losses = [float(step(ids, lbl).numpy()) for _ in range(2)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+
 def test_1f1b_accumulate_steps_honored():
     """accumulate_steps decouples n_micro from pp (VERDICT: >= 2*pp)."""
     ids, lbl = _batch(b=8)

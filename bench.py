@@ -102,7 +102,9 @@ def _run_measurement():
     paddle.seed(0)
     platform = jax.devices()[0].platform
     on_tpu = platform == 'tpu'
-    seq = 512
+    # seq override: long-context rungs (blockwise attention) ride the
+    # same harness — the warmer measures seq 2048/8192 variants
+    seq = int(os.environ.get('PADDLE_TPU_BENCH_SEQ', 512))
     if on_tpu:
         # fail loudly if the Pallas flash kernel cannot run on the chip:
         # a silent jnp fallback would invalidate the number. Since r3 the

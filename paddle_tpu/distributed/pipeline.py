@@ -25,7 +25,6 @@ eval/generation calls between steps run the plain sequential forward.
 Backward is jax AD through scan+ppermute (GPipe: all microbatches forward,
 then reverse); combine with recompute for the activation-memory win.
 """
-import contextlib
 import functools
 
 import numpy as np
@@ -100,12 +99,8 @@ class pp_scope:
         return False
 
 
-def _null_ctx():
-    return contextlib.nullcontext()
-
-
 def _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis, dtype_like,
-                wire_dtype=None, base_key=None):
+                wire_dtype, base_key):
     """The schedule: n_micro + n_stages - 1 ticks; stage 0 ingests
     microbatch t, every stage applies its segment, ppermute rotates
     activations forward; the last stage's outputs are psum-broadcast so

@@ -586,10 +586,12 @@ def _init_table():
     _ew('elementwise_floordiv', jnp.floor_divide)
     _ew('elementwise_mod', jnp.mod)
 
-    # -- reductions ---------------------------------------------------------
+    # -- reductions (one decoder for the whole reduce_* family) ------------
     for red_name, red_fn in (('reduce_max', jnp.max),
                              ('reduce_min', jnp.min),
-                             ('reduce_prod', jnp.prod)):
+                             ('reduce_prod', jnp.prod),
+                             ('reduce_mean', jnp.mean),
+                             ('reduce_sum', jnp.sum)):
         def _red(op, scope, fn=red_fn):
             x = scope[op.input('X')[0]]
             dims = tuple(op.attr('dim', [0])) or None
@@ -606,6 +608,7 @@ def _init_table():
 
     @_op('split')
     def _split(op, scope):
+        _no_dynamic(op, 'AxisTensor', 'SectionsTensorList')
         x = scope[op.input('X')[0]]
         axis = op.attr('axis', 0)
         sections = list(op.attr('sections', []))
@@ -630,6 +633,7 @@ def _init_table():
 
     @_op('fill_constant')
     def _fill_constant(op, scope):
+        _no_dynamic(op, 'ShapeTensor', 'ShapeTensorList', 'ValueTensor')
         shape = [int(s) for s in op.attr('shape', [])]
         dtype = _np_dtype(op.attr('dtype', 5))
         scope[op.output('Out')[0]] = jnp.full(shape, op.attr('value', 0.0),
@@ -637,6 +641,7 @@ def _init_table():
 
     @_op('expand_v2')
     def _expand_v2(op, scope):
+        _no_dynamic(op, 'Shape', 'expand_shapes_tensor')
         x = scope[op.input('X')[0]]
         shape = [int(s) for s in op.attr('shape', [])]
         # paddle aligns x to the target from the RIGHT when the target
@@ -665,6 +670,7 @@ def _init_table():
 
     @_op('clip')
     def _clip(op, scope):
+        _no_dynamic(op, 'Min', 'Max')
         x = scope[op.input('X')[0]]
         scope[op.output('Out')[0]] = jnp.clip(
             x, op.attr('min', float('-inf')), op.attr('max', float('inf')))
@@ -707,6 +713,31 @@ def _init_table():
             y = y + scope[op.input('Bias')[0]].reshape(shape)
         scope[op.output('Y')[0]] = y
 
+    def _no_dynamic(op, *slots):
+        """Raise loudly when a tensor-input override slot is wired (the
+        export relied on runtime shapes/values this static lowering
+        drops — silent fallback to attrs would compute wrong results)."""
+        for s in slots:
+            if op.input(s):
+                raise NotImplementedError(
+                    '%s: dynamic %r tensor input is not supported — '
+                    're-export with static attrs' % (op.type, s))
+
+    def _nearest_fluid(x, out_h, out_w, align_corners):
+        """Fluid nearest sampling: floor(dst*scale) when
+        align_corners=False (asymmetric), round(dst*(h-1)/(out-1)) when
+        True — jax.image.resize's half-pixel centers match neither."""
+        n, c, h, w = x.shape
+        if align_corners and out_h > 1 and out_w > 1:
+            ys = jnp.round(jnp.arange(out_h) * ((h - 1) / (out_h - 1)))
+            xs = jnp.round(jnp.arange(out_w) * ((w - 1) / (out_w - 1)))
+        else:
+            ys = jnp.floor(jnp.arange(out_h) * (h / out_h))
+            xs = jnp.floor(jnp.arange(out_w) * (w / out_w))
+        ys = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xs = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        return x[:, :, ys][:, :, :, xs]
+
     def _bilinear_asym(x, out_h, out_w):
         """align_corners=False, align_mode=1 (asymmetric): src = dst*scale
         — the fluid-era default, which jax.image.resize (half-pixel)
@@ -745,11 +776,14 @@ def _init_table():
         if not out_h or out_h <= 0 or not out_w or out_w <= 0:
             raise NotImplementedError(
                 'interp: no usable out_h/out_w attrs or scale')
-        if op.attr('align_corners', False) and method != 'nearest':
+        align = op.attr('align_corners', False)
+        if align and method != 'nearest':
             raise NotImplementedError('interp: align_corners=True not '
                                       'supported — export with '
                                       'align_corners=False')
-        if method == 'linear' and op.attr('align_mode', 1) == 1:
+        if method == 'nearest':
+            out = _nearest_fluid(x, out_h, out_w, align)
+        elif op.attr('align_mode', 1) == 1:
             out = _bilinear_asym(x, out_h, out_w)
         else:
             out = jax.image.resize(x, x.shape[:2] + (out_h, out_w),
@@ -864,24 +898,6 @@ def _init_table():
     @_op('mean')
     def _mean(op, scope):
         scope[op.output('Out')[0]] = jnp.mean(scope[op.input('X')[0]])
-
-    @_op('reduce_mean')
-    def _reduce_mean(op, scope):
-        x = scope[op.input('X')[0]]
-        dims = tuple(op.attr('dim', [0])) or None
-        if op.attr('reduce_all', False):
-            dims = None
-        scope[op.output('Out')[0]] = jnp.mean(
-            x, axis=dims, keepdims=op.attr('keep_dim', False))
-
-    @_op('reduce_sum')
-    def _reduce_sum(op, scope):
-        x = scope[op.input('X')[0]]
-        dims = tuple(op.attr('dim', [0])) or None
-        if op.attr('reduce_all', False):
-            dims = None
-        scope[op.output('Out')[0]] = jnp.sum(
-            x, axis=dims, keepdims=op.attr('keep_dim', False))
 
     @_op('reshape2')
     def _reshape2(op, scope):

@@ -55,6 +55,18 @@ CONFIGS = [
                                   'PADDLE_TPU_FLASH_STRICT': '0',
                                   'PADDLE_TPU_BENCH_BATCH': '64',
                                   'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    # long-context: blockwise (pure-XLA flash-shape) vs quadratic+remat
+    ('blockwise_seq2048_b8_scan4', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                                    'PADDLE_TPU_FLASH_STRICT': '0',
+                                    'PADDLE_TPU_ATTN_IMPL': 'blockwise',
+                                    'PADDLE_TPU_BENCH_SEQ': '2048',
+                                    'PADDLE_TPU_BENCH_BATCH': '8',
+                                    'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
+    ('quadratic_seq2048_b8_remat_scan4',
+     {'PADDLE_TPU_FLASH_DISABLE': '1', 'PADDLE_TPU_FLASH_STRICT': '0',
+      'PADDLE_TPU_ATTN_IMPL': 'quadratic', 'PADDLE_TPU_BENCH_SEQ': '2048',
+      'PADDLE_TPU_BENCH_BATCH': '8', 'PADDLE_TPU_BENCH_REMAT': '1',
+      'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
 ]
 
 

@@ -822,6 +822,64 @@ def _init_table():
             x, [(0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])],
             constant_values=op.attr('pad_value', 0.0))
 
+    # -- detection (PP-YOLO family serving, BASELINE config 4) --------------
+    # route to the vision implementations (vision/ops.py, vision/
+    # detection.py — numerically validated against the reference in
+    # tests/test_yolo.py + tests/test_detection_ops.py); fixed-size
+    # padded outputs keep the whole program one XLA module
+
+    def _arr(t):
+        return t._data if hasattr(t, '_data') else t
+
+    @_op('yolo_box')
+    def _yolo_box(op, scope):
+        from ..vision.ops import yolo_box
+        x = scope[op.input('X')[0]]
+        img = scope[op.input('ImgSize')[0]]
+        boxes, scores = yolo_box(
+            x, img, anchors=list(op.attr('anchors', [])),
+            class_num=op.attr('class_num', 1),
+            conf_thresh=op.attr('conf_thresh', 0.01),
+            downsample_ratio=op.attr('downsample_ratio', 32),
+            clip_bbox=op.attr('clip_bbox', True),
+            scale_x_y=op.attr('scale_x_y', 1.0),
+            iou_aware=op.attr('iou_aware', False),
+            iou_aware_factor=op.attr('iou_aware_factor', 0.5))
+        scope[op.output('Boxes')[0]] = _arr(boxes)
+        scope[op.output('Scores')[0]] = _arr(scores)
+
+    def _nms_common(op, scope, with_index):
+        from ..vision.detection import multiclass_nms
+        bboxes = scope[op.input('BBoxes')[0]]
+        scores = scope[op.input('Scores')[0]]
+        if op.attr('nms_eta', 1.0) != 1.0:
+            raise NotImplementedError(
+                'multiclass_nms: adaptive NMS (nms_eta != 1) is not '
+                'implemented — suppression would silently use a fixed '
+                'threshold')
+        res = multiclass_nms(
+            bboxes, scores,
+            score_threshold=op.attr('score_threshold', 0.05),
+            nms_top_k=op.attr('nms_top_k', 1000),
+            keep_top_k=op.attr('keep_top_k', 100),
+            nms_threshold=op.attr('nms_threshold', 0.3),
+            normalized=op.attr('normalized', True),
+            background_label=op.attr('background_label', 0),
+            return_index=with_index, return_rois_num=True)
+        scope[op.output('Out')[0]] = _arr(res[0])
+        if with_index and op.output('Index'):
+            scope[op.output('Index')[0]] = _arr(res[1])
+        rois = op.output('NmsRoisNum') or op.output('RoisNum')
+        if rois:
+            scope[rois[0]] = _arr(res[-1])
+
+    FLUID_OP_TABLE['multiclass_nms'] = functools.partial(
+        _nms_common, with_index=False)
+    FLUID_OP_TABLE['multiclass_nms2'] = functools.partial(
+        _nms_common, with_index=True)
+    FLUID_OP_TABLE['multiclass_nms3'] = functools.partial(
+        _nms_common, with_index=True)
+
     @_op('norm')
     def _norm(op, scope):
         x = scope[op.input('X')[0]]

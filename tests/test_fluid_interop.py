@@ -391,6 +391,72 @@ def test_extended_op_table_executes(tmp_path):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
 
 
+def test_yolo_detection_ops_serve(tmp_path):
+    """The real exported PP-YOLO tail — yolo_box (scores [N,M,C]) ->
+    transpose2 ([N,C,M]) -> multiclass_nms3 — through the fluid table,
+    matching the native vision implementations (themselves
+    reference-validated in test_yolo.py)."""
+    na, cls, h = 3, 4, 4
+    c = na * (5 + cls)
+    anchors = [10, 13, 16, 30, 33, 23]
+    variables = [
+        _var('feed', vtype=9, persistable=True),
+        _var('fetch', vtype=10, persistable=True),
+        _var('head', dims=[-1, c, h, h]),
+        _var('imgsz', dims=[-1, 2], dtype=2),       # int32
+        _var('boxes', dims=[-1, na * h * h, 4]),
+        _var('scores_mc', dims=[-1, na * h * h, cls]),
+        _var('scores', dims=[-1, cls, na * h * h]),
+        _var('dets', dims=[-1, 6]),
+        _var('rois_n', dims=[-1], dtype=2),
+    ]
+    ops = [
+        _op('feed', [('X', ['feed'])], [('Out', ['head'])],
+            [('col', 0, 0)]),
+        _op('feed', [('X', ['feed'])], [('Out', ['imgsz'])],
+            [('col', 0, 1)]),
+        _op('yolo_box', [('X', ['head']), ('ImgSize', ['imgsz'])],
+            [('Boxes', ['boxes']), ('Scores', ['scores_mc'])],
+            [('anchors', 3, anchors), ('class_num', 0, cls),
+             ('conf_thresh', 1, 0.01), ('downsample_ratio', 0, 32),
+             ('clip_bbox', 6, True), ('scale_x_y', 1, 1.0)]),
+        _op('transpose2', [('X', ['scores_mc'])], [('Out', ['scores'])],
+            [('axis', 3, [0, 2, 1])]),
+        _op('multiclass_nms3',
+            [('BBoxes', ['boxes']), ('Scores', ['scores'])],
+            [('Out', ['dets']), ('NmsRoisNum', ['rois_n'])],
+            [('score_threshold', 1, 0.01), ('nms_top_k', 0, 10),
+             ('keep_top_k', 0, 5), ('nms_threshold', 1, 0.45),
+             ('normalized', 6, True), ('background_label', 0, -1)]),
+        _op('fetch', [('X', ['dets'])], [('Out', ['fetch'])],
+            [('col', 0, 0)]),
+        _op('fetch', [('X', ['rois_n'])], [('Out', ['fetch'])],
+            [('col', 0, 1)]),
+    ]
+    d = tmp_path / 'yolo_tail'
+    d.mkdir()
+    (d / '__model__').write_bytes(_program([_block(variables, ops)]))
+    prog = load_fluid_model(str(d))
+    rng = np.random.RandomState(8)
+    head = rng.randn(1, c, h, h).astype(np.float32)
+    imgsz = np.array([[128, 128]], np.int32)
+    dets, rois_n = prog.run({'head': head, 'imgsz': imgsz})
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import yolo_box
+    from paddle_tpu.vision.detection import multiclass_nms
+    b_ref, s_ref = yolo_box(paddle.to_tensor(head),
+                            paddle.to_tensor(imgsz), anchors=anchors,
+                            class_num=cls, conf_thresh=0.01,
+                            downsample_ratio=32)
+    s_ref_cm = paddle.transpose(s_ref, [0, 2, 1])  # [N,M,C] -> [N,C,M]
+    out_ref, rois_ref = multiclass_nms(
+        b_ref, s_ref_cm, score_threshold=0.01, nms_top_k=10, keep_top_k=5,
+        nms_threshold=0.45, background_label=-1, return_rois_num=True)
+    np.testing.assert_allclose(dets, out_ref.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(rois_n, rois_ref.numpy())
+
+
 def test_parser_roundtrips_negative_and_attr_types(tmp_path):
     blk = _block([_var('v', dims=[-1, 7])],
                  [_op('scale', [('X', ['v'])], [('Out', ['v2'])],

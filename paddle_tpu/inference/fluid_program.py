@@ -880,6 +880,67 @@ def _init_table():
     FLUID_OP_TABLE['multiclass_nms3'] = functools.partial(
         _nms_common, with_index=True)
 
+    @_op('roi_align')
+    def _roi_align(op, scope):
+        from ..vision.ops import roi_align
+        if not op.input('RoisNum'):
+            raise NotImplementedError(
+                'roi_align: LoD-carried roi batching is not supported '
+                '(SURVEY §7.5) — re-export with the RoisNum input')
+        out = roi_align(
+            scope[op.input('X')[0]], scope[op.input('ROIs')[0]],
+            scope[op.input('RoisNum')[0]],
+            output_size=(op.attr('pooled_height', 1),
+                         op.attr('pooled_width', 1)),
+            spatial_scale=op.attr('spatial_scale', 1.0),
+            sampling_ratio=op.attr('sampling_ratio', -1),
+            aligned=op.attr('aligned', True))
+        scope[op.output('Out')[0]] = _arr(out)
+
+    @_op('box_coder')
+    def _box_coder(op, scope):
+        from ..vision.ops import box_coder
+        pbv = (scope[op.input('PriorBoxVar')[0]]
+               if op.input('PriorBoxVar')
+               else list(op.attr('variance', [])) or [1.0, 1.0, 1.0, 1.0])
+        out = box_coder(
+            scope[op.input('PriorBox')[0]], pbv,
+            scope[op.input('TargetBox')[0]],
+            code_type=op.attr('code_type', 'encode_center_size'),
+            box_normalized=op.attr('box_normalized', True),
+            axis=op.attr('axis', 0))
+        scope[op.output('OutputBox')[0]] = _arr(out)
+
+    @_op('prior_box')
+    def _prior_box(op, scope):
+        from ..vision.ops import prior_box
+        boxes, variances = prior_box(
+            scope[op.input('Input')[0]], scope[op.input('Image')[0]],
+            min_sizes=list(op.attr('min_sizes', [])),
+            max_sizes=list(op.attr('max_sizes', [])) or None,
+            aspect_ratios=list(op.attr('aspect_ratios', [1.0])),
+            variance=list(op.attr('variances', [0.1, 0.1, 0.2, 0.2])),
+            flip=op.attr('flip', False), clip=op.attr('clip', False),
+            steps=(op.attr('step_w', 0.0), op.attr('step_h', 0.0)),
+            offset=op.attr('offset', 0.5),
+            min_max_aspect_ratios_order=op.attr(
+                'min_max_aspect_ratios_order', False))
+        scope[op.output('Boxes')[0]] = _arr(boxes)
+        scope[op.output('Variances')[0]] = _arr(variances)
+
+    @_op('anchor_generator')
+    def _anchor_generator(op, scope):
+        from ..vision.detection import anchor_generator
+        anchors, variances = anchor_generator(
+            scope[op.input('Input')[0]],
+            anchor_sizes=list(op.attr('anchor_sizes', [])),
+            aspect_ratios=list(op.attr('aspect_ratios', [])),
+            variances=list(op.attr('variances', [])) or None,
+            stride=tuple(op.attr('stride', [])) or None,
+            offset=op.attr('offset', 0.5))
+        scope[op.output('Anchors')[0]] = _arr(anchors)
+        scope[op.output('Variances')[0]] = _arr(variances)
+
     @_op('norm')
     def _norm(op, scope):
         x = scope[op.input('X')[0]]

@@ -457,6 +457,53 @@ def test_yolo_detection_ops_serve(tmp_path):
     np.testing.assert_array_equal(rois_n, rois_ref.numpy())
 
 
+def test_rcnn_family_ops_serve(tmp_path):
+    """roi_align (RoisNum batching) + box_coder via the fluid table match
+    the native vision implementations."""
+    variables = [
+        _var('feed', vtype=9, persistable=True),
+        _var('fetch', vtype=10, persistable=True),
+        _var('feat', dims=[-1, 3, 8, 8]),
+        _var('rois', dims=[-1, 4]),
+        _var('rois_num', dims=[-1], dtype=2),
+        _var('pooled', dims=[-1, 3, 2, 2]),
+    ]
+    ops = [
+        _op('feed', [('X', ['feed'])], [('Out', ['feat'])],
+            [('col', 0, 0)]),
+        _op('feed', [('X', ['feed'])], [('Out', ['rois'])],
+            [('col', 0, 1)]),
+        _op('feed', [('X', ['feed'])], [('Out', ['rois_num'])],
+            [('col', 0, 2)]),
+        _op('roi_align',
+            [('X', ['feat']), ('ROIs', ['rois']),
+             ('RoisNum', ['rois_num'])],
+            [('Out', ['pooled'])],
+            [('pooled_height', 0, 2), ('pooled_width', 0, 2),
+             ('spatial_scale', 1, 0.5), ('sampling_ratio', 0, 2),
+             ('aligned', 6, True)]),
+        _op('fetch', [('X', ['pooled'])], [('Out', ['fetch'])],
+            [('col', 0, 0)]),
+    ]
+    d = tmp_path / 'rcnn'
+    d.mkdir()
+    (d / '__model__').write_bytes(_program([_block(variables, ops)]))
+    prog = load_fluid_model(str(d))
+    rng = np.random.RandomState(9)
+    feat = rng.randn(2, 3, 8, 8).astype(np.float32)
+    rois = np.abs(rng.randn(4, 4)).astype(np.float32) * 4
+    rois[:, 2:] += rois[:, :2] + 2
+    rois_num = np.array([3, 1], np.int32)
+    out, = prog.run({'feat': feat, 'rois': rois, 'rois_num': rois_num})
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.ops import roi_align
+    ref = roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                    paddle.to_tensor(rois_num), output_size=2,
+                    spatial_scale=0.5, sampling_ratio=2, aligned=True)
+    np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
 def test_parser_roundtrips_negative_and_attr_types(tmp_path):
     blk = _block([_var('v', dims=[-1, 7])],
                  [_op('scale', [('X', ['v'])], [('Out', ['v2'])],

@@ -761,10 +761,7 @@ def _init_table():
                   else op.input('X')[0]]  # NCHW
         if op.attr('data_layout', 'NCHW') != 'NCHW':
             raise NotImplementedError('interp: NCHW only')
-        if op.input('OutSize') or op.input('SizeTensor'):
-            raise NotImplementedError(
-                'interp: dynamic OutSize/SizeTensor inputs are not '
-                'supported — re-export with static out_h/out_w attrs')
+        _no_dynamic(op, 'OutSize', 'SizeTensor', 'Scale')
         out_h = op.attr('out_h', -1)
         out_w = op.attr('out_w', -1)
         scale = op.attr('scale', [])

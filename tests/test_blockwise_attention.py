@@ -131,6 +131,41 @@ def test_multi_step_under_dp_sharding():
     np.testing.assert_allclose(multi, seq, rtol=1e-4, atol=1e-5)
 
 
+def test_multi_step_composes_with_gradient_merge():
+    """K-step scan over a gradient-merge (k_steps=2) step: the merge's
+    lax.cond carry (acc/micro counters) must thread the scan exactly as
+    in sequential execution."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import TrainStep
+
+    def build():
+        paddle.seed(3)
+        model = paddle.nn.Linear(6, 3)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        return model, TrainStep(
+            model, lambda out, y: paddle.nn.functional.mse_loss(out, y),
+            opt, k_steps=2)
+
+    rng = np.random.RandomState(8)
+    k = 4
+    xs = rng.randn(k, 10, 6).astype(np.float32)
+    ys = rng.randn(k, 10, 3).astype(np.float32)
+
+    model_a, step_a = build()
+    seq = [float(step_a(paddle.to_tensor(xs[i]),
+                        paddle.to_tensor(ys[i])).numpy())
+           for i in range(k)]
+    model_b, step_b = build()
+    multi = step_b.multi_step(paddle.to_tensor(xs),
+                              paddle.to_tensor(ys)).numpy()
+    np.testing.assert_allclose(multi, seq, rtol=1e-5, atol=1e-6)
+    for (na, pa), (nb, pb) in zip(model_a.named_parameters(),
+                                  model_b.named_parameters()):
+        np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+
+
 def test_multi_step_matches_sequential():
     import paddle_tpu as paddle
     from paddle_tpu.framework.functional import TrainStep

@@ -209,12 +209,31 @@ class Predictor:
             return tuple(outs) if len(outs) != 1 else outs[0]
         self._fn = pure
 
+    def _enable_optim_cache(self):
+        """Config.set_optim_cache_dir maps onto jax's persistent
+        compilation cache (the reference persists its IR-pass/TensorRT
+        engine cache there; here the compiled XLA executables persist, so
+        a restarted server skips compilation entirely)."""
+        cache_dir = self._config._cache_dir
+        if not cache_dir:
+            return
+        try:
+            jax.config.update('jax_enable_compilation_cache', True)
+            jax.config.update('jax_compilation_cache_dir', cache_dir)
+            jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                              0)
+            jax.config.update('jax_persistent_cache_min_entry_size_bytes',
+                              -1)
+        except Exception:
+            pass  # older jax without some knob: cache is best-effort
+
     def _load(self):
         from .. import jit as jit_mod
         from ..framework import functional as func_mod
         path = self._config.model_dir()
         if path is None:
             raise ValueError('Config.set_model(path) required')
+        self._enable_optim_cache()
         if self._is_fluid_artifact(path):
             self._load_fluid(path)
             return

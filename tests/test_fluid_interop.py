@@ -457,6 +457,28 @@ def test_yolo_detection_ops_serve(tmp_path):
     np.testing.assert_array_equal(rois_n, rois_ref.numpy())
 
 
+def test_optim_cache_dir_persists_executables(tmp_path):
+    """Config.set_optim_cache_dir -> jax persistent compilation cache:
+    running the predictor populates the directory with compiled
+    executables (restart-warm serving)."""
+    import jax
+    d, w, b = _fit_a_line_dir(tmp_path, combined=False)
+    cache = tmp_path / 'optim_cache'
+    cfg = Config(str(d))
+    cfg.set_optim_cache_dir(str(cache))
+    try:
+        pred = create_predictor(cfg)
+        x = np.random.RandomState(1).randn(2, 13).astype(np.float32)
+        out, = pred.run([x])
+        np.testing.assert_allclose(out, x @ w + b, rtol=1e-5, atol=1e-6)
+        assert cache.exists() and any(cache.iterdir()), \
+            'persistent cache dir not populated'
+    finally:
+        # the knob is process-global; later tests must not write compile
+        # artifacts into this (soon-deleted) tmp dir
+        jax.config.update('jax_compilation_cache_dir', None)
+
+
 def test_rcnn_family_ops_serve(tmp_path):
     """roi_align (RoisNum batching) + box_coder via the fluid table match
     the native vision implementations."""

@@ -213,6 +213,52 @@ def test_gpt_pp2_1f1b_dropout_eval_matches_dropout_free_train_shape():
     np.testing.assert_array_equal(a, b)
 
 
+def test_ring_attention_dropout_unbiased():
+    """Attention-prob dropout in the ring must be UNBIASED: the value
+    accumulation sees the mask but the softmax denominator uses the
+    undropped weights, so E[out] over masks equals undropped attention
+    (the dropout-after-softmax identity)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.ops import ring_attention as ra
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ('sp',))
+    spec = P(None, 'sp', None, None)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+
+    def body(qq, kk, vv, key):
+        rank_key = jax.random.fold_in(key, lax.axis_index('sp'))
+        return ra.ring_attention(qq, kk, vv, axis_name='sp', causal=True,
+                                 dropout_p=0.3, dropout_key=rank_key)
+
+    dropped = jax.jit(shard_map(body, mesh=mesh,
+                                in_specs=(spec, spec, spec, P()),
+                                out_specs=spec, check_rep=False))
+
+    def ref_body(qq, kk, vv):
+        return ra.ring_attention(qq, kk, vv, axis_name='sp', causal=True)
+    ref = shard_map(ref_body, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_rep=False)(q, k, v)
+
+    n = 400
+    acc = np.zeros(q.shape, np.float32)
+    base = jax.random.PRNGKey(7)
+    for i in range(n):
+        acc += np.asarray(dropped(q, k, v, jax.random.fold_in(base, i)))
+    mean = acc / n
+    # SE of the mean ~ |v|*sqrt(p/(1-p))/sqrt(n); loose 4-sigma-ish band
+    np.testing.assert_allclose(mean, np.asarray(ref), atol=0.35)
+    # and a single draw really differs from the undropped output
+    one = np.asarray(dropped(q, k, v, base))
+    assert not np.allclose(one, np.asarray(ref), atol=1e-3)
+
+
 def test_sp_dropout_trains():
     """sp=4 ring attention with dropout (attention-prob + residual):
     builds (the r3 ValueError is gone) and trains with finite losses."""

@@ -26,6 +26,17 @@ from . import random as rng_mod
 __all__ = ['extract_params', 'extract_buffers', 'functional_call', 'TrainStep']
 
 
+def _cast_like(tree, ref):
+    """Cast each array in `tree` back to the dtype of the same-named entry
+    in `ref`. Keeps stored state dtypes stable across a jitted update (the
+    traced f32 lr intentionally promotes the arithmetic, but params,
+    buffers, and optimizer slots must round-trip their dtypes or the
+    lax.scan carry in multi_step mistypes)."""
+    return {k: v.astype(ref[k].dtype)
+            if hasattr(v, 'astype') and k in ref else v
+            for k, v in tree.items()}
+
+
 def extract_params(layer, trainable_only=False):
     """OrderedDict name -> jax array of the layer's parameters."""
     out = {}
@@ -297,9 +308,7 @@ class TrainStep:
                 loss_val = loss_t._data
                 if amp_dtype is not None:
                     loss_val = loss_val.astype(jnp.float32)
-                new_buf = {k: v.astype(buffers[k].dtype)
-                           if hasattr(v, 'astype') and k in buffers else v
-                           for k, v in new_buf.items()}
+                new_buf = _cast_like(new_buf, buffers)
                 if loss_scaling:
                     # differentiate the SCALED loss so fp16 cotangents stay
                     # above the fp16 underflow floor; report the raw loss
@@ -338,7 +347,11 @@ class TrainStep:
                 new_slots = {}
                 new_params = dict(params)
                 for name, g in gdict.items():
-                    p = params[name]
+                    old_slots = opt_state['slots'][name]
+                    master = old_slots.get('master')
+                    # multi_precision: the update rule runs on the f32
+                    # master; the stored param is its rounded shadow
+                    p = master if master is not None else params[name]
                     g = g.astype(p.dtype)
                     meta = pmeta[name]
                     if coeff and not decoupled:
@@ -350,10 +363,11 @@ class TrainStep:
                             (decay_fun is None or decay_fun(meta.name)):
                         p = p * (1.0 - plr * coeff)
                     opt._apply_param_name = meta.name
-                    new_p, slots = opt._apply(p, g,
-                                              opt_state['slots'][name],
-                                              plr, t)
-                    new_params[name] = new_p
+                    new_p, slots = opt._apply(p, g, old_slots, plr, t)
+                    slots = _cast_like(slots, old_slots)
+                    if master is not None:
+                        slots['master'] = new_p.astype(jnp.float32)
+                    new_params[name] = new_p.astype(params[name].dtype)
                     new_slots[name] = slots
                 return new_params, new_slots, t
 

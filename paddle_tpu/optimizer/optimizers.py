@@ -17,6 +17,21 @@ __all__ = ['Optimizer', 'SGD', 'Momentum', 'Adam', 'AdamW', 'Adamax',
            'Ftrl', 'Dpsgd', 'ProximalGD', 'ProximalAdagrad', 'SparseAdam']
 
 
+def _is_low_precision(arr):
+    return arr.dtype in (jnp.bfloat16, jnp.float16)
+
+
+def _slot_zeros(p):
+    """Optimizer state for bf16/fp16 params is stored in f32: the per-step
+    EMA increments ((1-beta2)*g**2 at beta2=0.999 is ~0.1% of the running
+    moment) fall below bf16's ~0.4% mantissa resolution, so low-precision
+    moments freeze. The reference reaches the same place through its
+    MasterParam/multi_precision path (operators/optimizers/adam_op.cu
+    MultiPrecisionAdam); on TPU f32 state is simply the default."""
+    d = p._data
+    return jnp.zeros(d.shape, jnp.float32 if _is_low_precision(d) else d.dtype)
+
+
 class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -28,6 +43,11 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._slots = {}   # id(param) -> dict of slot arrays
         self._step_count = 0
+        # reference multi_precision (MasterParam): keep an f32 master copy
+        # of each bf16/fp16 param in the slots; the update rule runs on the
+        # master and the stored param is its rounded shadow. Subclasses
+        # whose signatures take multi_precision set this.
+        self._multi_precision = False
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self):
@@ -52,12 +72,30 @@ class Optimizer:
     def _get_slots(self, p):
         key = id(p)
         if key not in self._slots:
-            self._slots[key] = self._init_slots(p)
+            slots = self._init_slots(p)
+            if self._multi_precision and _is_low_precision(p._data):
+                slots = dict(slots)
+                slots['master'] = p._data.astype(jnp.float32)
+            self._slots[key] = slots
         return self._slots[key]
 
     # -- core update rule (pure) -------------------------------------------
     def _apply(self, p, g, slots, lr, t):
         raise NotImplementedError
+
+    def _update_operand(self, p, slots):
+        """(master_or_None, value the update rule runs on)."""
+        master = slots.get('master')
+        return master, (master if master is not None else p._data)
+
+    def _store_update(self, p, new_p, new_slots, master):
+        """Write an update back: master (if any) keeps full precision, the
+        stored param is its rounded shadow; dtypes never drift."""
+        if master is not None:
+            new_slots = dict(new_slots)
+            new_slots['master'] = new_p
+        p._data = new_p.astype(p._data.dtype)
+        self._slots[id(p)] = new_slots
 
     def _decay_coeff(self):
         wd = self._weight_decay
@@ -84,25 +122,25 @@ class Optimizer:
         lr = self.get_lr()
         coeff = self._decay_coeff()
         for p, g in params_grads:
-            garr = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype \
+            slots = self._get_slots(p)
+            master, pval = self._update_operand(p, slots)
+            garr = g._data.astype(pval.dtype) if g._data.dtype != pval.dtype \
                 else g._data
             if coeff and not self._apply_decoupled_decay():
-                garr = garr + coeff * p._data
+                garr = garr + coeff * pval
             # per-param regularizer overrides global (reference semantics)
             if p.regularizer is not None:
-                garr = p.regularizer._append(garr, p._data)
+                garr = p.regularizer._append(garr, pval)
             plr = lr * p.optimize_attr.get('learning_rate', 1.0)
-            slots = self._get_slots(p)
             # name hint for rules with per-param behavior (e.g. LARS
             # weight-decay exclusion); static at jit trace time
             self._apply_param_name = getattr(p, 'name', None)
-            new_p, new_slots = self._apply(p._data, garr, slots, plr,
+            new_p, new_slots = self._apply(pval, garr, slots, plr,
                                            self._step_count)
             if coeff and self._apply_decoupled_decay() and \
                     getattr(p, 'no_weight_decay', False) is False:
-                new_p = new_p - plr * coeff * p._data
-            p._data = new_p
-            self._slots[id(p)] = new_slots
+                new_p = new_p - plr * coeff * pval
+            self._store_update(p, new_p, new_slots, master)
 
     def clear_grad(self, set_to_zero=True):
         if self._parameter_list:
@@ -160,13 +198,14 @@ class SGD(Optimizer):
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None,
-                 name=None):
+                 multi_precision=False, name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip)
         self._momentum = momentum
         self._nesterov = use_nesterov
+        self._multi_precision = multi_precision
 
     def _init_slots(self, p):
-        return {'velocity': jnp.zeros_like(p._data)}
+        return {'velocity': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         v = self._momentum * slots['velocity'] + g
@@ -186,10 +225,11 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._multi_precision = multi_precision
 
     def _init_slots(self, p):
-        return {'moment1': jnp.zeros_like(p._data),
-                'moment2': jnp.zeros_like(p._data)}
+        return {'moment1': _slot_zeros(p),
+                'moment2': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         b1 = self._beta1() if callable(self._beta1) else self._beta1
@@ -208,7 +248,8 @@ class AdamW(Adam):
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         weight_decay, grad_clip)
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _apply_decoupled_decay(self):
@@ -226,20 +267,20 @@ class AdamW(Adam):
         lr = self.get_lr()
         coeff = self._decay_coeff()
         for p, g in params_grads:
-            garr = g._data.astype(p._data.dtype) if g._data.dtype != p._data.dtype \
+            slots = self._get_slots(p)
+            master, pval = self._update_operand(p, slots)
+            garr = g._data.astype(pval.dtype) if g._data.dtype != pval.dtype \
                 else g._data
             plr = lr * p.optimize_attr.get('learning_rate', 1.0)
-            slots = self._get_slots(p)
             decay = coeff
             if self._apply_decay_param_fun is not None and \
                     not self._apply_decay_param_fun(p.name):
                 decay = 0.0
             if decay:
-                p._data = p._data * (1.0 - plr * decay)
-            new_p, new_slots = self._apply(p._data, garr, slots, plr,
+                pval = pval * (1.0 - plr * decay)
+            new_p, new_slots = self._apply(pval, garr, slots, plr,
                                            self._step_count)
-            p._data = new_p
-            self._slots[id(p)] = new_slots
+            self._store_update(p, new_p, new_slots, master)
 
 
 class Adamax(Optimizer):
@@ -250,8 +291,8 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _init_slots(self, p):
-        return {'moment': jnp.zeros_like(p._data),
-                'inf_norm': jnp.zeros_like(p._data)}
+        return {'moment': _slot_zeros(p),
+                'inf_norm': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         m = self._beta1 * slots['moment'] + (1 - self._beta1) * g
@@ -269,7 +310,7 @@ class Adagrad(Optimizer):
         self._init_val = initial_accumulator_value
 
     def _init_slots(self, p):
-        return {'moment': jnp.full_like(p._data, self._init_val)}
+        return {'moment': _slot_zeros(p) + self._init_val}
 
     def _apply(self, p, g, slots, lr, t):
         mom = slots['moment'] + g * g
@@ -283,8 +324,8 @@ class Adadelta(Optimizer):
         self._epsilon, self._rho = epsilon, rho
 
     def _init_slots(self, p):
-        return {'avg_squared_grad': jnp.zeros_like(p._data),
-                'avg_squared_update': jnp.zeros_like(p._data)}
+        return {'avg_squared_grad': _slot_zeros(p),
+                'avg_squared_update': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         asg = self._rho * slots['avg_squared_grad'] + (1 - self._rho) * g * g
@@ -305,9 +346,9 @@ class RMSProp(Optimizer):
         self._momentum, self._centered = momentum, centered
 
     def _init_slots(self, p):
-        return {'mean_square': jnp.zeros_like(p._data),
-                'mean_grad': jnp.zeros_like(p._data),
-                'momentum': jnp.zeros_like(p._data)}
+        return {'mean_square': _slot_zeros(p),
+                'mean_grad': _slot_zeros(p),
+                'momentum': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         ms = self._rho * slots['mean_square'] + (1 - self._rho) * g * g
@@ -331,8 +372,8 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _init_slots(self, p):
-        return {'moment1': jnp.zeros_like(p._data),
-                'moment2': jnp.zeros_like(p._data)}
+        return {'moment1': _slot_zeros(p),
+                'moment2': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         m = self._beta1 * slots['moment1'] + (1 - self._beta1) * g
@@ -367,7 +408,7 @@ class LarsMomentum(Optimizer):
         self._exclude = tuple(exclude_from_weight_decay or ())
 
     def _init_slots(self, p):
-        return {'velocity': jnp.zeros_like(p._data)}
+        return {'velocity': _slot_zeros(p)}
 
     def _excluded(self):
         name = getattr(self, '_apply_param_name', None) or ''
@@ -404,8 +445,8 @@ class Ftrl(Optimizer):
         self._lr_power = float(lr_power)
 
     def _init_slots(self, p):
-        return {'squared': jnp.zeros_like(p._data),
-                'linear': jnp.zeros_like(p._data)}
+        return {'squared': _slot_zeros(p),
+                'linear': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         n, z = slots['squared'], slots['linear']
@@ -478,7 +519,7 @@ class ProximalAdagrad(ProximalGD):
         self._epsilon = float(epsilon)
 
     def _init_slots(self, p):
-        return {'moment': jnp.zeros_like(p._data)}
+        return {'moment': _slot_zeros(p)}
 
     def _apply(self, p, g, slots, lr, t):
         mom = slots['moment'] + g * g

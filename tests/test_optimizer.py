@@ -133,3 +133,126 @@ def test_grad_scaler_fp16_contract():
     scaler.update()
     # unscaled grad = 2 -> w = 1 - 0.2
     np.testing.assert_allclose(w.numpy(), [0.8, 0.8], rtol=1e-6)
+
+
+def test_multi_step_bf16_params_keep_dtype():
+    """A bf16 model's params/slots must not drift to f32 through the jitted
+    update (the traced f32 lr promotes the update arithmetic — good — but
+    the stored dtypes must round-trip or the lax.scan carry in multi_step
+    mistypes). Regression: the bench's bf16 TPU rung failed exactly here."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import TrainStep
+
+    paddle.seed(11)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.GELU(), paddle.nn.Linear(16, 4))
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3, weight_decay=0.01,
+                                 parameters=model.parameters())
+
+    def loss_fn(out, lab):
+        return paddle.nn.functional.cross_entropy(out.astype('float32'), lab)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(
+        rng.randn(4, 6, 8).astype(np.float32)).astype('bfloat16')
+    y = paddle.to_tensor(rng.randint(0, 4, (4, 6)).astype(np.int64))
+
+    # single step: params stay bf16 (no silent f32 upcast + recompile)
+    step(x, y)
+    for p in model.parameters():
+        assert p.dtype == paddle.bfloat16, p.name
+
+    # multi_step: the scan carry must type-check, losses finite
+    k = 3
+    xk = paddle.to_tensor(np.broadcast_to(x.numpy(), (k, 4, 6, 8)).copy())
+    yk = paddle.to_tensor(np.broadcast_to(y.numpy(), (k, 4, 6)).copy())
+    losses = step.multi_step(xk, yk).numpy()
+    assert losses.shape == (k,)
+    assert np.isfinite(losses.astype(np.float32)).all()
+    for p in model.parameters():
+        assert p.dtype == paddle.bfloat16, p.name
+
+
+def test_bf16_optimizer_state_is_f32():
+    """Low-precision params get f32 optimizer state (bf16 moments freeze:
+    (1-b2)*g^2 is below bf16 resolution at beta2=0.999), and
+    multi_precision=True additionally keeps an f32 master param."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+
+    paddle.seed(3)
+    lin = paddle.nn.Linear(4, 4)
+    lin.bfloat16()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=lin.parameters())
+    p = lin.parameters()[0]
+    slots = opt._get_slots(p)
+    assert slots['moment1'].dtype == jnp.float32
+    assert slots['moment2'].dtype == jnp.float32
+    assert 'master' not in slots
+
+    # EMA actually accumulates: with bf16 moments this stalls at 0
+    x = paddle.to_tensor(np.ones((2, 4), np.float32)).astype('bfloat16')
+    for _ in range(3):
+        loss = (lin(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    slots = opt._get_slots(p)
+    assert float(jnp.abs(slots['moment2']).max()) > 0
+    assert p.dtype == paddle.bfloat16
+
+    paddle.seed(3)
+    lin2 = paddle.nn.Linear(4, 4)
+    lin2.bfloat16()
+    opt2 = paddle.optimizer.AdamW(learning_rate=1e-3, multi_precision=True,
+                                  parameters=lin2.parameters())
+    p2 = lin2.parameters()[0]
+    slots2 = opt2._get_slots(p2)
+    assert slots2['master'].dtype == jnp.float32
+    loss = (lin2(x) ** 2).mean()
+    loss.backward()
+    opt2.step()
+    opt2.clear_grad()
+    slots2 = opt2._get_slots(p2)
+    # stored param is the rounded shadow of the updated master
+    np.testing.assert_array_equal(
+        np.asarray(slots2['master'].astype(jnp.bfloat16), np.float32),
+        p2.numpy().astype(np.float32))
+
+
+def test_multi_precision_multi_step():
+    """multi_precision master weights ride through the jitted multi_step
+    scan: master persists f32 in the opt-state carry, stored params stay
+    bf16, and tiny updates that round to zero in bf16 still accumulate
+    in the master."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import TrainStep
+
+    paddle.seed(13)
+    model = paddle.nn.Linear(8, 4)
+    model.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-7, multi_precision=True,
+                                 parameters=model.parameters())
+    step = TrainStep(model, lambda out, y:
+                     paddle.nn.functional.mse_loss(
+                         out.astype('float32'), y), opt)
+    rng = np.random.RandomState(2)
+    k = 4
+    xs = paddle.to_tensor(
+        rng.randn(k, 8, 8).astype(np.float32)).astype('bfloat16')
+    ys = paddle.to_tensor(rng.randn(k, 8, 4).astype(np.float32))
+    m0 = np.asarray(opt._get_slots(model.parameters()[0])['master'],
+                    np.float32).copy()
+    losses = step.multi_step(xs, ys).numpy()
+    assert losses.shape == (k,)
+    p0 = model.parameters()[0]
+    assert p0.dtype == paddle.bfloat16
+    m1 = opt._get_slots(p0)['master']
+    assert m1.dtype == jnp.float32
+    # lr=1e-7 moves the master below bf16 resolution: the shadow may not
+    # change, the master must
+    assert np.abs(np.asarray(m1, np.float32) - m0).max() > 0

@@ -39,12 +39,13 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
     Numerically matches softmax(q k^T * scale) v with f32 accumulation;
     memory is O(seq * head_dim) instead of O(seq^2).
 
-    Known cost: causal mode computes (then masks) the future KV blocks —
-    the q-block loop is vmapped for MXU parallelism, so a lax.cond skip
-    would lower to select and save nothing. The quadratic reference path
-    pays the same 2x on masked flops; the Pallas flash kernels
-    (flash_attention.py) are the zero-waste causal path when Mosaic is
-    available. This op's win is the O(N) memory shape.
+    Causal self-attention (n == m, equal blocks, modest block count) skips
+    future KV blocks outright: the q-block count is static, so a Python
+    unroll gives q-block i a STATIC kv slice [0..i] — only the lower
+    triangle is ever computed (the diagonal block alone carries a mask),
+    halving causal attention flops vs compute-then-mask. Cross-attention
+    and very deep block counts (compile-size guard) fall back to the
+    vmapped compute-then-mask path, which still has the O(N) memory win.
     """
     b, h, n, d = q.shape
     m = k.shape[2]
@@ -58,34 +59,23 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
     kb = jnp.moveaxis(k.reshape(b, h, tk, bk, d), 2, 0)  # [tk, b, h, bk, d]
     vb = jnp.moveaxis(v.reshape(b, h, tk, bk, d), 2, 0)
 
+    if causal and n == m and bq == bk and tq <= 64:
+        return _causal_skip(qb, kb, vb, scale, q.dtype)
+
     def one_qblock(qi, i):
         # qi: [b, h, bq, d]; i: scalar q-block index
         q32 = qi.astype(jnp.float32) * scale
 
         def body(carry, xs):
-            m_prev, l_prev, acc = carry
             kj, vj, j = xs
-            s = jnp.einsum('bhqd,bhkd->bhqk', q32, kj.astype(jnp.float32))
+            keep = None
             if causal:
                 qpos = i * bq + jnp.arange(bq)
                 kpos = j * bk + jnp.arange(bk)
                 keep = qpos[:, None] >= kpos[None, :]
-                s = jnp.where(keep, s, _NEG_INF)
-            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-            p = jnp.exp(s - m_cur[..., None])
-            if causal:
-                # -1e30 sentinel rows: exp(-1e30 - -1e30) = 1 would leak
-                # masked weight; zero them explicitly
-                p = jnp.where(keep[None, None], p, 0.0)
-            corr = jnp.exp(m_prev - m_cur)
-            l_cur = l_prev * corr + jnp.sum(p, axis=-1)
-            acc = acc * corr[..., None] + jnp.einsum(
-                'bhqk,bhkd->bhqd', p, vj.astype(jnp.float32))
-            return (m_cur, l_cur, acc), None
+            return _online_step(carry, q32, kj, vj, keep), None
 
-        init = (jnp.full((b, h, bq), _NEG_INF, jnp.float32),
-                jnp.zeros((b, h, bq), jnp.float32),
-                jnp.zeros((b, h, bq, d), jnp.float32))
+        init = _online_init(b, h, bq, d)
         (m_f, l_f, acc), _ = lax.scan(jax.checkpoint(body), init,
                                       (kb, vb, jnp.arange(tk)))
         out = acc / jnp.maximum(l_f, 1e-30)[..., None]
@@ -94,6 +84,73 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
     out = jax.vmap(one_qblock, in_axes=(2, 0), out_axes=2)(
         qb, jnp.arange(tq))
     return out.reshape(b, h, n, d)
+
+
+def _online_init(b, h, bq, d):
+    return (jnp.full((b, h, bq), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, bq), jnp.float32),
+            jnp.zeros((b, h, bq, d), jnp.float32))
+
+
+def _online_step(carry, q32, kj, vj, keep=None):
+    """One online-softmax accumulation step over a single KV block.
+
+    carry = (running max, running denom, running weighted-V accum), all
+    f32. `keep` is an optional [bq, bk] visibility mask. The single copy
+    of this numerically delicate update serves the masked fallback, the
+    causal-skip scan body, and the causal diagonal block.
+    """
+    m_prev, l_prev, acc = carry
+    s = jnp.einsum('bhqd,bhkd->bhqk', q32, kj.astype(jnp.float32))
+    if keep is not None:
+        s = jnp.where(keep, s, _NEG_INF)
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_cur[..., None])
+    if keep is not None:
+        # -1e30 sentinel rows: exp(-1e30 - -1e30) = 1 would leak masked
+        # weight; zero them explicitly
+        p = jnp.where(keep, p, 0.0)
+    corr = jnp.exp(m_prev - m_cur)
+    l_cur = l_prev * corr + jnp.sum(p, axis=-1)
+    acc = acc * corr[..., None] + jnp.einsum(
+        'bhqk,bhkd->bhqd', p, vj.astype(jnp.float32))
+    return m_cur, l_cur, acc
+
+
+def _causal_skip(qb, kb, vb, scale, out_dtype):
+    """Lower-triangle-only causal blockwise attention.
+
+    qb: [b, h, tq, bq, d]; kb/vb: [tk, b, h, bk, d] with tq == tk,
+    bq == bk. q-block i scans kv blocks 0..i-1 unmasked (all positions
+    visible) via a static slice, then folds in the diagonal block with
+    the in-block triangle mask — no future block is ever computed. Every
+    step (diagonal included) sits under jax.checkpoint so backward only
+    keeps the (m, l, acc) carries, preserving the O(seq*head_dim)
+    residual contract.
+    """
+    b, h, tq, bq, d = qb.shape
+    tri = jnp.arange(bq)[:, None] >= jnp.arange(bq)[None, :]
+
+    def make_body(q32):
+        def body(carry, xs):
+            return _online_step(carry, q32, *xs), None
+        return body
+
+    def diag_step(carry, q32, kj, vj):
+        return _online_step(carry, q32, kj, vj, tri)
+
+    outs = []
+    for i in range(tq):
+        q32 = qb[:, :, i].astype(jnp.float32) * scale
+        carry = _online_init(b, h, bq, d)
+        if i > 0:
+            carry, _ = lax.scan(jax.checkpoint(make_body(q32)), carry,
+                                (kb[:i], vb[:i]))
+        # diagonal block: the only one needing the triangle mask
+        m_f, l_f, acc = jax.checkpoint(diag_step)(carry, q32, kb[i], vb[i])
+        outs.append((acc / jnp.maximum(l_f, 1e-30)[..., None]
+                     ).astype(out_dtype))
+    return jnp.stack(outs, axis=2).reshape(b, h, tq * bq, d)
 
 
 def blockwise_attention(q, k, v, causal=False, scale=None,

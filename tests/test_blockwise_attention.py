@@ -202,3 +202,77 @@ def test_multi_step_matches_sequential():
         assert na == nb
         np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=1e-5,
                                    atol=1e-6)
+
+
+def _weighted_dot_flops(jaxpr, mult=1):
+    """Matmul flops of a jaxpr with scan bodies weighted by trip count
+    (XLA's cost_analysis counts a while-body once, hiding the real work)."""
+    total = 0
+    for eqn in jaxpr.eqns:
+        m = mult
+        sub = []
+        if eqn.primitive.name == 'scan':
+            m = mult * eqn.params['length']
+            sub = [eqn.params['jaxpr'].jaxpr]
+        else:
+            for vparam in eqn.params.values():
+                if hasattr(vparam, 'eqns'):
+                    sub.append(vparam)
+                elif hasattr(vparam, 'jaxpr') and \
+                        hasattr(vparam.jaxpr, 'eqns'):
+                    sub.append(vparam.jaxpr)
+        if eqn.primitive.name == 'dot_general':
+            lhs = eqn.invars[0].aval.shape
+            rhs = eqn.invars[1].aval.shape
+            (lc, rc), (lb, rb) = eqn.params['dimension_numbers']
+            bsz = mdim = ndim = kdim = 1
+            for a in lb:
+                bsz *= lhs[a]
+            for i, s in enumerate(lhs):
+                if i not in lc and i not in lb:
+                    mdim *= s
+            for i, s in enumerate(rhs):
+                if i not in rc and i not in rb:
+                    ndim *= s
+            for a in lc:
+                kdim *= lhs[a]
+            total += 2 * bsz * mdim * ndim * kdim * m
+        for s in sub:
+            total += _weighted_dot_flops(s, m)
+    return total
+
+
+def test_causal_skip_halves_flops():
+    """The causal path must actually SKIP future kv blocks (static
+    lower-triangle slices), not compute-then-mask: trip-count-weighted
+    matmul flops must equal the lower-triangle fraction of the square."""
+    b, h, n, d, blk = 1, 2, 512, 32, 64   # tq = 8
+
+    def count(causal):
+        def f(q, k, v):
+            return blockwise_attention_bnhd(q, k, v, causal=causal,
+                                            block_q=blk, block_k=blk)
+        x = jnp.zeros((b, h, n, d), jnp.float32)
+        return _weighted_dot_flops(jax.make_jaxpr(f)(x, x, x).jaxpr)
+
+    full = count(False)
+    tri = count(True)
+    tq = n // blk
+    assert tri == full * (tq + 1) // (2 * tq), (tri, full)
+
+
+def test_causal_cross_attention_fallback():
+    """causal with n != m (or unequal blocks) uses the masked fallback and
+    stays correct."""
+    rng = np.random.RandomState(7)
+    b, h, d = 1, 2, 16
+    n, m = 128, 256
+    q = jnp.asarray(rng.randn(b, h, n, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, m, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, m, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    out = blockwise_attention_bnhd(q, k, v, causal=True, scale=scale,
+                                   block_q=64, block_k=64)
+    ref = _ref_bnhd(q, k, v, True, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

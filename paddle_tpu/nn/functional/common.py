@@ -86,13 +86,15 @@ def pad(x, pad, mode='constant', value=0.0, data_format='NCHW', name=None):
     if len(pad) == 2 * nd:
         pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
     else:
-        # paddle NCHW semantics: pad only spatial dims, given reversed
+        # paddle spatial-pad semantics: the list orders from the LAST
+        # spatial dim backwards — 4-D NCHW pad=[left, right, top, bottom]
+        # pads W with (left, right) and H with (top, bottom)
         n_spatial = len(pad) // 2
         pairs = [(0, 0)] * nd
         if data_format.startswith('NC'):
-            spatial_dims = list(range(2, 2 + n_spatial))
+            spatial_dims = list(range(2, 2 + n_spatial))[::-1]
         else:
-            spatial_dims = list(range(1, 1 + n_spatial))
+            spatial_dims = list(range(1, 1 + n_spatial))[::-1]
         for i, d in enumerate(spatial_dims):
             pairs[d] = (pad[2 * i], pad[2 * i + 1])
 

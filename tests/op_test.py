@@ -78,7 +78,11 @@ class OpTest:
             def scalar_fn(x, name=name):
                 vals = {k: np.asarray(v) for k, v in self.inputs.items()}
                 vals[name] = x
-                ts = {k: paddle.to_tensor(v.astype(np.float32))
+                # float inputs ride at f32; integer inputs (indices,
+                # labels) must keep their dtype or gather-like ops break
+                ts = {k: paddle.to_tensor(
+                          v.astype(np.float32)
+                          if np.issubdtype(v.dtype, np.floating) else v)
                       for k, v in vals.items()}
                 o = type(self).fn(*ts.values(), **attrs)
                 o0 = o[0] if isinstance(o, (list, tuple)) else o

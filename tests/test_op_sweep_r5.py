@@ -1,0 +1,413 @@
+"""Third OpTest sweep wave: the remaining differentiable nn.functional
+tail (activations, losses, pooling, norms, conv family, shape ops) vs
+independent numpy references with numeric-grad checks — extending
+test_op_sweep.py / test_op_sweep_r4.py toward full surface coverage
+(reference bar: unittests/op_test.py:270 OpTest over ~1,122 op files).
+
+References are written from the ops' canonical/documented semantics
+(paddle 2.1 docs conventions: NCHW layouts, paddle arg orders), NOT from
+this repo's implementations.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from test_op_sweep import _mk, _run_sweep_case, _softplus_np as _softplus
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _log_softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    return x - m - np.log(np.exp(x - m).sum(axis=axis, keepdims=True))
+
+
+# -- conv family loops (canonical cross-correlation, NCHW) -------------------
+
+def _conv1d_np(x, w, b):
+    n, cin, l = x.shape
+    co, _, k = w.shape
+    lo = l - k + 1
+    out = np.zeros((n, co, lo), np.float32)
+    for i in range(lo):
+        out[:, :, i] = np.tensordot(x[:, :, i:i + k], w,
+                                    axes=([1, 2], [1, 2]))
+    return out + b.reshape(1, -1, 1)
+
+
+def _conv2d_np(x, w, b):
+    n, cin, h, wd = x.shape
+    co, _, kh, kw = w.shape
+    ho, wo = h - kh + 1, wd - kw + 1
+    out = np.zeros((n, co, ho, wo), np.float32)
+    for i in range(ho):
+        for j in range(wo):
+            patch = x[:, :, i:i + kh, j:j + kw]
+            out[:, :, i, j] = np.tensordot(patch, w,
+                                           axes=([1, 2, 3], [1, 2, 3]))
+    return out + b.reshape(1, -1, 1, 1)
+
+
+def _conv3d_np(x, w):
+    n, cin, dd, h, wd = x.shape
+    co, _, kd, kh, kw = w.shape
+    do, ho, wo = dd - kd + 1, h - kh + 1, wd - kw + 1
+    out = np.zeros((n, co, do, ho, wo), np.float32)
+    for z in range(do):
+        for i in range(ho):
+            for j in range(wo):
+                patch = x[:, :, z:z + kd, i:i + kh, j:j + kw]
+                out[:, :, z, i, j] = np.tensordot(
+                    patch, w, axes=([1, 2, 3, 4], [1, 2, 3, 4]))
+    return out
+
+
+def _conv1dT_np(x, w, stride=1):
+    # paddle conv1d_transpose weight: [cin, cout, k]
+    n, cin, l = x.shape
+    _, co, k = w.shape
+    lo = (l - 1) * stride + k
+    out = np.zeros((n, co, lo), np.float32)
+    for i in range(l):
+        out[:, :, i * stride:i * stride + k] += np.einsum(
+            'nc,cok->nok', x[:, :, i], w)
+    return out
+
+
+def _conv2dT_np(x, w, stride=1):
+    # paddle conv2d_transpose weight: [cin, cout, kh, kw]
+    n, cin, h, wd = x.shape
+    _, co, kh, kw = w.shape
+    ho, wo = (h - 1) * stride + kh, (wd - 1) * stride + kw
+    out = np.zeros((n, co, ho, wo), np.float32)
+    for i in range(h):
+        for j in range(wd):
+            out[:, :, i * stride:i * stride + kh,
+                j * stride:j * stride + kw] += np.einsum(
+                    'nc,cokl->nokl', x[:, :, i, j], w)
+    return out
+
+
+def _unfold_np(x, k):
+    # im2col, channel-major (c, ki, kj) row layout, L = ho*wo cols
+    n, c, h, w = x.shape
+    ho, wo = h - k + 1, w - k + 1
+    cols = np.zeros((n, c, k * k, ho * wo), np.float32)
+    for i in range(k):
+        for j in range(k):
+            cols[:, :, i * k + j] = x[:, :, i:i + ho, j:j + wo].reshape(
+                n, c, -1)
+    return cols.reshape(n, c * k * k, ho * wo)
+
+
+def _fold_np(x, out_hw, k):
+    n, ckk, l = x.shape
+    c = ckk // (k * k)
+    ho, wo = out_hw[0] - k + 1, out_hw[1] - k + 1
+    cols = x.reshape(n, c, k, k, ho, wo)
+    out = np.zeros((n, c, out_hw[0], out_hw[1]), np.float32)
+    for i in range(k):
+        for j in range(k):
+            out[:, :, i:i + ho, j:j + wo] += cols[:, :, i, j]
+    return out
+
+
+# -- pooling refs ------------------------------------------------------------
+
+def _avg_pool2d_np(x, k):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // k, k, w // k, k).mean(axis=(3, 5))
+
+
+def _max_pool2d_np(x, k):
+    n, c, h, w = x.shape
+    return x.reshape(n, c, h // k, k, w // k, k).max(axis=(3, 5))
+
+
+def _group_norm_np(x, w, b, groups, eps=1e-5):
+    n, c, h, wd = x.shape
+    xg = x.reshape(n, groups, -1)
+    mu = xg.mean(axis=2, keepdims=True)
+    var = xg.var(axis=2, keepdims=True)
+    xn = ((xg - mu) / np.sqrt(var + eps)).reshape(n, c, h, wd)
+    return xn * w.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+
+
+_PM1 = lambda l: 2.0 * l - 1.0   # {0,1} int spec -> {-1,+1} labels
+
+_BN_MEAN = np.array([0.1, -0.2, 0.3], np.float32)
+_BN_VAR = np.array([1.1, 0.9, 1.3], np.float32)
+
+
+SWEEP5 = [
+    # --- activations -------------------------------------------------------
+    ('celu', lambda x: F.celu(x, alpha=1.2),
+     lambda x: np.maximum(x, 0) + np.minimum(1.2 * np.expm1(x / 1.2), 0),
+     [(3, 4)], {}, True),
+    ('mish', F.mish, lambda x: x * np.tanh(_softplus(x)), [(3, 4)], {}, True),
+    ('silu', F.silu, lambda x: x * _sigmoid(x), [(3, 4)], {}, True),
+    ('selu', F.selu,
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * np.expm1(x)), [(3, 4)], {}, False),
+    ('relu6', F.relu6, lambda x: np.clip(x, 0, 6), [(3, 4)], {}, False),
+    ('softshrink', lambda x: F.softshrink(x, threshold=0.5),
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0.0)),
+     [(3, 4)], {}, False),
+    ('hardshrink', lambda x: F.hardshrink(x, threshold=0.5),
+     lambda x: np.where(np.abs(x) > 0.5, x, 0.0), [(3, 4)], {}, False),
+    ('tanhshrink', F.tanhshrink, lambda x: x - np.tanh(x), [(3, 4)], {},
+     True),
+    ('thresholded_relu', lambda x: F.thresholded_relu(x, threshold=1.0),
+     lambda x: np.where(x > 1.0, x, 0.0), [(3, 4)], {}, False),
+    ('hardsigmoid', F.hardsigmoid,
+     lambda x: np.clip(x / 6.0 + 0.5, 0.0, 1.0), [(3, 4)], {}, False),
+    ('hardtanh', F.hardtanh, lambda x: np.clip(x, -1, 1), [(3, 4)], {},
+     False),
+    ('leaky_relu', lambda x: F.leaky_relu(x, negative_slope=0.01),
+     lambda x: np.where(x >= 0, x, 0.01 * x), [(3, 4)], {}, False),
+    ('log_sigmoid', F.log_sigmoid, lambda x: -_softplus(-x), [(3, 4)], {},
+     True),
+    ('softsign', F.softsign, lambda x: x / (1 + np.abs(x)), [(3, 4)], {},
+     True),
+    ('swish', F.swish, lambda x: x * _sigmoid(x), [(3, 4)], {}, True),
+    ('maxout', lambda x: F.maxout(x, groups=2, axis=1),
+     lambda x: x.reshape(2, 2, 2, 3, 4).max(axis=2), [(2, 4, 3, 4)], {},
+     False),
+    ('prelu', lambda x, w: F.prelu(x, w),
+     lambda x, w: np.where(x >= 0, x, w.reshape(1, -1, 1, 1) * x),
+     [(2, 3, 4, 4), ('pos', (3,))], {}, False),
+    ('glu', lambda x: F.glu(x, axis=-1),
+     lambda x: x[..., :3] * _sigmoid(x[..., 3:]), [(2, 4, 6)], {}, True),
+    # --- losses ------------------------------------------------------------
+    ('l1_loss', F.l1_loss, lambda x, y: np.mean(np.abs(x - y)),
+     [(3, 4), (3, 4)], {}, False),
+    ('mse_loss', F.mse_loss, lambda x, y: np.mean((x - y) ** 2),
+     [(3, 4), (3, 4)], {}, True),
+    ('smooth_l1_loss', F.smooth_l1_loss,
+     lambda x, y: np.mean(np.where(np.abs(x - y) < 1.0,
+                                   0.5 * (x - y) ** 2,
+                                   np.abs(x - y) - 0.5)),
+     [(3, 4), (3, 4)], {}, False),
+    ('kl_div', lambda x, y: F.kl_div(x, paddle.nn.functional.softmax(y)),
+     lambda x, y: np.mean(
+         np.exp(y) / np.exp(y).sum(-1, keepdims=True) *
+         (np.log(np.exp(y) / np.exp(y).sum(-1, keepdims=True)) - x)),
+     [(3, 4), (3, 4)], {}, True),
+    ('nll_loss',
+     lambda x, l: F.nll_loss(paddle.nn.functional.log_softmax(x), l),
+     lambda x, l: -np.mean(
+         _log_softmax(x)[np.arange(len(l)), l.astype(int)]),
+     [(6, 5), ('int', (6,), 5)], {}, True),
+    ('binary_cross_entropy',
+     lambda x, y: F.binary_cross_entropy(paddle.nn.functional.sigmoid(x),
+                                         y),
+     lambda x, y: -np.mean(y * np.log(_sigmoid(x)) +
+                           (1 - y) * np.log(1 - _sigmoid(x))),
+     [(3, 4), ('unit', (3, 4))], {}, True),
+    ('bce_with_logits', F.binary_cross_entropy_with_logits,
+     lambda x, y: np.mean((1 - y) * x + _softplus(-x)),
+     [(3, 4), ('unit', (3, 4))], {}, True),
+    ('soft_margin_loss',
+     lambda x, l: F.soft_margin_loss(x, paddle.to_tensor(2.0) * l - 1.0),
+     lambda x, l: np.mean(np.log1p(np.exp(-_PM1(l) * x))),
+     [(3, 4), ('int', (3, 4), 2)], {}, True),
+    ('margin_ranking_loss',
+     lambda a, b, l: F.margin_ranking_loss(
+         a, b, paddle.to_tensor(2.0) * l - 1.0, margin=0.1),
+     lambda a, b, l: np.mean(np.maximum(0.0, -_PM1(l) * (a - b) + 0.1)),
+     [(3, 4), (3, 4), ('int', (3, 4), 2)], {}, False),
+    ('hinge_embedding_loss',
+     lambda x, l: F.hinge_embedding_loss(
+         x, paddle.to_tensor(2.0) * l - 1.0),
+     lambda x, l: np.mean(np.where(_PM1(l) == 1.0, x,
+                                   np.maximum(0.0, 1.0 - x))),
+     [(3, 4), ('int', (3, 4), 2)], {}, False),
+    ('cosine_embedding_loss',
+     lambda a, b, l: F.cosine_embedding_loss(
+         a, b, paddle.to_tensor(2.0) * l - 1.0, margin=0.1),
+     lambda a, b, l: np.mean(np.where(
+         _PM1(l) == 1,
+         1 - (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                                np.linalg.norm(b, axis=-1)),
+         np.maximum(0.0, (a * b).sum(-1) /
+                    (np.linalg.norm(a, axis=-1) *
+                     np.linalg.norm(b, axis=-1)) - 0.1))),
+     [(4, 6), (4, 6), ('int', (4,), 2)], {}, False),
+    ('triplet_margin_loss', F.triplet_margin_loss,
+     lambda a, p, n: np.mean(np.maximum(
+         np.linalg.norm(a - p, axis=-1) -
+         np.linalg.norm(a - n, axis=-1) + 1.0, 0.0)),
+     [(4, 6), (4, 6), (4, 6)], {}, False),
+    ('multi_label_soft_margin', F.multi_label_soft_margin_loss,
+     lambda x, y: np.mean(
+         np.mean(-(y * np.log(_sigmoid(x)) +
+                   (1 - y) * np.log(_sigmoid(-x))), axis=-1)),
+     [(3, 5), ('int', (3, 5), 2)], {}, True),
+    ('square_error_cost', F.square_error_cost,
+     lambda x, y: (x - y) ** 2, [(3, 4), (3, 4)], {}, True),
+    ('dice_loss',
+     lambda x, l: F.dice_loss(paddle.nn.functional.softmax(x), l),
+     lambda x, l: np.mean(1.0 - (
+         2 * np.take_along_axis(
+             np.exp(x) / np.exp(x).sum(-1, keepdims=True), l, -1
+         ).squeeze(-1).sum(-1) + 1e-5) / (
+             (np.exp(x) / np.exp(x).sum(-1, keepdims=True)).sum((1, 2)) +
+             l.shape[1] + 1e-5)),
+     [(2, 6, 3), ('int', (2, 6, 1), 3)], {}, True),
+    ('label_smooth', F.label_smooth,
+     lambda x: 0.9 * x + 0.1 / 4, [('unit', (3, 4))], {}, True),
+    ('softmax_with_cross_entropy', F.softmax_with_cross_entropy,
+     lambda x, l: -np.take_along_axis(_log_softmax(x), l, -1),
+     [(5, 6), ('int', (5, 1), 6)], {}, True),
+    # --- pooling -----------------------------------------------------------
+    ('avg_pool1d', lambda x: F.avg_pool1d(x, 2, stride=2),
+     lambda x: x.reshape(2, 3, 4, 2).mean(-1), [(2, 3, 8)], {}, True),
+    ('avg_pool2d', lambda x: F.avg_pool2d(x, 2, stride=2),
+     lambda x: _avg_pool2d_np(x, 2), [(2, 3, 4, 6)], {}, True),
+    ('avg_pool3d', lambda x: F.avg_pool3d(x, 2, stride=2),
+     lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+     [(1, 2, 4, 4, 4)], {}, True),
+    ('max_pool1d', lambda x: F.max_pool1d(x, 2, stride=2),
+     lambda x: x.reshape(2, 3, 4, 2).max(-1), [(2, 3, 8)], {}, False),
+    ('max_pool2d', lambda x: F.max_pool2d(x, 2, stride=2),
+     lambda x: _max_pool2d_np(x, 2), [(2, 3, 4, 6)], {}, False),
+    ('max_pool3d', lambda x: F.max_pool3d(x, 2, stride=2),
+     lambda x: x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7)),
+     [(1, 2, 4, 4, 4)], {}, False),
+    ('adaptive_avg_pool1d', lambda x: F.adaptive_avg_pool1d(x, 2),
+     lambda x: x.reshape(2, 3, 2, 4).mean(-1), [(2, 3, 8)], {}, True),
+    ('adaptive_avg_pool2d', lambda x: F.adaptive_avg_pool2d(x, 2),
+     lambda x: x.reshape(2, 3, 2, 2, 2, 3).mean(axis=(3, 5)),
+     [(2, 3, 4, 6)], {}, True),
+    ('adaptive_avg_pool3d', lambda x: F.adaptive_avg_pool3d(x, 1),
+     lambda x: x.mean(axis=(2, 3, 4), keepdims=True), [(1, 2, 4, 4, 4)],
+     {}, True),
+    ('adaptive_max_pool2d', lambda x: F.adaptive_max_pool2d(x, 2),
+     lambda x: x.reshape(2, 3, 2, 2, 2, 3).max(axis=(3, 5)),
+     [(2, 3, 4, 6)], {}, False),
+    # --- norms -------------------------------------------------------------
+    ('layer_norm_affine',
+     lambda x, w, b: F.layer_norm(x, (6,), weight=w, bias=b),
+     lambda x, w, b: (x - x.mean(-1, keepdims=True)) /
+     np.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b,
+     [(4, 6), (6,), (6,)], {}, True),
+    ('group_norm',
+     lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+     lambda x, w, b: _group_norm_np(x, w, b, 2),
+     [(2, 4, 3, 3), (4,), (4,)], {}, True),
+    ('instance_norm',
+     lambda x, w, b: F.instance_norm(x, weight=w, bias=b),
+     lambda x, w, b: (x - x.mean((2, 3), keepdims=True)) /
+     np.sqrt(x.var((2, 3), keepdims=True) + 1e-5) *
+     w.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1),
+     [(2, 3, 4, 4), (3,), (3,)], {}, True),
+    ('batch_norm_eval',
+     lambda x, w, b: F.batch_norm(
+         x, paddle.to_tensor(_BN_MEAN), paddle.to_tensor(_BN_VAR),
+         weight=w, bias=b, training=False),
+     lambda x, w, b: (x - _BN_MEAN.reshape(1, -1, 1, 1)) /
+     np.sqrt(_BN_VAR.reshape(1, -1, 1, 1) + 1e-5) *
+     w.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1),
+     [(2, 3, 4, 4), (3,), (3,)], {}, True),
+    ('local_response_norm',
+     lambda x: F.local_response_norm(x, 3, alpha=0.1, beta=0.75, k=1.0),
+     None, [(2, 5, 4, 4)], {}, True),
+    ('normalize', lambda x: F.normalize(x, axis=-1),
+     lambda x: x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True),
+                              1e-12),
+     [(3, 6)], {}, True),
+    # --- conv family -------------------------------------------------------
+    ('conv1d', F.conv1d, _conv1d_np,
+     [(2, 3, 8), (4, 3, 3), (4,)], {}, True),
+    ('conv2d', F.conv2d, _conv2d_np,
+     [(2, 3, 6, 6), (4, 3, 3, 3), (4,)], {}, True),
+    ('conv3d', lambda x, w: F.conv3d(x, w), _conv3d_np,
+     [(1, 2, 4, 4, 4), (3, 2, 2, 2, 2)], {}, True),
+    ('conv1d_transpose',
+     lambda x, w: F.conv1d_transpose(x, w, stride=2),
+     lambda x, w: _conv1dT_np(x, w, 2),
+     [(2, 3, 5), (3, 4, 3)], {}, True),
+    ('conv2d_transpose',
+     lambda x, w: F.conv2d_transpose(x, w, stride=2),
+     lambda x, w: _conv2dT_np(x, w, 2),
+     [(1, 3, 4, 4), (3, 2, 3, 3)], {}, True),
+    ('unfold', lambda x: F.unfold(x, 2),
+     lambda x: _unfold_np(x, 2), [(2, 3, 4, 5)], {}, True),
+    ('fold', lambda x: F.fold(x, (4, 5), 2),
+     lambda x: _fold_np(x, (4, 5), 2), [(2, 12, 12)], {}, True),
+    ('bilinear', F.bilinear,
+     lambda x1, x2, w, b: np.einsum('bi,oij,bj->bo', x1, w, x2) + b,
+     [(4, 3), (4, 5), (2, 3, 5), (1, 2)], {}, True),
+    ('embedding', lambda ids, w: F.embedding(ids, w),
+     lambda ids, w: w[ids.astype(int)],
+     [('int', (3, 4), 6), (6, 5)], {}, True),
+    ('cosine_similarity', lambda a, b: F.cosine_similarity(a, b, axis=-1),
+     lambda a, b: (a * b).sum(-1) / (np.linalg.norm(a, axis=-1) *
+                                     np.linalg.norm(b, axis=-1)),
+     [(3, 6), (3, 6)], {}, True),
+    # --- shape / layout ----------------------------------------------------
+    ('one_hot', lambda l: F.one_hot(l, 5),
+     lambda l: np.eye(5, dtype=np.float32)[l.astype(int)],
+     [('int', (3, 4), 5)], {}, False),
+    ('diag_embed', F.diag_embed,
+     lambda x: np.stack([np.diag(r) for r in x]), [(3, 4)], {}, True),
+    ('pad_nchw', lambda x: F.pad(x, [1, 2, 0, 1]),
+     lambda x: np.pad(x, [(0, 0), (0, 0), (0, 1), (1, 2)]),
+     [(2, 3, 4, 4)], {}, True),
+    ('zeropad2d', lambda x: F.zeropad2d(x, [1, 2, 3, 4]),
+     lambda x: np.pad(x, [(0, 0), (0, 0), (3, 4), (1, 2)]),
+     [(2, 3, 4, 4)], {}, True),
+    ('pixel_shuffle', lambda x: F.pixel_shuffle(x, 2),
+     lambda x: x.reshape(1, 2, 2, 2, 3, 3).transpose(
+         0, 1, 4, 2, 5, 3).reshape(1, 2, 6, 6),
+     [(1, 8, 3, 3)], {}, True),
+    ('pixel_unshuffle', lambda x: F.pixel_unshuffle(x, 2),
+     lambda x: x.reshape(1, 2, 3, 2, 3, 2).transpose(
+         0, 1, 3, 5, 2, 4).reshape(1, 8, 3, 3),
+     [(1, 2, 6, 6)], {}, True),
+    ('channel_shuffle', lambda x: F.channel_shuffle(x, 2),
+     lambda x: x.reshape(1, 2, 3, 4, 4).transpose(0, 2, 1, 3, 4).reshape(
+         1, 6, 4, 4),
+     [(1, 6, 4, 4)], {}, True),
+    # --- tensor namespace tail ---------------------------------------------
+    ('einsum_matmul', lambda x, y: paddle.einsum('ij,jk->ik', x, y),
+     lambda x, y: x @ y, [(3, 4), (4, 5)], {}, True),
+    ('norm_fro', lambda x: paddle.norm(x),
+     lambda x: np.sqrt((x ** 2).sum()), [(3, 4)], {}, True),
+    ('dist_l2', lambda x, y: paddle.dist(x, y),
+     lambda x, y: np.sqrt(((x - y) ** 2).sum()), [(3, 4), (3, 4)], {},
+     True),
+    ('diag_vec', paddle.diag, np.diag, [(5,)], {}, True),
+    ('t', paddle.t, np.transpose, [(3, 4)], {}, True),
+    ('where_select',
+     lambda c, x, y: paddle.where(c.astype('bool'), x, y),
+     lambda c, x, y: np.where(c.astype(bool), x, y),
+     [('int', (3, 4), 2), (3, 4), (3, 4)], {}, True),
+    ('scale_op', lambda x: paddle.scale(x, scale=2.5, bias=1.5),
+     lambda x: 2.5 * x + 1.5, [(3, 4)], {}, True),
+    ('stack_op', lambda x, y: paddle.stack([x, y], axis=1),
+     lambda x, y: np.stack([x, y], axis=1), [(3, 4), (3, 4)], {}, True),
+    ('max_reduce', lambda x: paddle.max(x, axis=1),
+     lambda x: x.max(axis=1), [(3, 4)], {}, False),
+    ('min_reduce', lambda x: paddle.min(x, axis=1),
+     lambda x: x.min(axis=1), [(3, 4)], {}, False),
+    ('sort_op', lambda x: paddle.sort(x, axis=-1),
+     lambda x: np.sort(x, axis=-1), [(3, 4)], {}, False),
+    ('expand_as', lambda x, y: paddle.expand_as(x, y),
+     lambda x, y: np.broadcast_to(x, y.shape), [(1, 4), (3, 4)], {},
+     False),
+    ('crop_tensor',
+     lambda x: paddle.crop_tensor(x, shape=[2, 2], offsets=[1, 1]),
+     lambda x: x[1:3, 1:3], [(4, 5)], {}, True),
+    ('atleast_2d', paddle.atleast_2d,
+     lambda x: np.atleast_2d(x), [(4,)], {}, True),
+]
+
+
+@pytest.mark.parametrize('case', SWEEP5, ids=[c[0] for c in SWEEP5])
+def test_op_sweep_r5(case):
+    _run_sweep_case(case)

@@ -198,8 +198,15 @@ class TrainStep:
                  'step': jnp.asarray(opt._step_count, jnp.int32)}
         if self._k_steps > 1:
             acc = getattr(self, '_gm_acc', None)
+            # f32 accumulators for low-precision params (the reference's
+            # fp16 gradient-merge accumulates in fp32): summing K same-
+            # magnitude grads in bf16 loses ~log2(K) of its 8 mantissa bits
+            from ..optimizer.optimizers import _is_low_precision
             state['acc'] = acc if acc is not None else {
-                name: jnp.zeros_like(pmap[name]._data)
+                name: jnp.zeros(
+                    pmap[name]._data.shape,
+                    jnp.float32 if _is_low_precision(pmap[name]._data)
+                    else pmap[name]._data.dtype)
                 for name in slots}
             state['micro'] = getattr(
                 self, '_gm_micro', jnp.zeros((), jnp.int32))
@@ -417,8 +424,9 @@ class TrainStep:
 
             def do_apply(_):
                 scale = 1.0 / K if self._grad_merge_avg else 1.0
-                eff = {n: (a * scale).astype(params[n].dtype)
-                       for n, a in new_acc.items()}
+                # no downcast here: apply_updates casts to the update
+                # operand's dtype (the f32 master when one exists)
+                eff = {n: a * scale for n, a in new_acc.items()}
                 np_, ns_, t_ = apply_updates(eff)
                 return (np_, ns_, t_,
                         {n: jnp.zeros_like(a) for n, a in new_acc.items()},

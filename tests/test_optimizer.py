@@ -256,3 +256,34 @@ def test_multi_precision_multi_step():
     # lr=1e-7 moves the master below bf16 resolution: the shadow may not
     # change, the master must
     assert np.abs(np.asarray(m1, np.float32) - m0).max() > 0
+
+
+def test_grad_merge_bf16_acc_is_f32():
+    """Gradient-merge accumulators for bf16 params are f32 (summing K
+    same-magnitude grads in bf16 loses ~log2(K) mantissa bits), and the
+    k_steps path stays scan-carry-type-stable for bf16 models."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import TrainStep
+
+    paddle.seed(17)
+    model = paddle.nn.Linear(6, 3)
+    model.bfloat16()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    step = TrainStep(model, lambda out, y:
+                     paddle.nn.functional.mse_loss(
+                         out.astype('float32'), y), opt, k_steps=2)
+    acc = step._opt_state()['acc']
+    assert all(a.dtype == jnp.float32 for a in acc.values())
+
+    rng = np.random.RandomState(3)
+    k = 4
+    xs = paddle.to_tensor(
+        rng.randn(k, 5, 6).astype(np.float32)).astype('bfloat16')
+    ys = paddle.to_tensor(rng.randn(k, 5, 3).astype(np.float32))
+    losses = step.multi_step(xs, ys).numpy()
+    assert losses.shape == (k,)
+    assert np.isfinite(losses.astype(np.float32)).all()
+    for p in model.parameters():
+        assert p.dtype == paddle.bfloat16

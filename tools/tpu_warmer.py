@@ -130,6 +130,7 @@ def main():
         log('TPU not up at warmer start; exiting')
         return
     log('TPU up — warming')
+    best = None
     for label, extra in CONFIGS:
         result, err, wall = run_child(label, extra)
         record(label, result, err, wall)
@@ -137,12 +138,28 @@ def main():
             log('%s: %.1fms/step mfu=%.4f (%.0fs)' % (
                 label, result.get('step_ms', -1), result.get('mfu', 0),
                 wall))
+            if best is None or result.get('mfu', 0) > best[1].get('mfu', 0):
+                best = (label, result, extra)
         else:
             log('%s: FAILED %s (%.0fs)' % (label, err, wall))
             # if the pool wedged mid-window, stop burning child timeouts
             if not probe_tpu():
                 log('pool went down mid-window; stopping')
                 break
+    # window still open after the ladder: capture an on-chip profile of
+    # the best rung — the data that tells WHERE the remaining MFU gap is
+    # (XLA schedule vs attention vs dispatch), which no step-time number
+    # can. Written under docs/ so it survives for analysis.
+    if best is not None and probe_tpu():
+        label, _, extra = best
+        pdir = os.path.join(REPO, 'docs', 'tpu_profile_r4')
+        prof_env = dict(extra, PADDLE_TPU_BENCH_PROFILE=pdir,
+                        PADDLE_TPU_BENCH_STEPS='8',
+                        PADDLE_TPU_BENCH_WARMUP='4')
+        result, err, wall = run_child('profile_' + label, prof_env)
+        record('profile_' + label, result, err, wall)
+        log('profile(%s): %s (%.0fs)' % (
+            label, 'ok -> %s' % pdir if result is not None else err, wall))
     log('warmer done')
 
 

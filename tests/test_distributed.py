@@ -384,3 +384,54 @@ def test_ring_attention_long_context_8k():
     for gi in g[1:]:
         arr = np.asarray(gi)
         assert np.isfinite(arr).all() and np.abs(arr).max() > 0
+
+
+def test_fleet_zero3_bf16_multi_precision():
+    """ZeRO-3 composes with a bf16 model and multi_precision masters: the
+    f32 master/slot entries ride the sharded opt-state pytree, stored
+    params stay bf16 AND sharded, and training stays finite."""
+    from paddle_tpu.distributed import fleet
+
+    paddle.seed(23)
+    m = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 4))
+    m.bfloat16()
+    o = paddle.optimizer.AdamW(learning_rate=1e-2, weight_decay=0.01,
+                               multi_precision=True,
+                               parameters=m.parameters())
+
+    def loss_fn(out, lab):
+        return paddle.nn.functional.mse_loss(out.astype('float32'), lab)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs['stage'] = 3
+    strategy.hybrid_configs = {'dp_degree': 2, 'mp_degree': 1,
+                               'pp_degree': 1, 'sharding_degree': 4,
+                               'sp_degree': 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    step = fleet.fleet_train_step(m, loss_fn, o, strategy=strategy)
+
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(
+        rng.standard_normal((16, 8)).astype(np.float32)).astype('bfloat16')
+    y = paddle.to_tensor(rng.standard_normal((16, 4)).astype(np.float32))
+    losses = [float(step(x, y).numpy()) for _ in range(3)]
+    assert all(np.isfinite(losses)), losses
+
+    for n, p in m.named_parameters():
+        assert p.dtype == paddle.bfloat16, n
+    shardings = {n: p._data.sharding for n, p in m.named_parameters()}
+    assert any('sharding' in str(s.spec) for s in shardings.values())
+    # masters exist, are f32, were WRITTEN BACK by the jitted step (a
+    # lazily re-created slot would have all-zero moments), and ride the
+    # sharded opt-state pytree
+    import jax.numpy as jnp
+    pmap = dict(m.named_parameters())
+    for n, p in pmap.items():
+        slots = o._get_slots(p)
+        if not p.stop_gradient:
+            assert slots['master'].dtype == jnp.float32, n
+            assert slots['moment1'].dtype == jnp.float32, n
+            assert np.abs(np.asarray(slots['moment1'])).max() > 0, n
+    assert any('sharding' in str(o._get_slots(p)['master'].sharding.spec)
+               for p in pmap.values() if not p.stop_gradient)

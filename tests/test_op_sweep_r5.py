@@ -435,7 +435,6 @@ def _lu_ref_check(x):
 
 SWEEP5B = [
     ('angle', paddle.angle, np.angle, [(3, 4)], {}, False),
-    ('frac', paddle.frac, lambda x: x - np.trunc(x), [(3, 4)], {}, False),
     ('nanmean',
      lambda x: paddle.nanmean(paddle.where(x > 0, x,
                                            paddle.to_tensor(np.nan))),
@@ -446,53 +445,6 @@ SWEEP5B = [
                                           paddle.to_tensor(np.nan))),
      lambda x: np.nansum(np.where(x > 0, x, np.nan)), [(3, 4)], {},
      False),
-    ('cumprod', lambda x: paddle.cumprod(x, dim=1),
-     lambda x: np.cumprod(x, axis=1), [(3, 4)], {}, True),
-    ('diff', lambda x: paddle.diff(x, axis=-1),
-     lambda x: np.diff(x, axis=-1), [(3, 5)], {}, True),
-    ('heaviside', paddle.heaviside,
-     lambda x, y: np.heaviside(x, y), [(3, 4), (3, 4)], {}, False),
-    ('rad2deg', paddle.rad2deg, np.rad2deg, [(3, 4)], {}, False),
-    ('deg2rad', paddle.deg2rad, np.deg2rad, [(3, 4)], {}, False),
-    ('gcd', paddle.gcd, np.gcd,
-     [('int', (3, 4), 20), ('int', (3, 4), 20)], {}, False),
-    ('lcm', paddle.lcm, np.lcm,
-     [('int', (3, 4), 12), ('int', (3, 4), 12)], {}, False),
-    ('outer', paddle.outer, np.outer, [(4,), (5,)], {}, True),
-    ('kron', paddle.kron, np.kron, [(2, 3), (3, 2)], {}, True),
-    ('trace_op', paddle.trace, np.trace, [(4, 4)], {}, True),
-    ('diagonal', paddle.diagonal,
-     lambda x: np.diagonal(x), [(4, 5)], {}, True),
-    ('cov', lambda x: paddle.cov(x),
-     lambda x: np.cov(x), [(3, 8)], {}, True),
-    ('corrcoef', lambda x: paddle.corrcoef(x),
-     lambda x: np.corrcoef(x), [(3, 8)], {}, False),
-    ('searchsorted',
-     lambda s, v: paddle.searchsorted(paddle.sort(s), v),
-     lambda s, v: np.searchsorted(np.sort(s), v).astype(np.int64),
-     [(8,), (5,)], {}, False),
-    ('take_along_axis',
-     lambda x, i: paddle.take_along_axis(x, i, axis=1),
-     lambda x, i: np.take_along_axis(x, i.astype(int), axis=1),
-     [(3, 4), ('int', (3, 2), 4)], {}, True),
-    ('multi_dot', lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
-     lambda a, b, c: a @ b @ c, [(3, 4), (4, 5), (5, 2)], {}, True),
-    ('matrix_power', lambda x: paddle.linalg.matrix_power(x, 3),
-     lambda x: np.linalg.matrix_power(x, 3), [(4, 4)], {}, False),
-    ('pinv', lambda x: paddle.linalg.pinv(x),
-     lambda x: np.linalg.pinv(x), [(4, 3)], {}, False),
-    ('slogdet',
-     lambda x: paddle.linalg.slogdet(x + paddle.to_tensor(
-         4.0 * np.eye(4, dtype=np.float32))),
-     lambda x: np.concatenate([
-         np.asarray(np.linalg.slogdet(x + 4 * np.eye(4,
-                                                     dtype=np.float32)))]
-     ).reshape(2),
-     [(4, 4)], {}, False),
-    ('solve', lambda a, b: paddle.linalg.solve(
-        a + paddle.to_tensor(4.0 * np.eye(4, dtype=np.float32)), b),
-     lambda a, b: np.linalg.solve(a + 4 * np.eye(4, dtype=np.float32), b),
-     [(4, 4), (4, 2)], {}, True),
     ('triangular_solve',
      lambda a, b: paddle.linalg.triangular_solve(
          paddle.tril(a) + paddle.to_tensor(
@@ -500,17 +452,6 @@ SWEEP5B = [
      lambda a, b: np.linalg.solve(
          np.tril(a) + 4 * np.eye(4, dtype=np.float32), b),
      [(4, 4), (4, 2)], {}, True),
-    ('cholesky',
-     lambda a: paddle.linalg.cholesky(
-         paddle.matmul(a, a, transpose_y=True) + paddle.to_tensor(
-             4.0 * np.eye(4, dtype=np.float32))),
-     lambda a: np.linalg.cholesky(a @ a.T + 4 * np.eye(4,
-                                                       dtype=np.float32)),
-     [(4, 4)], {}, False),
-    ('matrix_rank',
-     lambda a: paddle.linalg.matrix_rank(a),
-     lambda a: np.asarray(np.linalg.matrix_rank(a), np.int64), [(4, 3)],
-     {}, False),
 ]
 @pytest.mark.parametrize('case', SWEEP5B, ids=[c[0] for c in SWEEP5B])
 def test_op_sweep_r5b(case):
@@ -520,7 +461,11 @@ def test_op_sweep_r5b(case):
 def test_put_along_axis_matches_numpy():
     rng = np.random.RandomState(9)
     x = rng.randn(3, 4).astype(np.float32)
-    idx = rng.randint(0, 4, (3, 2)).astype(np.int64)
+    # per-row-UNIQUE indices: duplicate-index scatter-set ordering is
+    # unspecified in XLA, so a duplicated column would make the expected
+    # result backend-dependent
+    idx = np.stack([rng.permutation(4)[:2] for _ in range(3)]).astype(
+        np.int64)
     v = rng.randn(3, 2).astype(np.float32)
     out = paddle.put_along_axis(paddle.to_tensor(x), paddle.to_tensor(idx),
                                 paddle.to_tensor(v), axis=1)
@@ -545,11 +490,14 @@ def test_lu_reconstructs():
     _lu_ref_check(x)
 
 
-def test_broadcast_tensors_shapes():
-    a = paddle.to_tensor(np.ones((1, 4), np.float32))
-    b = paddle.to_tensor(np.ones((3, 1), np.float32))
-    oa, ob = paddle.broadcast_tensors([a, b])
-    assert tuple(oa.shape) == (3, 4) and tuple(ob.shape) == (3, 4)
+def test_broadcast_tensors_values():
+    a_np = np.arange(4, dtype=np.float32).reshape(1, 4)
+    b_np = 10.0 * np.arange(3, dtype=np.float32).reshape(3, 1)
+    oa, ob = paddle.broadcast_tensors([paddle.to_tensor(a_np),
+                                       paddle.to_tensor(b_np)])
+    ra, rb = np.broadcast_arrays(a_np, b_np)
+    np.testing.assert_array_equal(oa.numpy(), ra)
+    np.testing.assert_array_equal(ob.numpy(), rb)
 
 
 def test_unique_consecutive_matches_numpy():

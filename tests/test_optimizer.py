@@ -287,3 +287,26 @@ def test_grad_merge_bf16_acc_is_f32():
     assert np.isfinite(losses.astype(np.float32)).all()
     for p in model.parameters():
         assert p.dtype == paddle.bfloat16
+
+
+def test_bf16_step_compiles_once():
+    """The jitted step must not retrace after the first bf16 step: the
+    old dtype drift silently recompiled to an f32 program on step 2 (the
+    f32-matmul slowdown behind the r3/r4 197-198 ms/step TPU plateau)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import TrainStep
+
+    paddle.seed(29)
+    m = paddle.nn.Linear(8, 4)
+    m.bfloat16()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=m.parameters())
+    step = TrainStep(m, lambda o, y: paddle.nn.functional.mse_loss(
+        o.astype('float32'), y), opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randn(4, 8).astype(np.float32)).astype('bfloat16')
+    y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+    for _ in range(3):
+        step(x, y)
+    assert step._jitted._cache_size() == 1

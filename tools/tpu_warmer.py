@@ -160,6 +160,27 @@ def main():
         record('profile_' + label, result, err, wall)
         log('profile(%s): %s (%.0fs)' % (
             label, 'ok -> %s' % pdir if result is not None else err, wall))
+    # BASELINE configs 2/4 (ResNet train throughput, YOLO inference):
+    # bench_extra prints one JSON line per config
+    if probe_tpu():
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, 'bench_extra.py')],
+                capture_output=True, text=True, timeout=1800)
+            for line in proc.stdout.strip().splitlines():
+                line = line.strip()
+                if line.startswith('{'):
+                    try:
+                        entry = json.loads(line)
+                    except ValueError:
+                        continue
+                    record(entry.get('metric', 'bench_extra'), entry, None,
+                           time.time() - t0)
+                    log('extra %s: %s' % (entry.get('metric'),
+                                          entry.get('value')))
+        except subprocess.TimeoutExpired:
+            log('bench_extra timed out')
     log('warmer done')
 
 

@@ -77,6 +77,18 @@ def log(msg):
         f.write(line + '\n')
 
 
+def _json_lines(stdout):
+    out = []
+    for line in (stdout or '').strip().splitlines():
+        line = line.strip()
+        if line.startswith('{'):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
 def run_child(label, extra_env, timeout=1500):
     env = dict(os.environ)
     env['PADDLE_TPU_BENCH_CHILD'] = '1'
@@ -87,13 +99,9 @@ def run_child(label, extra_env, timeout=1500):
                               text=True, env=env, timeout=timeout)
     except subprocess.TimeoutExpired:
         return None, 'timeout>%ds' % timeout, time.time() - t0
-    for line in reversed(proc.stdout.strip().splitlines()):
-        line = line.strip()
-        if line.startswith('{'):
-            try:
-                return json.loads(line), None, time.time() - t0
-            except ValueError:
-                continue
+    entries = _json_lines(proc.stdout)
+    if entries:
+        return entries[-1], None, time.time() - t0
     return None, 'rc=%d: %s' % (proc.returncode,
                                 (proc.stderr or '')[-300:]), time.time() - t0
 
@@ -168,18 +176,22 @@ def main():
             proc = subprocess.run(
                 [sys.executable, os.path.join(REPO, 'bench_extra.py')],
                 capture_output=True, text=True, timeout=1800)
-            for line in proc.stdout.strip().splitlines():
-                line = line.strip()
-                if line.startswith('{'):
-                    try:
-                        entry = json.loads(line)
-                    except ValueError:
-                        continue
-                    record(entry.get('metric', 'bench_extra'), entry, None,
-                           time.time() - t0)
-                    log('extra %s: %s' % (entry.get('metric'),
-                                          entry.get('value')))
+            entries = _json_lines(proc.stdout)
+            wall = time.time() - t0
+            if not entries:
+                record('bench_extra', None,
+                       'rc=%d: %s' % (proc.returncode,
+                                      (proc.stderr or '')[-300:]), wall)
+                log('bench_extra: no JSON output (rc=%d)' % proc.returncode)
+            for entry in entries:
+                # wall is the whole two-config process; per-row timing is
+                # not observable from outside, so mark it as shared
+                record(entry.get('metric', 'bench_extra'),
+                       dict(entry, wall_shared=True), None, wall)
+                log('extra %s: %s' % (entry.get('metric'),
+                                      entry.get('value')))
         except subprocess.TimeoutExpired:
+            record('bench_extra', None, 'timeout>1800s', time.time() - t0)
             log('bench_extra timed out')
     log('warmer done')
 

@@ -18,7 +18,8 @@ from jax import lax
 
 __all__ = ['ring_attention', 'ulysses_attention', 'ring_attention_sharded',
            'ulysses_attention_sharded', 'ring_flash_attention',
-           'ring_flash_attention_sharded']
+           'ring_flash_attention_sharded', 'zigzag_ring_attention',
+           'zigzag_layout_indices']
 
 
 def _block_attn(q, k, v, scale, mask, drop_p=0.0, drop_key=None):
@@ -45,6 +46,21 @@ def _block_attn(q, k, v, scale, mask, drop_p=0.0, drop_key=None):
     acc = jnp.einsum('bhqk,bkhd->bqhd', p_v.astype(v.dtype), v,
                      preferred_element_type=jnp.float32)
     return m, l, acc
+
+
+def _merge_blocks(carry, blk):
+    """Online-softmax merge of two (m, l, acc) streaming-attention states.
+    Safe against an empty carry (m = -inf, l = 0, acc = 0) as long as the
+    incoming block's m is finite."""
+    m_prev, l_prev, acc_prev = carry
+    m_blk, l_blk, acc_blk = blk
+    m_new = jnp.maximum(m_prev, m_blk)
+    alpha = jnp.exp(m_prev - m_new)
+    beta = jnp.exp(m_blk - m_new)
+    l_new = alpha * l_prev + beta * l_blk
+    acc_new = acc_prev * jnp.moveaxis(alpha, 1, 2)[..., None] + \
+        acc_blk * jnp.moveaxis(beta, 1, 2)[..., None]
+    return m_new, l_new, acc_new
 
 
 def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
@@ -82,14 +98,10 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
             mask = None
         blk_key = (jax.random.fold_in(dropout_key, src)
                    if dropout_p and dropout_key is not None else None)
-        m_blk, l_blk, acc_blk = _block_attn(q32, k_cur, v_cur, scale, mask,
-                                            dropout_p, blk_key)
-        m_new = jnp.maximum(m_prev, m_blk)
-        alpha = jnp.exp(m_prev - m_new)
-        beta = jnp.exp(m_blk - m_new)
-        l_new = alpha * l_prev + beta * l_blk
-        acc_new = acc_prev * jnp.moveaxis(alpha, 1, 2)[..., None] + \
-            acc_blk * jnp.moveaxis(beta, 1, 2)[..., None]
+        blk = _block_attn(q32, k_cur, v_cur, scale, mask,
+                          dropout_p, blk_key)
+        m_new, l_new, acc_new = _merge_blocks((m_prev, l_prev, acc_prev),
+                                              blk)
         # rotate kv to the next rank (ring)
         perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -103,6 +115,116 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
                                     jnp.arange(n_dev))
     l = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
     return (acc / l).astype(q.dtype)
+
+
+def zigzag_ring_attention(q, k, v, axis_name='sp', scale=None,
+                          dropout_p=0.0, dropout_key=None, causal=True):
+    """Load-balanced CAUSAL ring attention (zigzag layout).
+
+    The plain causal ring computes every (q-shard, kv-shard) pair and
+    masks the future ones — and since SPMD wall-clock is gated by the
+    last rank (which masks nothing), the masked flops are pure waste.
+    Zigzag rebalances by layout: with P ranks the sequence is cut into
+    2P chunks of size c and rank r holds rows [chunk r ; chunk 2P-1-r]
+    (the caller permutes — sp.sp_attention does this outside shard_map).
+    Visibility then collapses to a uniform schedule:
+
+      - local step: lo-lo (tri), hi-lo (full), hi-hi (tri)
+      - every other ring step exactly TWO full c x c quadrants:
+        hi-q vs src-lo-kv always, plus lo-q vs src-lo-kv when r > src
+        else hi-q vs src-hi-kv — chosen by jnp.where on the operands,
+        so every rank does identical work and no masked block is ever
+        computed: ~2x the causal throughput of the plain ring.
+
+    (Brandon et al. striped attention / zigzag ring — public technique.)
+    Requires causal=True (the balance argument IS causality) and an even
+    local row count.
+    """
+    assert causal, 'zigzag_ring_attention is causal-only; use ring_attention'
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n_dev = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, n_loc, h, d = q.shape
+    assert n_loc % 2 == 0, 'zigzag needs an even local row count'
+    c = n_loc // 2
+    two_p = 2 * n_dev
+
+    q32 = q.astype(jnp.float32)
+    q_lo, q_hi = q32[:, :c], q32[:, c:]
+    lo_chunk, hi_chunk = r, two_p - 1 - r
+    tri = jnp.tril(jnp.ones((c, c), bool))
+
+    def blk_key(q_chunk, kv_chunk):
+        if not (dropout_p and dropout_key is not None):
+            return None
+        return jax.random.fold_in(
+            jax.random.fold_in(dropout_key, q_chunk), kv_chunk)
+
+    # local step (src == r): the only masked quadrants in the schedule
+    k_lo, k_hi = k[:, :c], k[:, c:]
+    v_lo, v_hi = v[:, :c], v[:, c:]
+    lo_c = _block_attn(q_lo, k_lo, v_lo, scale, tri, dropout_p,
+                       blk_key(lo_chunk, lo_chunk))
+    hi_c = _block_attn(q_hi, k_lo, v_lo, scale, None, dropout_p,
+                       blk_key(hi_chunk, lo_chunk))
+    hi_c = _merge_blocks(hi_c, _block_attn(q_hi, k_hi, v_hi, scale, tri,
+                                           dropout_p,
+                                           blk_key(hi_chunk, hi_chunk)))
+
+    def step(carry, t):
+        lo_c, hi_c, k_cur, v_cur = carry
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        src = jnp.mod(r - t, n_dev)
+        src_hi = two_p - 1 - src
+        kl, kh = k_cur[:, :c], k_cur[:, c:]
+        vl, vh = v_cur[:, :c], v_cur[:, c:]
+        # quadrant A: hi-q sees every lo chunk — always full
+        hi_c = _merge_blocks(hi_c, _block_attn(
+            q_hi, kl, vl, scale, None, dropout_p, blk_key(hi_chunk, src)))
+        # quadrant B: r > src -> lo-q vs src-lo; else hi-q vs src-hi.
+        # Operand selects keep the program uniform across ranks — the
+        # load-balance property — while only visible work is computed.
+        pred = r > src
+        qB = jnp.where(pred, q_lo, q_hi)
+        kB = jnp.where(pred, kl, kh)
+        vB = jnp.where(pred, vl, vh)
+        keyB = blk_key(jnp.where(pred, lo_chunk, hi_chunk),
+                       jnp.where(pred, src, src_hi))
+        blkB = _block_attn(qB, kB, vB, scale, None, dropout_p, keyB)
+        lo_new = _merge_blocks(lo_c, blkB)
+        hi_new = _merge_blocks(hi_c, blkB)
+        sel = lambda a, b_: jnp.where(pred, a, b_)
+        lo_c = jax.tree_util.tree_map(sel, lo_new, lo_c)
+        hi_c = jax.tree_util.tree_map(sel, hi_c, hi_new)
+        return (lo_c, hi_c, k_cur, v_cur), None
+
+    if n_dev > 1:
+        (lo_c, hi_c, _, _), _ = lax.scan(
+            step, (lo_c, hi_c, k, v), jnp.arange(1, n_dev))
+
+    def finish(cr):
+        m, l, acc = cr
+        l = jnp.moveaxis(jnp.maximum(l, 1e-30), 1, 2)[..., None]
+        return acc / l
+    out = jnp.concatenate([finish(lo_c), finish(hi_c)], axis=1)
+    return out.astype(q.dtype)
+
+
+def zigzag_layout_indices(n, n_dev):
+    """Global gather indices taking a contiguous sequence to the zigzag
+    layout (rank r <- chunks r and 2P-1-r), and the inverse."""
+    import numpy as np
+    c = n // (2 * n_dev)
+    idx = np.concatenate([
+        np.concatenate([np.arange(r * c, (r + 1) * c),
+                        np.arange((2 * n_dev - 1 - r) * c,
+                                  (2 * n_dev - r) * c)])
+        for r in range(n_dev)])
+    inv = np.argsort(idx)
+    return idx, inv
 
 
 def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,

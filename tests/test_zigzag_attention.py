@@ -1,0 +1,137 @@
+"""Zigzag (load-balanced) causal ring attention.
+
+Parity bar: must match the quadratic causal reference exactly (fwd and
+grads) through the sp_attention entry, like the plain ring. Balance bar:
+per-rank matmul flops must be the lower-triangle schedule — (2P+1)/(4P)
+of the plain ring's compute-then-mask — asserted on the shard_map body's
+jaxpr with scan trip counts weighted in.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import paddle_tpu  # noqa: F401  (forces the 8-device CPU mesh via conftest)
+from paddle_tpu.distributed import sp as sp_mod
+from paddle_tpu.ops import ring_attention as ra
+
+from test_blockwise_attention import _weighted_dot_flops
+
+
+def _mesh(n):
+    devs = np.array(jax.devices()[:n])
+    return Mesh(devs, ('sp',))
+
+
+def _ref_causal(q, k, v, scale):
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    n = s.shape[-1]
+    s = jnp.where(jnp.tril(jnp.ones((n, n), bool))[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.mark.parametrize('sp,n', [(2, 8), (4, 16), (8, 32), (4, 64)])
+def test_zigzag_matches_reference_fwd(sp, n):
+    rng = np.random.RandomState(0)
+    b, h, d = 2, 2, 16
+    q = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    mesh = _mesh(sp)
+    st = sp_mod.make_sp_state(mesh, axis='sp', mode='zigzag')
+    out = sp_mod.sp_attention(q, k, v, causal=True, scale=scale, state=st)
+    ref = _ref_causal(q, k, v, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_matches_reference_grads():
+    rng = np.random.RandomState(1)
+    b, n, h, d = 1, 16, 2, 8
+    q = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    scale = 1.0 / np.sqrt(d)
+    mesh = _mesh(4)
+    st = sp_mod.make_sp_state(mesh, axis='sp', mode='zigzag')
+
+    def loss_z(q, k, v):
+        return jnp.sum(sp_mod.sp_attention(q, k, v, causal=True,
+                                           scale=scale, state=st) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_causal(q, k, v, scale) ** 2)
+
+    gz = jax.grad(loss_z, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gz, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_zigzag_flops_are_lower_triangle():
+    """Per-rank matmul flops: plain causal ring computes all 4 quadrants
+    per ring step (then masks); zigzag computes 2P+1 quadrants total vs
+    the ring's 4P."""
+    sp, n, b, h, d = 4, 32, 1, 2, 16
+    mesh = _mesh(sp)
+    x = jnp.zeros((b, n, h, d), jnp.float32)
+    spec = P(None, 'sp', None, None)
+
+    def count(fn, **kw):
+        import functools
+        wrapped = shard_map(
+            functools.partial(fn, axis_name='sp', **kw), mesh=mesh,
+            in_specs=(spec, spec, spec), out_specs=spec, check_rep=False)
+        return _weighted_dot_flops(jax.make_jaxpr(wrapped)(x, x, x).jaxpr)
+
+    ring = count(ra.ring_attention, causal=True)
+    zig = count(ra.zigzag_ring_attention)
+    assert zig == ring * (2 * sp + 1) // (4 * sp), (zig, ring)
+
+
+def test_zigzag_dropout_deterministic_and_varying():
+    rng = np.random.RandomState(3)
+    b, n, h, d = 1, 16, 2, 8
+    q = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    mesh = _mesh(4)
+    st = sp_mod.make_sp_state(mesh, axis='sp', mode='zigzag')
+    key = jax.random.PRNGKey(7)
+
+    def run(key):
+        return np.asarray(sp_mod.sp_attention(
+            q, q, q, causal=True, scale=0.35, state=st,
+            dropout_p=0.5, dropout_key=key))
+
+    a, b_ = run(key), run(key)
+    np.testing.assert_array_equal(a, b_)          # same key -> same masks
+    c = run(jax.random.PRNGKey(8))
+    assert np.abs(a - c).max() > 0                # new key -> new masks
+    # p=0 path equals the no-dropout path
+    nd = np.asarray(sp_mod.sp_attention(q, q, q, causal=True, scale=0.35,
+                                        state=st))
+    z = np.asarray(sp_mod.sp_attention(q, q, q, causal=True, scale=0.35,
+                                       state=st, dropout_p=0.0,
+                                       dropout_key=key))
+    np.testing.assert_allclose(nd, z, rtol=1e-6)
+
+
+def test_zigzag_falls_back_when_not_applicable():
+    rng = np.random.RandomState(5)
+    b, n, h, d = 1, 16, 2, 8
+    q = jnp.asarray(rng.randn(b, n, h, d), jnp.float32)
+    mesh = _mesh(4)
+    st = sp_mod.make_sp_state(mesh, axis='sp', mode='zigzag')
+    # non-causal: falls back to the plain ring and stays correct
+    out = sp_mod.sp_attention(q, q, q, causal=False, scale=0.35, state=st)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q, q) * 0.35
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum('bhqk,bkhd->bqhd', p, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

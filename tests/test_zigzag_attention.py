@@ -135,3 +135,12 @@ def test_zigzag_falls_back_when_not_applicable():
     ref = jnp.einsum('bhqk,bkhd->bqhd', p, q)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+    # causal but N not divisible by 2P (24 % 8 != 0): same downgrade,
+    # must still match the quadratic causal reference
+    n2 = 24
+    q2 = jnp.asarray(rng.randn(b, n2, h, d), jnp.float32)
+    out2 = sp_mod.sp_attention(q2, q2, q2, causal=True, scale=0.35,
+                               state=st)
+    ref2 = _ref_causal(q2, q2, q2, 0.35)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
+                               rtol=2e-5, atol=2e-5)

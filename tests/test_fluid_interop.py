@@ -579,3 +579,72 @@ def test_reshape_neg1_and_decrease_axis_execute(tmp_path):
     out, = prog.run({'x': x})
     assert out.shape == (6,)
     np.testing.assert_allclose(out, x.reshape(-1, 6)[0], rtol=1e-6)
+
+
+def _word2vec_dir(tmp_path):
+    """The word2vec book-test graph (test_word2vec_book.py shape): four
+    context words share ONE embedding table (lookup_table_v2), concat,
+    fc, softmax over the vocab."""
+    rng = np.random.RandomState(7)
+    vocab, emb, n_ctx = 50, 8, 4
+    table = rng.randn(vocab, emb).astype(np.float32)
+    fc_w = rng.randn(n_ctx * emb, vocab).astype(np.float32)
+    fc_b = rng.randn(vocab).astype(np.float32)
+
+    int64 = 3
+    variables = [
+        _var('feed', vtype=9, persistable=True),
+        _var('fetch', vtype=10, persistable=True),
+        _var('emb_table', dims=[vocab, emb], persistable=True),
+        _var('fc_w', dims=[n_ctx * emb, vocab], persistable=True),
+        _var('fc_b', dims=[vocab], persistable=True),
+        _var('cat', dims=[-1, n_ctx * emb]),
+        _var('fc_tmp', dims=[-1, vocab]),
+        _var('logits', dims=[-1, vocab]),
+        _var('prob', dims=[-1, vocab]),
+    ]
+    ops = []
+    for i in range(n_ctx):
+        variables.append(_var('w%d' % i, dims=[-1], dtype=int64))
+        variables.append(_var('emb%d' % i, dims=[-1, emb]))
+        ops.append(_op('feed', [('X', ['feed'])], [('Out', ['w%d' % i])],
+                       [('col', 0, i)]))
+    for i in range(n_ctx):
+        ops.append(_op('lookup_table_v2',
+                       [('Ids', ['w%d' % i]), ('W', ['emb_table'])],
+                       [('Out', ['emb%d' % i])]))
+    ops += [
+        _op('concat', [('X', ['emb%d' % i for i in range(n_ctx)])],
+            [('Out', ['cat'])], [('axis', 0, 1)]),
+        _op('mul', [('X', ['cat']), ('Y', ['fc_w'])],
+            [('Out', ['fc_tmp'])],
+            [('x_num_col_dims', 0, 1), ('y_num_col_dims', 0, 1)]),
+        _op('elementwise_add', [('X', ['fc_tmp']), ('Y', ['fc_b'])],
+            [('Out', ['logits'])], [('axis', 0, 1)]),
+        _op('softmax', [('X', ['logits'])], [('Out', ['prob'])],
+            [('axis', 0, -1)]),
+        _op('fetch', [('X', ['prob'])], [('Out', ['fetch'])],
+            [('col', 0, 0)]),
+    ]
+    d = tmp_path / 'word2vec'
+    d.mkdir()
+    (d / '__model__').write_bytes(_program([_block(variables, ops)]))
+    for name, arr in (('emb_table', table), ('fc_w', fc_w),
+                      ('fc_b', fc_b)):
+        with open(d / name, 'wb') as f:
+            _write_lod_tensor(f, arr)
+    return d, table, fc_w, fc_b
+
+
+def test_word2vec_reference_model_serves(tmp_path):
+    d, table, fc_w, fc_b = _word2vec_dir(tmp_path)
+    pred = create_predictor(Config(str(d)))
+    assert pred.get_input_names() == ['w0', 'w1', 'w2', 'w3']
+    rng = np.random.RandomState(8)
+    ids = [rng.randint(0, 50, (6,)).astype(np.int64) for _ in range(4)]
+    out, = pred.run(ids)
+    cat = np.concatenate([table[i] for i in ids], axis=1)
+    logits = cat @ fc_w + fc_b
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    ref = e / e.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

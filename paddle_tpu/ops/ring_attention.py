@@ -254,19 +254,32 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
     if attn_fn is None:
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
-        s = jnp.einsum('bqhd,bkhd->bhqk', qf.astype(jnp.float32), kf,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            n = s.shape[-1]
-            cm = jnp.tril(jnp.ones((n, n), bool))
-            s = jnp.where(cm[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        if dropout_p and dropout_key is not None:
-            # the caller folds the rank in; local heads draw iid masks
-            keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
-                                        p.shape)
-            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
-        of = jnp.einsum('bhqk,bkhd->bqhd', p.astype(vf.dtype), vf)
+        n_full = qf.shape[1]
+        if causal and not (dropout_p and dropout_key is not None) \
+                and n_full >= 1024 and n_full % 512 == 0 \
+                and n_full // 512 <= 64:
+            # (the divisibility/block-count guard mirrors blockwise's own
+            # causal-skip precondition — without it, odd lengths would
+            # degenerate to tiny-block fallbacks slower than quadratic)
+            # long causal sequences: the local full-sequence attention is
+            # where Ulysses burns its flops — route through the blockwise
+            # causal-skip path (ops/blockwise_attention.py) so future KV
+            # blocks are never computed (and memory stays O(N))
+            from .blockwise_attention import blockwise_attention
+            of = blockwise_attention(qf, kf, vf, causal=True, scale=scale)
+        else:
+            s = jnp.einsum('bqhd,bkhd->bhqk', qf.astype(jnp.float32), kf,
+                           preferred_element_type=jnp.float32) * scale
+            if causal:
+                cm = jnp.tril(jnp.ones((n_full, n_full), bool))
+                s = jnp.where(cm[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            if dropout_p and dropout_key is not None:
+                # the caller folds the rank in; local heads draw iid masks
+                keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                            p.shape)
+                p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            of = jnp.einsum('bhqk,bkhd->bqhd', p.astype(vf.dtype), vf)
     else:
         of = attn_fn(qf, kf, vf)
     return head2seq(of.astype(q.dtype))

@@ -114,10 +114,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if use_blockwise and q._data.ndim == 4 and mask_arr is None and \
             dropout_p == 0.0:
         from ...ops import blockwise_attention as bw
+        # smaller blocks widen the causal-skip window (tq = N/block must
+        # be > 1 for any future block to exist); tunable for benchmarking
+        blk = int(os.environ.get('PADDLE_TPU_BLOCKWISE_BLOCK', 512))
 
         def fn(qq, kk, vv):
             return bw.blockwise_attention(qq, kk, vv, causal=is_causal,
-                                          scale=scale)
+                                          scale=scale, block_q=blk,
+                                          block_k=blk)
         return run_op('blockwise_attention', fn, q, k, v)
 
     # attention-prob dropout rides the framework RNG stream (same

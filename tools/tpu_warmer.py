@@ -55,6 +55,13 @@ CONFIGS = [
                                   'PADDLE_TPU_FLASH_STRICT': '0',
                                   'PADDLE_TPU_BENCH_BATCH': '64',
                                   'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    # causal block-skip at seq 512: tq=4 computes 62.5% of the attention
+    # flops — does the chunking beat XLA's fused quadratic on-chip?
+    ('blockwise_b128_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
+                              'PADDLE_TPU_FLASH_STRICT': '0',
+                              'PADDLE_TPU_ATTN_IMPL': 'blockwise',
+                              'PADDLE_TPU_BLOCKWISE_BLOCK': '128',
+                              'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
     # long-context: blockwise (pure-XLA flash-shape) vs quadratic+remat
     ('blockwise_seq2048_b8_scan4', {'PADDLE_TPU_FLASH_DISABLE': '1',
                                     'PADDLE_TPU_FLASH_STRICT': '0',

@@ -30,6 +30,23 @@ def _blockwise_min_seq():
     return int(os.environ.get('PADDLE_TPU_BLOCKWISE_MIN_SEQ', 1024))
 
 
+def _blockwise_block(seq_len):
+    """PADDLE_TPU_BLOCKWISE_BLOCK: blockwise attention chunk size (one
+    home for the 512 default shared with ops/blockwise_attention.py).
+    Values that cannot tile the sequence (non-divisors, <= 0) would
+    silently degrade to 1-row blocks — reject them loudly instead."""
+    blk = int(os.environ.get('PADDLE_TPU_BLOCKWISE_BLOCK', 512))
+    if blk <= 0:
+        raise ValueError('PADDLE_TPU_BLOCKWISE_BLOCK must be positive, '
+                         'got %d' % blk)
+    eff = min(blk, seq_len)
+    if seq_len % eff:
+        raise ValueError(
+            'PADDLE_TPU_BLOCKWISE_BLOCK=%d does not tile seq len %d '
+            '(pick a divisor)' % (blk, seq_len))
+    return blk
+
+
 def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, drop_key=None):
     # q,k,v: [B, N, H, D] paddle layout
     qt = jnp.swapaxes(q, 1, 2)  # B,H,N,D
@@ -116,7 +133,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...ops import blockwise_attention as bw
         # smaller blocks widen the causal-skip window (tq = N/block must
         # be > 1 for any future block to exist); tunable for benchmarking
-        blk = int(os.environ.get('PADDLE_TPU_BLOCKWISE_BLOCK', 512))
+        blk = _blockwise_block(int(q.shape[1]))
 
         def fn(qq, kk, vv):
             return bw.blockwise_attention(qq, kk, vv, causal=is_causal,

@@ -171,7 +171,9 @@ def _run_measurement():
         labels_k = paddle.to_tensor(_np.broadcast_to(
             labels.numpy(), (scan_k,) + tuple(labels.shape)).copy())
         losses = step.multi_step(ids_k, labels_k)
-        for _ in range(max(1, warmup // scan_k)):
+        # the relay's dispatch path ramps over the first dispatches, not
+        # steps — warm at least 3 dispatches regardless of K
+        for _ in range(max(3, -(-warmup // scan_k))):
             losses = step.multi_step(ids_k, labels_k)
         _ = losses.numpy()
     else:

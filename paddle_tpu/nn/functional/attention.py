@@ -54,8 +54,15 @@ def _sdpa_ref(q, k, v, mask, dropout_p, causal, scale, drop_key=None):
     vt = jnp.swapaxes(v, 1, 2)
     s = jnp.einsum('bhqd,bhkd->bhqk', qt, kt) * scale
     if causal:
+        # bottom-right aligned (flash-attn convention): query i sits at
+        # absolute position (m - n) + i, so KV-cache decode (n=1, m=T)
+        # sees the whole cache. Top-left tril would mask it to key 0.
         n, m = s.shape[-2], s.shape[-1]
-        cm = jnp.tril(jnp.ones((n, m), bool))
+        if n > m:
+            raise ValueError(
+                'causal attention with more queries (%d) than keys (%d): '
+                'the leading query rows would have no visible key' % (n, m))
+        cm = jnp.tril(jnp.ones((n, m), bool), m - n)
         s = jnp.where(cm, s, -1e30)
     if mask is not None:
         s = s + mask

@@ -49,6 +49,10 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
     """
     b, h, n, d = q.shape
     m = k.shape[2]
+    if causal and n > m:
+        raise ValueError(
+            'causal attention with more queries (%d) than keys (%d)'
+            % (n, m))
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     bq = _pick_block(n, block_q)
@@ -70,7 +74,11 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
             kj, vj, j = xs
             keep = None
             if causal:
-                qpos = i * bq + jnp.arange(bq)
+                # bottom-right aligned: query row i*bq+row sits at
+                # absolute key position (m - n) + i*bq + row, so causal
+                # cross-attention (KV-cache decode, chunked prefill)
+                # sees the full prefix
+                qpos = (m - n) + i * bq + jnp.arange(bq)
                 kpos = j * bk + jnp.arange(bk)
                 keep = qpos[:, None] >= kpos[None, :]
             return _online_step(carry, q32, kj, vj, keep), None

@@ -64,8 +64,15 @@ def _supported(q, k, v):
 def _ref_bhnd(q, k, v, causal, scale):
     s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * scale
     if causal:
+        # bottom-right aligned: query i is at absolute position m-n+i
+        # (KV-cache decode correctness; flash-attn convention)
         n, m = s.shape[-2], s.shape[-1]
-        s = jnp.where(jnp.tril(jnp.ones((n, m), bool)), s, _NEG_INF)
+        if n > m:
+            raise ValueError(
+                'causal attention with more queries (%d) than keys (%d)'
+                % (n, m))
+        s = jnp.where(jnp.tril(jnp.ones((n, m), bool), m - n), s,
+                      _NEG_INF)
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum('bhqk,bhkd->bhqd', p, v)
 
@@ -309,6 +316,16 @@ def _flash_bhnd(q, k, v, causal, scale):
 
 def _dispatch_fwd(q, k, v, causal, scale):
     """Returns (o, lse_or_None); lse None means the jnp path ran."""
+    if causal and q.shape[2] != k.shape[2]:
+        # the Pallas kernels' causal block bounds assume self-attention
+        # (q_pos = global q index); cross-length causal (KV-cache decode,
+        # chunked prefill) takes the bottom-right-aligned blockwise path,
+        # which keeps memory O(N*D) for a long cache. This is a semantics
+        # contract, not a capability fallback — strict mode (a bench-
+        # honesty guard for the n == m training shape) does not apply.
+        from .blockwise_attention import blockwise_attention_bnhd
+        return blockwise_attention_bnhd(q, k, v, causal=True,
+                                        scale=scale), None
     reason = _supported(q, k, v)
     if reason is not None:
         if strict_mode():
@@ -331,6 +348,11 @@ def _fwd_rule(q, k, v, causal, scale):
 
 def _bwd_rule(causal, scale, res, do):
     q, k, v, o, lse = res
+    if causal and q.shape[2] != k.shape[2]:
+        from .blockwise_attention import blockwise_attention_bnhd
+        _, vjp = jax.vjp(lambda a, b, c: blockwise_attention_bnhd(
+            a, b, c, causal=True, scale=scale), q, k, v)
+        return vjp(do)
     if lse is not None:
         if strict_mode():
             return _bwd_impl(q, k, v, o, lse, do, causal, scale)

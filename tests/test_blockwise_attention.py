@@ -18,8 +18,9 @@ def _ref_bnhd(q, k, v, causal, scale):
     s = jnp.einsum('bhqd,bhkd->bhqk', q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
+        # bottom-right aligned (decode-correct; flash-attn convention)
         n, m = s.shape[-2], s.shape[-1]
-        s = jnp.where(jnp.tril(jnp.ones((n, m), bool)), s, -1e30)
+        s = jnp.where(jnp.tril(jnp.ones((n, m), bool), m - n), s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum('bhqk,bhkd->bhqd', p,
                       v.astype(jnp.float32)).astype(q.dtype)
@@ -276,3 +277,26 @@ def test_causal_cross_attention_fallback():
     ref = _ref_bnhd(q, k, v, True, scale)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_kv_cache_decode_matches_full_forward():
+    """Bottom-right causal alignment end-to-end: GPT incremental decode
+    with a KV cache must reproduce the full forward's last position.
+    Regression: the top-left tril masked the decode token down to key 0."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTAttention
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position_embeddings=8, dropout=0.0)
+    attn = GPTAttention(cfg)
+    attn.eval()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(1, 4, 16).astype(np.float32))
+
+    full = attn(x).numpy()
+    out3, cache = attn(paddle.to_tensor(x.numpy()[:, :3]), cache=(
+        paddle.zeros([1, 0, 2, 8]), paddle.zeros([1, 0, 2, 8])))
+    np.testing.assert_allclose(out3.numpy()[0], full[0, :3], atol=1e-5)
+    step4, cache = attn(paddle.to_tensor(x.numpy()[:, 3:4]), cache=cache)
+    np.testing.assert_allclose(step4.numpy()[0, 0], full[0, 3], atol=1e-5)

@@ -10,7 +10,7 @@ import math
 import jax.numpy as jnp
 
 from ... import nn
-from ...framework.core import Tensor
+from ...framework.core import Tensor, no_grad_guard
 from ...nn import functional as F
 from ...tensor import manipulation as M
 
@@ -56,6 +56,33 @@ class GPTConfig:
                          num_heads=40, max_position_embeddings=2048)
 
 
+class GPTStaticCache:
+    """Fixed-shape KV cache for decode: preallocated [B, max_len, H, Dh]
+    buffers plus the current valid length. Every decode step writes via
+    dynamic_update_slice and attends with a validity mask, so all steps
+    share one set of shapes — per-op executables are reused across
+    tokens, and the step is jit-able without retracing per token (a
+    concat-growing cache changes shape every step). Inference-only: the
+    buffer writes bypass the autograd tape."""
+
+    def __init__(self, k_buf, v_buf, length, fresh=False):
+        self.k = k_buf
+        self.v = v_buf
+        self.length = length  # scalar int32 (traced under jit)
+        # python-level marker: no write has happened yet, so a multi-
+        # token prefill may use the plain causal fast path (flash/
+        # blockwise-eligible) instead of masked attention over the
+        # zero-padded buffer
+        self.fresh = fresh
+
+    @staticmethod
+    def empty(batch, max_len, num_heads, head_dim, dtype='float32'):
+        import paddle_tpu as paddle
+        k = paddle.zeros([batch, max_len, num_heads, head_dim], dtype)
+        v = paddle.zeros([batch, max_len, num_heads, head_dim], dtype)
+        return GPTStaticCache(k, v, jnp.zeros((), jnp.int32), fresh=True)
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -77,6 +104,52 @@ class GPTAttention(nn.Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        if isinstance(cache, GPTStaticCache):
+            import jax
+            from ...framework.core import is_grad_enabled
+            if self.training and is_grad_enabled():
+                # the buffer writes bypass the autograd tape: training
+                # through this path would silently drop the k/v grads
+                raise RuntimeError(
+                    'GPTStaticCache is an inference-only decode path — '
+                    'call model.eval() / no_grad / generate()')
+            max_len = cache.k.shape[1]
+            if not isinstance(cache.length, jax.core.Tracer) and \
+                    int(cache.length) + n > max_len:
+                # (under jit the length is a tracer; generate() guards
+                # the budget up front instead)
+                raise ValueError(
+                    'static cache overflow: length %d + %d new tokens > '
+                    'capacity %d' % (int(cache.length), n, max_len))
+            t = cache.length
+            k_buf = jax.lax.dynamic_update_slice(
+                cache.k._data, k._data.astype(cache.k._data.dtype),
+                (0, t, 0, 0))
+            v_buf = jax.lax.dynamic_update_slice(
+                cache.v._data, v._data.astype(cache.v._data.dtype),
+                (0, t, 0, 0))
+            new_cache = GPTStaticCache(Tensor(k_buf), Tensor(v_buf), t + n)
+            if cache.fresh and n > 1:
+                # prefill on an untouched cache: plain causal attention
+                # over the chunk itself (flash/blockwise-eligible) — the
+                # masked full-buffer attention below would pay quadratic
+                # cost against max_len-n empty slots
+                out = F.scaled_dot_product_attention(
+                    q, k, v, is_causal=True, dropout_p=0.0)
+                out = M.reshape(out, [b, n, self.hidden_size])
+                return self.out_proj(out), new_cache
+            # validity mask over the fixed buffer: query row i (absolute
+            # position t+i) sees buffer slots j <= t+i
+            qpos = t + jnp.arange(n)
+            kpos = jnp.arange(max_len)
+            allow = qpos[:, None] >= kpos[None, :]
+            mask = Tensor(jnp.where(allow, 0.0, -1e9)[None, None].astype(
+                jnp.float32))
+            out = F.scaled_dot_product_attention(
+                q, Tensor(k_buf), Tensor(v_buf), attn_mask=mask,
+                is_causal=False, dropout_p=0.0)
+            out = M.reshape(out, [b, n, self.hidden_size])
+            return self.out_proj(out), new_cache
         if cache is not None:
             k = M.concat([cache[0], k], axis=1)
             v = M.concat([cache[1], v], axis=1)
@@ -121,7 +194,12 @@ class GPTBlock(nn.Layer):
         else:
             self.mlp = GPTMLP(config)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache=cache)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
@@ -148,11 +226,23 @@ class GPTModel(nn.Layer):
         checkpoint segments = transformer blocks)."""
         self._recompute = flag
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None):
         n = input_ids.shape[1]
         if position_ids is None:
-            position_ids = Tensor(jnp.arange(n, dtype=jnp.int64)[None, :])
+            if caches is not None:
+                # decode: positions continue from the cached length
+                position_ids = Tensor(
+                    (caches[0].length + jnp.arange(n))[None, :])
+            else:
+                position_ids = Tensor(
+                    jnp.arange(n, dtype=jnp.int64)[None, :])
         x = self.drop(self.wte(input_ids) + self.wpe(position_ids))
+        if caches is not None:
+            new_caches = []
+            for block, c in zip(self.h, caches):
+                x, nc = block(x, cache=c)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         from ...distributed import pipeline as pp_mod
         pp_state = pp_mod.pipeline_state()
         moe = getattr(self.config, 'num_experts', 0) > 0
@@ -200,14 +290,86 @@ class GPTForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, position_ids=None, caches=None):
+        if caches is not None:
+            hidden, new_caches = self.gpt(input_ids, position_ids,
+                                          caches=caches)
+        else:
+            hidden = self.gpt(input_ids, position_ids)
         if self.lm_head is None:
             logits = F.linear(hidden,
                               M.transpose(self.gpt.wte.weight, [1, 0]))
         else:
             logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, new_caches
         return logits
+
+    @no_grad_guard()
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, do_sample=False, seed=0):
+        """Autoregressive generation with a STATIC-shape KV cache.
+
+        TPU-native decode shape: the per-token step uses fixed-size
+        cache buffers (GPTStaticCache) updated by dynamic_update_slice,
+        so every step shares one set of shapes — per-op executables are
+        reused across tokens and the step is jit-able without per-token
+        retracing (decode itself currently dispatches eagerly). The
+        reference ecosystem reaches this via PaddleNLP's decoding; the
+        framework here provides it natively. Greedy by default;
+        do_sample=True draws from softmax(logits/temperature) restricted
+        to top_k (0 = full vocab).
+        """
+        import jax
+        model = self
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids if isinstance(input_ids, Tensor) \
+                else Tensor(jnp.asarray(input_ids))
+            b, n0 = ids.shape[0], ids.shape[1]
+            max_len = n0 + max_new_tokens
+            if max_len > self.config.max_position_embeddings:
+                raise ValueError(
+                    'prompt %d + max_new_tokens %d exceeds '
+                    'max_position_embeddings %d' %
+                    (n0, max_new_tokens, self.config.max_position_embeddings))
+            dtype = self.gpt.wte.weight.dtype
+            caches = [GPTStaticCache.empty(
+                b, max_len, self.config.num_heads,
+                self.config.hidden_size // self.config.num_heads,
+                dtype=str(dtype).replace('paddle.', ''))
+                for _ in self.gpt.h]
+            # prefill: one pass over the prompt seeds the caches
+            logits, caches = model(ids, caches=caches)
+            last = logits[:, -1]
+
+            key = jax.random.PRNGKey(seed)
+
+            def pick(last_logits, key):
+                lg = last_logits._data.astype(jnp.float32)
+                if not do_sample:
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                lg = lg / max(float(temperature), 1e-6)
+                if top_k:
+                    kth = jnp.sort(lg, axis=-1)[:, -int(top_k)][:, None]
+                    lg = jnp.where(lg >= kth, lg, -1e30)
+                return jax.random.categorical(key, lg, axis=-1).astype(
+                    jnp.int32)
+
+            out = [ids._data.astype(jnp.int32)]
+            for step in range(max_new_tokens):
+                key, sub = jax.random.split(key)
+                nxt = pick(last, sub)[:, None]
+                out.append(nxt)
+                if step == max_new_tokens - 1:
+                    break
+                logits, caches = model(Tensor(nxt), caches=caches)
+                last = logits[:, -1]
+            return Tensor(jnp.concatenate(out, axis=1))
+        finally:
+            if was_training:
+                self.train()
 
     def enable_recompute(self, flag=True):
         self.gpt.enable_recompute(flag)

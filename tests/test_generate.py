@@ -1,0 +1,95 @@
+"""GPT autoregressive generation over the static-shape KV cache.
+
+Parity bar: greedy cached decode must reproduce argmax over repeated
+FULL forwards exactly (the cache is an optimization, never a semantics
+change). The static cache keeps every decode step the same shape, so
+per-op executables are reused across tokens.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+
+def _model(**kw):
+    paddle.seed(1)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32, dropout=0.0,
+                    **kw)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_greedy_generate_matches_full_forward():
+    m = _model()
+    rng = np.random.RandomState(0)
+    prompt = paddle.to_tensor(rng.randint(0, 64, (2, 5)).astype(np.int32))
+    out = m.generate(prompt, max_new_tokens=6)
+    assert tuple(out.shape) == (2, 11)
+
+    ids = prompt.numpy().astype(np.int32)
+    for _ in range(6):
+        logits = m(paddle.to_tensor(ids)).numpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)[:, None]
+        ids = np.concatenate([ids, nxt], axis=1)
+    np.testing.assert_array_equal(out.numpy(), ids)
+
+
+def test_sampling_deterministic_and_in_topk():
+    m = _model()
+    rng = np.random.RandomState(2)
+    prompt = paddle.to_tensor(rng.randint(0, 64, (1, 4)).astype(np.int32))
+    s1 = m.generate(prompt, max_new_tokens=5, do_sample=True, top_k=4,
+                    seed=7)
+    s2 = m.generate(prompt, max_new_tokens=5, do_sample=True, top_k=4,
+                    seed=7)
+    np.testing.assert_array_equal(s1.numpy(), s2.numpy())
+    s3 = m.generate(prompt, max_new_tokens=5, do_sample=True, top_k=4,
+                    seed=8)
+    assert s3.numpy().shape == s1.numpy().shape
+
+    # every sampled token must be inside the step's top-k set
+    ids = prompt.numpy().astype(np.int32)
+    gen = s1.numpy()[:, 4:]
+    for i in range(gen.shape[1]):
+        logits = m(paddle.to_tensor(ids)).numpy()[:, -1]
+        topk = np.argsort(logits[0])[-4:]
+        assert gen[0, i] in topk
+        ids = np.concatenate([ids, gen[:, i:i + 1]], axis=1)
+
+
+def test_generate_respects_position_limit():
+    m = _model()
+    prompt = paddle.to_tensor(np.zeros((1, 30), np.int32))
+    with pytest.raises(ValueError, match='max_position_embeddings'):
+        m.generate(prompt, max_new_tokens=10)
+
+
+def test_generate_training_mode_restored():
+    m = _model()
+    m.train()
+    prompt = paddle.to_tensor(np.zeros((1, 3), np.int32))
+    m.generate(prompt, max_new_tokens=2)
+    assert m.training
+
+
+def test_static_cache_overflow_raises():
+    from paddle_tpu.text.models.gpt import GPTStaticCache
+    m = _model()
+    caches = [GPTStaticCache.empty(1, 4, 2, 16) for _ in range(2)]
+    ids = paddle.to_tensor(np.zeros((1, 3), np.int32))
+    _, caches = m(ids, caches=caches)
+    with pytest.raises(ValueError, match='overflow'):
+        m(paddle.to_tensor(np.zeros((1, 2), np.int32)), caches=caches)
+
+
+def test_static_cache_rejects_grad_mode():
+    from paddle_tpu.text.models.gpt import GPTStaticCache
+    m = _model()
+    m.train()
+    caches = [GPTStaticCache.empty(1, 8, 2, 16) for _ in range(2)]
+    ids = paddle.to_tensor(np.zeros((1, 3), np.int32))
+    with pytest.raises(RuntimeError, match='inference-only'):
+        m(ids, caches=caches)

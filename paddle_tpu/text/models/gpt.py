@@ -7,6 +7,7 @@ kernel on TPU.
 """
 import math
 
+import jax
 import jax.numpy as jnp
 
 from ... import nn
@@ -81,6 +82,22 @@ class GPTStaticCache:
         k = paddle.zeros([batch, max_len, num_heads, head_dim], dtype)
         v = paddle.zeros([batch, max_len, num_heads, head_dim], dtype)
         return GPTStaticCache(k, v, jnp.zeros((), jnp.int32), fresh=True)
+
+
+# registered as a pytree so cache stacks cross jit boundaries (the jitted
+# decode step takes and returns them); `fresh` is static aux data — a
+# fresh (prefill) cache and a decode cache intentionally trace differently
+def _cache_flatten(c):
+    return (c.k._data, c.v._data, c.length), c.fresh
+
+
+def _cache_unflatten(fresh, children):
+    k, v, length = children
+    return GPTStaticCache(Tensor(k), Tensor(v), length, fresh=fresh)
+
+
+jax.tree_util.register_pytree_node(GPTStaticCache, _cache_flatten,
+                                   _cache_unflatten)
 
 
 class GPTAttention(nn.Layer):
@@ -310,15 +327,15 @@ class GPTForCausalLM(nn.Layer):
                  top_k=0, do_sample=False, seed=0):
         """Autoregressive generation with a STATIC-shape KV cache.
 
-        TPU-native decode shape: the per-token step uses fixed-size
-        cache buffers (GPTStaticCache) updated by dynamic_update_slice,
-        so every step shares one set of shapes — per-op executables are
-        reused across tokens and the step is jit-able without per-token
-        retracing (decode itself currently dispatches eagerly). The
-        reference ecosystem reaches this via PaddleNLP's decoding; the
-        framework here provides it natively. Greedy by default;
-        do_sample=True draws from softmax(logits/temperature) restricted
-        to top_k (0 = full vocab).
+        TPU-native decode: the per-token step (forward + next-token
+        pick) is ONE jitted program over fixed-size cache buffers
+        (GPTStaticCache, a registered pytree) updated by
+        dynamic_update_slice — identical shapes every token, so XLA
+        traces and compiles the step once and the loop replays the
+        executable. The reference ecosystem reaches this via PaddleNLP's
+        decoding; the framework here provides it natively. Greedy by
+        default; do_sample=True draws from softmax(logits/temperature)
+        restricted to top_k (0 = full vocab).
         """
         import jax
         model = self
@@ -340,14 +357,8 @@ class GPTForCausalLM(nn.Layer):
                 self.config.hidden_size // self.config.num_heads,
                 dtype=str(dtype).replace('paddle.', ''))
                 for _ in self.gpt.h]
-            # prefill: one pass over the prompt seeds the caches
-            logits, caches = model(ids, caches=caches)
-            last = logits[:, -1]
-
-            key = jax.random.PRNGKey(seed)
-
             def pick(last_logits, key):
-                lg = last_logits._data.astype(jnp.float32)
+                lg = last_logits.astype(jnp.float32)
                 if not do_sample:
                     return jnp.argmax(lg, axis=-1).astype(jnp.int32)
                 lg = lg / max(float(temperature), 1e-6)
@@ -357,15 +368,34 @@ class GPTForCausalLM(nn.Layer):
                 return jax.random.categorical(key, lg, axis=-1).astype(
                     jnp.int32)
 
+            # prefill: one pass over the prompt seeds the caches
+            logits, caches = model(ids, caches=caches)
+            last = logits[:, -1]._data
+
+            # the decode step is ONE compiled program (params/buffers/
+            # caches are pytree args; GPTStaticCache is a registered
+            # node): same shapes every token, traced once
+            from ...framework import functional as func_mod
+            params = func_mod.extract_params(self)
+            bufs = func_mod.extract_buffers(self)
+
+            def _step(p, bf, cs, tok, key):
+                (lg, new_cs), _ = func_mod.functional_call(
+                    self, p, bf, args=(Tensor(tok),),
+                    kwargs={'caches': cs}, training=False)
+                return pick(lg[:, -1], key), new_cs
+            step_jit = jax.jit(_step)
+
+            key = jax.random.PRNGKey(seed)
             out = [ids._data.astype(jnp.int32)]
-            for step in range(max_new_tokens):
+            key, sub = jax.random.split(key)
+            nxt = pick(last, sub)[:, None]
+            out.append(nxt)
+            for step in range(max_new_tokens - 1):
                 key, sub = jax.random.split(key)
-                nxt = pick(last, sub)[:, None]
+                nxt_tok, caches = step_jit(params, bufs, caches, nxt, sub)
+                nxt = nxt_tok[:, None]
                 out.append(nxt)
-                if step == max_new_tokens - 1:
-                    break
-                logits, caches = model(Tensor(nxt), caches=caches)
-                last = logits[:, -1]
             return Tensor(jnp.concatenate(out, axis=1))
         finally:
             if was_training:

@@ -31,19 +31,21 @@ def _blockwise_min_seq():
 
 
 def _blockwise_block(seq_len):
-    """PADDLE_TPU_BLOCKWISE_BLOCK: blockwise attention chunk size (one
-    home for the 512 default shared with ops/blockwise_attention.py).
-    Values that cannot tile the sequence (non-divisors, <= 0) would
-    silently degrade to 1-row blocks — reject them loudly instead."""
-    blk = int(os.environ.get('PADDLE_TPU_BLOCKWISE_BLOCK', 512))
-    if blk <= 0:
-        raise ValueError('PADDLE_TPU_BLOCKWISE_BLOCK must be positive, '
-                         'got %d' % blk)
-    eff = min(blk, seq_len)
-    if seq_len % eff:
-        raise ValueError(
-            'PADDLE_TPU_BLOCKWISE_BLOCK=%d does not tile seq len %d '
-            '(pick a divisor)' % (blk, seq_len))
+    """Blockwise attention chunk size. The default (see
+    ops.blockwise_attention.env_block_size) flows through _pick_block's
+    graceful divisor shrink; an EXPLICITLY-set PADDLE_TPU_BLOCKWISE_BLOCK
+    that cannot tile the q sequence (non-divisor, <= 0) would silently
+    degrade to 1-row blocks - reject that loudly instead."""
+    from ...ops.blockwise_attention import env_block_size
+    blk = env_block_size()
+    if 'PADDLE_TPU_BLOCKWISE_BLOCK' in os.environ:
+        if blk <= 0:
+            raise ValueError('PADDLE_TPU_BLOCKWISE_BLOCK must be '
+                             'positive, got %d' % blk)
+        if seq_len % min(blk, seq_len):
+            raise ValueError(
+                'PADDLE_TPU_BLOCKWISE_BLOCK=%d does not tile seq len %d '
+                '(pick a divisor)' % (blk, seq_len))
     return blk
 
 

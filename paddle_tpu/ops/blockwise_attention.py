@@ -16,12 +16,20 @@ only — that is a cuBLAS/cuDNN kernel; this is an XLA-native algorithm).
 """
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 _NEG_INF = -1e30
+
+
+def env_block_size():
+    """PADDLE_TPU_BLOCKWISE_BLOCK: the blockwise attention chunk size
+    (default 512) - the one home for the default, shared by the SDPA
+    routing and the Ulysses causal-skip route."""
+    return int(os.environ.get('PADDLE_TPU_BLOCKWISE_BLOCK', 512))
 
 
 def _pick_block(n, target):

@@ -255,9 +255,11 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
         if scale is None:
             scale = 1.0 / (q.shape[-1] ** 0.5)
         n_full = qf.shape[1]
+        from .blockwise_attention import env_block_size
+        blk = env_block_size()
         if causal and not (dropout_p and dropout_key is not None) \
-                and n_full >= 1024 and n_full % 512 == 0 \
-                and n_full // 512 <= 64:
+                and n_full >= 1024 and blk > 0 and n_full % blk == 0 \
+                and n_full // blk <= 64:
             # (the divisibility/block-count guard mirrors blockwise's own
             # causal-skip precondition — without it, odd lengths would
             # degenerate to tiny-block fallbacks slower than quadratic)
@@ -266,7 +268,8 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
             # causal-skip path (ops/blockwise_attention.py) so future KV
             # blocks are never computed (and memory stays O(N))
             from .blockwise_attention import blockwise_attention
-            of = blockwise_attention(qf, kf, vf, causal=True, scale=scale)
+            of = blockwise_attention(qf, kf, vf, causal=True, scale=scale,
+                                     block_q=blk, block_k=blk)
         else:
             s = jnp.einsum('bqhd,bkhd->bhqk', qf.astype(jnp.float32), kf,
                            preferred_element_type=jnp.float32) * scale

@@ -344,6 +344,8 @@ class GPTForCausalLM(nn.Layer):
         try:
             ids = input_ids if isinstance(input_ids, Tensor) \
                 else Tensor(jnp.asarray(input_ids))
+            if max_new_tokens <= 0:
+                return Tensor(ids._data.astype(jnp.int32))
             b, n0 = ids.shape[0], ids.shape[1]
             max_len = n0 + max_new_tokens
             if max_len > self.config.max_position_embeddings:

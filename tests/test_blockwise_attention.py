@@ -300,3 +300,23 @@ def test_kv_cache_decode_matches_full_forward():
     np.testing.assert_allclose(out3.numpy()[0], full[0, :3], atol=1e-5)
     step4, cache = attn(paddle.to_tensor(x.numpy()[:, 3:4]), cache=cache)
     np.testing.assert_allclose(step4.numpy()[0, 0], full[0, 3], atol=1e-5)
+
+
+def test_default_block_size_degrades_gracefully():
+    """Without PADDLE_TPU_BLOCKWISE_BLOCK set, non-512-divisible lengths
+    must flow through _pick_block's divisor shrink, not raise."""
+    import os
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    assert 'PADDLE_TPU_BLOCKWISE_BLOCK' not in os.environ
+    os.environ['PADDLE_TPU_ATTN_IMPL'] = 'blockwise'
+    try:
+        rng = np.random.RandomState(4)
+        q = paddle.to_tensor(rng.randn(1, 640, 2, 16).astype(np.float32))
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        os.environ['PADDLE_TPU_ATTN_IMPL'] = 'quadratic'
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5,
+                                   atol=2e-5)
+    finally:
+        os.environ.pop('PADDLE_TPU_ATTN_IMPL', None)

@@ -93,3 +93,10 @@ def test_static_cache_rejects_grad_mode():
     ids = paddle.to_tensor(np.zeros((1, 3), np.int32))
     with pytest.raises(RuntimeError, match='inference-only'):
         m(ids, caches=caches)
+
+
+def test_generate_zero_tokens_returns_prompt():
+    m = _model()
+    prompt = paddle.to_tensor(np.zeros((1, 3), np.int32))
+    out = m.generate(prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(out.numpy(), prompt.numpy())

@@ -86,9 +86,47 @@ def bench_yolo_infer(on_tpu):
             'unit': 'ms', 'image_size': size, 'degraded': not on_tpu}
 
 
+def bench_gpt_decode(on_tpu):
+    """Autoregressive decode throughput (tokens/sec) through the jitted
+    static-cache step (GPTForCausalLM.generate)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        batch, prompt_len, new_tokens = 8, 128, 128
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=4, max_position_embeddings=64,
+                        dropout=0.0)
+        batch, prompt_len, new_tokens = 2, 8, 16
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompt = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (batch, prompt_len)).astype(
+            np.int32))
+    out = model.generate(prompt, max_new_tokens=new_tokens)   # compile
+    _ = out.numpy()
+    t0 = time.time()
+    out = model.generate(prompt, max_new_tokens=new_tokens)
+    _ = out.numpy()
+    dt = time.time() - t0
+    return {'metric': 'gpt_decode_tokens_per_sec',
+            'value': round(batch * new_tokens / dt, 2),
+            'unit': 'tokens/sec', 'batch': batch,
+            'prompt_len': prompt_len, 'new_tokens': new_tokens,
+            'degraded': not on_tpu}
+
+
 def main():
     on_tpu = _platform() == 'tpu'
-    for fn in (bench_resnet, bench_yolo_infer):
+    for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode):
         try:
             print(json.dumps(fn(on_tpu)))
         except Exception as e:  # never die half-way

@@ -235,6 +235,8 @@ def _run_measurement():
         'flash_in_program': flash_in_program,
         'scan_steps': scan_k,
         'attn_impl': os.environ.get('PADDLE_TPU_ATTN_IMPL', 'auto'),
+        **({'blockwise_block': int(os.environ['PADDLE_TPU_BLOCKWISE_BLOCK'])}
+           if 'PADDLE_TPU_BLOCKWISE_BLOCK' in os.environ else {}),
         'platform': platform,
         'degraded': not on_tpu,
         **({'dispatch_ms': dispatch_ms} if dispatch_ms else {}),

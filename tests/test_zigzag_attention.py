@@ -210,3 +210,34 @@ def test_ulysses_long_causal_grads_match():
     for a, b_ in zip(gu, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_dropout_unbiased():
+    """Zigzag's quadrant-level dropout keys must preserve the dropout-
+    after-softmax identity: averaging many masked draws recovers the
+    undropped attention (the same unbiasedness bar the plain ring
+    holds)."""
+    mesh = _mesh(2)
+    spec = P(None, 'sp', None, None)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
+    st = sp_mod.make_sp_state(mesh, axis='sp', mode='zigzag')
+
+    ref = np.asarray(sp_mod.sp_attention(q, k, v, causal=True, scale=0.5,
+                                         state=st))
+
+    @jax.jit
+    def one(key):
+        return sp_mod.sp_attention(q, k, v, causal=True, scale=0.5,
+                                   state=st, dropout_p=0.3,
+                                   dropout_key=key)
+
+    n = 400
+    acc = np.zeros(np.asarray(ref).shape, np.float32)
+    base = jax.random.PRNGKey(11)
+    for i in range(n):
+        acc += np.asarray(one(jax.random.fold_in(base, i)))
+    mean = acc / n
+    np.testing.assert_allclose(mean, ref, atol=0.35)

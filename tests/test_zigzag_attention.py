@@ -218,7 +218,6 @@ def test_zigzag_dropout_unbiased():
     undropped attention (the same unbiasedness bar the plain ring
     holds)."""
     mesh = _mesh(2)
-    spec = P(None, 'sp', None, None)
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)
     k = jnp.asarray(rng.randn(1, 8, 2, 4), jnp.float32)

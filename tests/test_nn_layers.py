@@ -559,3 +559,63 @@ def test_sparse_attention_masks():
     # first row attends only itself -> equals v[0]
     np.testing.assert_allclose(causal[0, 0, 0], v.numpy()[0, 0, 0],
                                rtol=1e-5)
+
+
+def test_lstm_gru_match_numpy_recurrence():
+    """Independent numpy gate-math reference (paddle gate order i,f,g,o
+    for LSTM; r,z,n with torch/paddle candidate convention for GRU) —
+    the recurrence itself, not just self-consistency."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    def sig(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    paddle.seed(3)
+    b, t, din, h = 2, 5, 3, 4
+    x = np.random.RandomState(1).randn(b, t, din).astype(np.float32)
+
+    lstm = nn.LSTM(din, h)
+    out, (hn, cn) = lstm(paddle.to_tensor(x))
+    params = dict(lstm.named_parameters())
+    wi = params['_cells.0.weight_ih'].numpy()
+    wh = params['_cells.0.weight_hh'].numpy()
+    bi = params['_cells.0.bias_ih'].numpy()
+    bh = params['_cells.0.bias_hh'].numpy()
+    hh = np.zeros((b, h), np.float32)
+    cc = np.zeros((b, h), np.float32)
+    ref = []
+    for s in range(t):
+        gates = x[:, s] @ wi.T + bi + hh @ wh.T + bh
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        i, f, o = sig(i), sig(f), sig(o)
+        cc = f * cc + i * np.tanh(g)
+        hh = o * np.tanh(cc)
+        ref.append(hh.copy())
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(hn.numpy()[0], hh, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(cn.numpy()[0], cc, rtol=1e-5, atol=1e-5)
+
+    gru = nn.GRU(din, h)
+    gout, ghn = gru(paddle.to_tensor(x))
+    params = dict(gru.named_parameters())
+    wi = params['_cells.0.weight_ih'].numpy()
+    wh = params['_cells.0.weight_hh'].numpy()
+    bi = params['_cells.0.bias_ih'].numpy()
+    bh = params['_cells.0.bias_hh'].numpy()
+    hh = np.zeros((b, h), np.float32)
+    ref = []
+    for s in range(t):
+        gi = x[:, s] @ wi.T + bi
+        gh = hh @ wh.T + bh
+        ir, iz, inn = np.split(gi, 3, axis=-1)
+        hr, hz, hn_ = np.split(gh, 3, axis=-1)
+        r = sig(ir + hr)
+        z = sig(iz + hz)
+        n = np.tanh(inn + r * hn_)
+        hh = (1 - z) * n + z * hh
+        ref.append(hh.copy())
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(gout.numpy(), ref, rtol=1e-5, atol=1e-5)

@@ -619,3 +619,4 @@ def test_lstm_gru_match_numpy_recurrence():
         ref.append(hh.copy())
     ref = np.stack(ref, axis=1)
     np.testing.assert_allclose(gout.numpy(), ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(ghn.numpy()[0], hh, rtol=1e-5, atol=1e-5)

@@ -29,7 +29,9 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, 'bench.py')
-OUT = os.path.join(REPO, 'docs', 'bench_inwindow_r4.jsonl')
+OUT = os.environ.get(
+    'PADDLE_TPU_BENCH_INWINDOW_LOG',
+    os.path.join(REPO, 'docs', 'bench_inwindow_r4.jsonl'))
 LOCK = '/tmp/tpu_warmer.lock'
 
 # config ladder: label -> extra env. Ordered so the most valuable

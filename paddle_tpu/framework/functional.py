@@ -14,13 +14,10 @@ donated buffers — the XLA-native replacement for the reference's
 executor-driven training loop, and the unit over which distributed
 strategies apply shardings (distributed/strategy.py).
 """
-import functools
-
-import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .core import Tensor, Parameter, no_grad_guard
+from .core import Tensor
 from . import random as rng_mod
 
 __all__ = ['extract_params', 'extract_buffers', 'functional_call', 'TrainStep']

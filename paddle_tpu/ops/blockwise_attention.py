@@ -14,7 +14,6 @@ Reference counterpart: the fused attention family
 /root/reference/paddle/fluid/operators/fused/fused_attention_op.cu (spec
 only — that is a cuBLAS/cuDNN kernel; this is an XLA-native algorithm).
 """
-import functools
 import math
 import os
 

@@ -8,8 +8,7 @@ TPU-native replacement for per-op optimizer kernels (sgd_op.cc, adam_op.cc).
 """
 import jax.numpy as jnp
 
-from ..framework.core import Tensor, Parameter, no_grad_guard
-from ..nn.clip import ClipGradBase
+from ..framework.core import Tensor, no_grad_guard
 from .lr import LRScheduler
 
 __all__ = ['Optimizer', 'SGD', 'Momentum', 'Adam', 'AdamW', 'Adamax',

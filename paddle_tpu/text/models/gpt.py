@@ -5,8 +5,6 @@ placements, ZeRO sharding specs, pipeline stages) apply uniformly. Causal
 attention routes through F.scaled_dot_product_attention → pallas flash
 kernel on TPU.
 """
-import math
-
 import jax
 import jax.numpy as jnp
 

@@ -71,12 +71,20 @@ def _bind(layer, params, buffers):
 
 
 def functional_call(layer, params, buffers, args=(), kwargs=None,
-                    training=None):
+                    training=None, post_fn=None):
     """Run layer.forward with `params`/`buffers` arrays bound in.
 
     Returns (outputs_as_arrays, new_buffers_dict). Safe under jit tracing:
     any buffer mutated by forward (e.g. BN running stats) comes back as a
     traced output instead of leaking a tracer into the live object.
+
+    post_fn, when given, receives the forward's raw (Tensor) output and
+    runs INSIDE the parameter binding; its result becomes the returned
+    output. This is how a loss that references model parameters directly
+    (e.g. a fused tied-embedding head, an L2 term over weights) sees the
+    traced arrays rather than the live ones — referencing a live
+    Parameter from an unbound loss would silently drop its gradient
+    contribution.
     """
     kwargs = kwargs or {}
     prev_mode = layer.training
@@ -90,6 +98,8 @@ def functional_call(layer, params, buffers, args=(), kwargs=None,
             a, (jnp.ndarray, jax.Array)) or hasattr(a, 'aval') else a
             for a in args]
         out = layer(*targs, **kwargs)
+        if post_fn is not None:
+            out = post_fn(out)
         new_buffers = {name: t._data for name, t in bmap.items()
                        if t is not None}
 
@@ -300,16 +310,25 @@ class TrainStep:
                         return loss_val * opt_state['loss_scale'], \
                             ({}, loss_val)
                     return loss_val, {}
-                with rng_mod.key_scope(key):
-                    out, new_buf = functional_call(model, all_params,
-                                                   call_buffers,
-                                                   args=call_inputs,
-                                                   training=True)
-                    outs = out if isinstance(out, tuple) else (out,)
-                    t_outs = [Tensor(o, stop_gradient=False) for o in outs]
+                def _loss_post(out):
+                    # runs inside the parameter binding: a loss_fn that
+                    # references model parameters (fused tied-embedding
+                    # head, weight penalties) differentiates the traced
+                    # arrays, not the live ones
+                    outs = out if isinstance(out, (list, tuple)) else (out,)
+                    t_outs = [Tensor(o._data if isinstance(o, Tensor)
+                                     else o, stop_gradient=False)
+                              for o in outs]
                     t_labels = [Tensor(l) for l in labels]
-                    loss_t = loss_fn(*t_outs, *t_labels)
-                loss_val = loss_t._data
+                    return loss_fn(*t_outs, *t_labels)
+
+                with rng_mod.key_scope(key):
+                    loss_arr, new_buf = functional_call(model, all_params,
+                                                        call_buffers,
+                                                        args=call_inputs,
+                                                        training=True,
+                                                        post_fn=_loss_post)
+                loss_val = loss_arr
                 if amp_dtype is not None:
                     loss_val = loss_val.astype(jnp.float32)
                 new_buf = _cast_like(new_buf, buffers)

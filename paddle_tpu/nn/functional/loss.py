@@ -47,7 +47,8 @@ def _ce_bwd(ignore_index, res, g):
 _ce_with_logits.defvjp(_ce_fwd, _ce_bwd)
 
 __all__ = [
-    'cross_entropy', 'softmax_with_cross_entropy', 'binary_cross_entropy',
+    'cross_entropy', 'linear_cross_entropy',
+    'softmax_with_cross_entropy', 'binary_cross_entropy',
     'binary_cross_entropy_with_logits', 'nll_loss', 'mse_loss', 'l1_loss',
     'smooth_l1_loss', 'kl_div', 'margin_ranking_loss', 'hinge_embedding_loss',
     'cosine_embedding_loss', 'ctc_loss', 'log_loss', 'square_error_cost',
@@ -107,6 +108,45 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             return jnp.sum(out) / denom
         return _reduce(out, reduction)
     return run_op('cross_entropy', fn, x, *([w] if w is not None else []))
+
+
+def linear_cross_entropy(input, weight, label, bias=None, ignore_index=-100,
+                         transpose_weight=False, chunk_rows=None, name=None):
+    """Fused linear head + mean softmax cross-entropy (hard labels).
+
+    Computes ``cross_entropy(input @ weight + bias, label)`` without ever
+    materializing the [rows, vocab] logits — the memory-optimal LM loss
+    for large vocabularies (see ops/fused_ce.py for the algorithm and
+    the reference counterparts it replaces). Beyond-reference op: the
+    reference's analog is the vocab-parallel
+    c_softmax_with_cross_entropy (operators/collective/); this is the
+    single-chip fused form.
+
+    input: [..., d] activations (leading dims are flattened to rows).
+    weight: [d, vocab], or [vocab, d] with transpose_weight=True (the
+        tied-embedding layout; the transpose folds into the matmuls).
+    label: integer tensor matching input's leading dims.
+    Returns a scalar: mean CE over rows whose label != ignore_index.
+    """
+    from ...ops import fused_ce as _fce
+    x = ensure_tensor(input)
+    l = ensure_tensor(label)
+    wt = ensure_tensor(weight)
+    bt = ensure_tensor(bias) if bias is not None else None
+    d = x.shape[-1]
+    chunk = chunk_rows if chunk_rows is not None else _fce.env_chunk_rows()
+
+    lab = l._data.reshape(-1).astype(jnp.int32)
+
+    def fn(a, warr, *rest):
+        x2 = a.reshape(-1, d)
+        wmat = warr.T if transpose_weight else warr
+        barr = rest[0] if rest else None
+        return _fce.linear_cross_entropy_arrays(
+            x2, wmat, lab, barr, int(ignore_index), int(chunk))
+
+    args = [x, wt] + ([bt] if bt is not None else [])
+    return run_op('linear_cross_entropy', fn, *args)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

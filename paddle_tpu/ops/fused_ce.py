@@ -1,0 +1,158 @@
+"""Fused LM-head + softmax cross-entropy, chunked over rows.
+
+The dominant non-matmul cost of LM training at realistic vocab sizes is
+the logits tensor: a [batch*seq, vocab] bf16 matmul output that the
+straight path (head matmul -> cross_entropy) materializes in HBM, copies
+to f32 for the stable logsumexp, and materializes AGAIN as softmax probs
+in the backward. On the BERT-base bench config that is ~2 GB of f32
+logits + ~1 GB of probs per step — measured at ~13 ms/step of pure HBM
+traffic on v5e (docs/PERF_NOTES_r4.md, profile analysis).
+
+This op computes mean softmax-CE of `x @ w (+bias)` against integer
+labels WITHOUT ever materializing the full [rows, vocab] logits:
+
+- forward: python-unrolled loop over row chunks; each chunk computes its
+  logits tile, reduces it to (logsumexp, picked-label logit) in f32, and
+  discards it. Residuals are O(rows), not O(rows*vocab).
+- backward (custom_vjp): re-computes each chunk's logits tile, forms
+  softmax(logits) - onehot(label) on the fly (an elementwise epilogue
+  XLA fuses into the consuming matmuls), and emits dx per chunk and a
+  f32-accumulated dw. MXU matmuls use f32 accumulation
+  (preferred_element_type) so the chunked dw matches the one-shot matmul.
+
+Cost: one extra logits-tile matmul (the backward recompute) — ~25% more
+head flops — traded for removing every [rows, vocab] HBM round-trip.
+
+Reference counterpart: the reference reaches the same end by op fusion
+on GPU (paddle/fluid/operators/fused/ family; c_softmax_with_cross_entropy
+fuses the vocab-PARALLEL variant, operators/collective/
+c_softmax_with_cross_entropy_op.cu) — this is the XLA/TPU-native design:
+chunk at the algorithm level, let the compiler fuse the epilogues.
+"""
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['linear_cross_entropy_arrays', 'env_chunk_rows']
+
+_MAX_CHUNKS = 64
+
+
+def env_chunk_rows():
+    """PADDLE_TPU_FUSED_CE_CHUNK: rows per logits tile (default 4096).
+
+    Bigger tiles = fewer dw accumulation passes (each one is a
+    read-modify-write of the full f32 [d, vocab] accumulator) but a
+    larger transient logits tile. 4096 rows x 30k vocab bf16 = 250 MB —
+    comfortably HBM-resident on any TPU generation.
+    """
+    return int(os.environ.get('PADDLE_TPU_FUSED_CE_CHUNK', 4096))
+
+
+def _chunk_plan(rows, chunk):
+    """(chunk, n_chunks, padded_rows) with the unroll bounded."""
+    chunk = max(1, int(chunk))
+    n = -(-rows // chunk)
+    if n > _MAX_CHUNKS:  # keep the unrolled program a sane size
+        chunk = -(-rows // _MAX_CHUNKS)
+        n = -(-rows // chunk)
+    return chunk, n, n * chunk
+
+
+def _pad_rows(x, labels, rows_p, ignore_index):
+    rows = x.shape[0]
+    if rows_p == rows:
+        return x, labels
+    pad = rows_p - rows
+    x = jnp.pad(x, ((0, pad), (0, 0)))
+    labels = jnp.pad(labels, (0, pad), constant_values=ignore_index)
+    return x, labels
+
+
+def _tile_logits(xc, w, bias):
+    logits = jnp.matmul(xc, w)
+    if bias is not None:
+        logits = logits + bias
+    return logits.astype(jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def linear_cross_entropy_arrays(x, w, labels, bias, ignore_index, chunk):
+    """Mean softmax-CE of (x @ w + bias) vs labels over valid rows.
+
+    x: [rows, d] float; w: [d, vocab]; labels: [rows] int;
+    bias: [vocab] or None. Rows whose label == ignore_index contribute
+    nothing; the mean divides by the valid count (matching
+    F.cross_entropy(reduction='mean', ignore_index=...)).
+    Returns a scalar in x.dtype.
+    """
+    loss, _ = _lce_fwd(x, w, labels, bias, ignore_index, chunk)
+    return loss
+
+
+def _lce_fwd(x, w, labels, bias, ignore_index, chunk):
+    rows = x.shape[0]
+    v = w.shape[1]
+    chunk, n, rows_p = _chunk_plan(rows, chunk)
+    xp, lp = _pad_rows(x, labels, rows_p, ignore_index)
+    lse_parts, picked_parts = [], []
+    for i in range(n):
+        xc = jax.lax.slice_in_dim(xp, i * chunk, (i + 1) * chunk)
+        lc = jax.lax.slice_in_dim(lp, i * chunk, (i + 1) * chunk)
+        af = _tile_logits(xc, w, bias)
+        m = af.max(axis=-1)
+        lse = m + jnp.log(jnp.sum(jnp.exp(af - m[:, None]), axis=-1))
+        safe = jnp.clip(lc, 0, v - 1).astype(jnp.int32)
+        picked = jnp.take_along_axis(af, safe[:, None], axis=-1)[:, 0]
+        lse_parts.append(lse)
+        picked_parts.append(picked)
+    lse = jnp.concatenate(lse_parts)
+    picked = jnp.concatenate(picked_parts)
+    valid = lp != ignore_index
+    per_row = jnp.where(valid, lse - picked, 0.0)
+    denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    loss = (jnp.sum(per_row) / denom).astype(x.dtype)
+    # residuals are O(rows): the logits tiles are recomputed in _lce_bwd
+    return loss, (x, w, labels, bias, lse, denom)
+
+
+def _lce_bwd(ignore_index, chunk, res, g):
+    x, w, labels, bias, lse, denom = res
+    rows, d = x.shape
+    v = w.shape[1]
+    chunk, n, rows_p = _chunk_plan(rows, chunk)
+    xp, lp = _pad_rows(x, labels, rows_p, ignore_index)
+    gg = g.astype(jnp.float32) / denom
+    dx_parts = []
+    dw = jnp.zeros((d, v), jnp.float32)
+    db = jnp.zeros((v,), jnp.float32) if bias is not None else None
+    for i in range(n):
+        xc = jax.lax.slice_in_dim(xp, i * chunk, (i + 1) * chunk)
+        lc = jax.lax.slice_in_dim(lp, i * chunk, (i + 1) * chunk)
+        lse_c = jax.lax.slice_in_dim(lse, i * chunk, (i + 1) * chunk)
+        af = _tile_logits(xc, w, bias)
+        p = jnp.exp(af - lse_c[:, None])
+        valid = lc != ignore_index
+        safe = jnp.clip(lc, 0, v - 1).astype(jnp.int32)
+        onehot = jax.lax.broadcasted_iota(
+            jnp.int32, (p.shape[0], v), 1) == safe[:, None]
+        # d(CE)/d(logits) = softmax - onehot, zeroed on ignored rows; the
+        # whole epilogue is elementwise so XLA fuses it into both
+        # consuming matmuls — p never round-trips HBM at full precision
+        p = (p - onehot) * (gg * valid.astype(jnp.float32))[:, None]
+        pc = p.astype(w.dtype)
+        dx_parts.append(
+            jnp.matmul(pc, w.T,
+                       preferred_element_type=jnp.float32).astype(x.dtype))
+        dw = dw + jnp.matmul(xc.T, pc, preferred_element_type=jnp.float32)
+        if db is not None:
+            db = db + p.sum(axis=0)
+    dx = jnp.concatenate(dx_parts)[:rows]
+    dlabels = jnp.zeros(labels.shape, jax.dtypes.float0)
+    return (dx, dw.astype(w.dtype), dlabels,
+            None if bias is None else db.astype(bias.dtype))
+
+
+linear_cross_entropy_arrays.defvjp(_lce_fwd, _lce_bwd)

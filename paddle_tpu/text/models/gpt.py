@@ -22,7 +22,8 @@ class GPTConfig:
                  max_position_embeddings=1024, dropout=0.1,
                  layer_norm_epsilon=1e-5, initializer_range=0.02,
                  use_rmsnorm=False, tie_word_embeddings=True,
-                 recompute=False, num_experts=0, moe_capacity_factor=1.5):
+                 recompute=False, num_experts=0, moe_capacity_factor=1.5,
+                 fused_loss=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -39,6 +40,12 @@ class GPTConfig:
         # SwitchMoE (incubate/moe.py) routed over the 'ep' mesh axis
         self.num_experts = num_experts
         self.moe_capacity_factor = moe_capacity_factor
+        # fused_loss=True changes the TRAINING forward contract: forward()
+        # (without caches) returns the final hidden states and loss()
+        # fuses head matmul + CE via F.linear_cross_entropy, never
+        # materializing [batch*seq, vocab] logits (ops/fused_ce.py).
+        # Decode/generate paths (caches=...) still produce logits.
+        self.fused_loss = fused_loss
 
     @staticmethod
     def gpt2_small():
@@ -311,6 +318,12 @@ class GPTForCausalLM(nn.Layer):
                                           caches=caches)
         else:
             hidden = self.gpt(input_ids, position_ids)
+            if getattr(self.config, 'fused_loss', False) and self.training:
+                # fused-loss TRAINING contract: the head matmul lives
+                # inside loss() (F.linear_cross_entropy) — returning
+                # hidden here is what makes the fusion possible. Eval
+                # and decode forwards keep producing logits.
+                return hidden
         if self.lm_head is None:
             logits = F.linear(hidden,
                               M.transpose(self.gpt.wte.weight, [1, 0]))
@@ -424,6 +437,11 @@ class GPTForCausalLM(nn.Layer):
 
         def post(x, labels):
             h = gpt.ln_f(x)
+            if getattr(self.config, 'fused_loss', False) and \
+                    loss_fn == self.loss:
+                # last pipeline stage fuses head+CE directly off the
+                # hidden state — loss() handles the hidden-state input
+                return loss_fn(h, labels)
             if self.lm_head is None:
                 logits = F.linear(h, M.transpose(gpt.wte.weight, [1, 0]))
             else:
@@ -433,9 +451,21 @@ class GPTForCausalLM(nn.Layer):
         return pre, gpt.h, post
 
     def loss(self, logits, labels):
-        b, n, v = logits.shape
-        ce = F.cross_entropy(M.reshape(logits, [b * n, v]),
-                             M.reshape(labels, [b * n]))
+        if getattr(self.config, 'fused_loss', False) and \
+                logits.shape[-1] == self.config.hidden_size:
+            # fused contract: `logits` is the final HIDDEN state (see
+            # forward); head matmul + CE fuse in one chunked op
+            if self.lm_head is None:
+                ce = F.linear_cross_entropy(
+                    logits, self.gpt.wte.weight, labels,
+                    transpose_weight=True)
+            else:
+                ce = F.linear_cross_entropy(
+                    logits, self.lm_head.weight, labels)
+        else:
+            b, n, v = logits.shape
+            ce = F.cross_entropy(M.reshape(logits, [b * n, v]),
+                                 M.reshape(labels, [b * n]))
         aux = getattr(self.gpt, '_moe_aux', None)
         self.gpt._moe_aux = None  # consume once — never stale across calls
         if aux is not None:

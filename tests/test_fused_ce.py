@@ -1,0 +1,198 @@
+"""Fused linear+CE (ops/fused_ce.py, F.linear_cross_entropy).
+
+Parity bar: the chunked custom_vjp must match the straight path
+(head matmul -> F.cross_entropy) in value AND in every gradient
+(dx, dw, db) — f32 tight, bf16 loose — including ignored labels,
+non-divisible row counts, and the tied-embedding transposed-weight
+layout. Then end-to-end: a GPTForCausalLM(fused_loss=True) TrainStep
+must track the non-fused model parameter-for-parameter.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops.fused_ce import linear_cross_entropy_arrays
+
+
+def _naive(x, w, labels, bias, ignore_index):
+    logits = (x @ w).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    safe = jnp.clip(labels, 0, w.shape[1] - 1)
+    picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    valid = labels != ignore_index
+    per = jnp.where(valid, lse - picked, 0.0)
+    denom = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+    return (per.sum() / denom).astype(x.dtype)
+
+
+@pytest.mark.parametrize('rows,chunk', [(64, 16), (60, 16), (64, 64),
+                                        (7, 100)])
+@pytest.mark.parametrize('with_bias', [False, True])
+def test_matches_naive_f32(rows, chunk, with_bias):
+    rng = np.random.RandomState(0)
+    d, v = 24, 97
+    x = jnp.asarray(rng.randn(rows, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, v) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(v) * 0.1, jnp.float32) if with_bias else None
+    labels = jnp.asarray(rng.randint(0, v, rows), jnp.int32)
+
+    args = (x, w, labels, b)
+    loss = linear_cross_entropy_arrays(*args, -100, chunk)
+    ref = _naive(*args, -100)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+    diff = (0, 1) if b is None else (0, 1, 3)
+    gf = jax.grad(lambda *a: linear_cross_entropy_arrays(*a, -100, chunk),
+                  argnums=diff)(*args)
+    gr = jax.grad(lambda *a: _naive(*a, -100), argnums=diff)(*args)
+    for gi, ri in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(gi), np.asarray(ri),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_ignore_index_rows_contribute_nothing():
+    rng = np.random.RandomState(1)
+    rows, d, v = 32, 16, 50
+    x = jnp.asarray(rng.randn(rows, d), jnp.float32)
+    w = jnp.asarray(rng.randn(d, v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rng.randint(0, v, rows), jnp.int32)
+    labels = labels.at[::3].set(-100)
+
+    loss = linear_cross_entropy_arrays(x, w, labels, None, -100, 8)
+    ref = _naive(x, w, labels, None, -100)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-6)
+
+    # ignored rows must get exactly zero dx
+    dx = jax.grad(lambda a: linear_cross_entropy_arrays(
+        a, w, labels, None, -100, 8))(x)
+    assert float(jnp.abs(dx[::3]).max()) == 0.0
+    assert float(jnp.abs(dx[1::3]).max()) > 0.0
+
+
+def test_all_rows_ignored_is_finite():
+    x = jnp.ones((8, 4), jnp.float32)
+    w = jnp.ones((4, 9), jnp.float32)
+    labels = jnp.full((8,), -100, jnp.int32)
+    loss = linear_cross_entropy_arrays(x, w, labels, None, -100, 4)
+    assert float(loss) == 0.0
+    dx = jax.grad(lambda a: linear_cross_entropy_arrays(
+        a, w, labels, None, -100, 4))(x)
+    assert float(jnp.abs(dx).max()) == 0.0
+
+
+def test_bf16_matches_naive_bf16():
+    rng = np.random.RandomState(2)
+    rows, d, v = 128, 32, 211
+    x = jnp.asarray(rng.randn(rows, d), jnp.bfloat16)
+    w = jnp.asarray(rng.randn(d, v) * 0.05, jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, v, rows), jnp.int32)
+
+    loss = linear_cross_entropy_arrays(x, w, labels, None, -100, 32)
+    ref = _naive(x, w, labels, None, -100)
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-2)
+    gf = jax.grad(lambda a, b: linear_cross_entropy_arrays(
+        a, b, labels, None, -100, 32), argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda a, b: _naive(a, b, labels, None, -100),
+                  argnums=(0, 1))(x, w)
+    for gi, ri in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(gi, np.float32),
+                                   np.asarray(ri, np.float32),
+                                   rtol=0.1, atol=5e-4)
+
+
+def test_functional_transpose_weight_eager_backward():
+    """Tensor-level API with the tied-embedding [vocab, d] layout."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(3)
+    b, n, d, v = 2, 6, 8, 31
+    x = paddle.to_tensor(rng.randn(b, n, d).astype(np.float32),
+                         stop_gradient=False)
+    wt = paddle.to_tensor(rng.randn(v, d).astype(np.float32) * 0.1,
+                          stop_gradient=False)
+    labels = paddle.to_tensor(rng.randint(0, v, (b, n)).astype(np.int64))
+
+    loss = F.linear_cross_entropy(x, wt, labels, transpose_weight=True,
+                                  chunk_rows=5)
+    loss.backward()
+
+    xa, wa = jnp.asarray(x.numpy()), jnp.asarray(wt.numpy())
+    la = jnp.asarray(labels.numpy().reshape(-1), jnp.int32)
+    ref_fn = lambda a, ww: _naive(a.reshape(-1, d), ww.T, la, None, -100)
+    ref = ref_fn(xa, wa)
+    np.testing.assert_allclose(float(loss.numpy()), float(ref), rtol=1e-6)
+    gx, gw = jax.grad(ref_fn, argnums=(0, 1))(xa, wa)
+    np.testing.assert_allclose(x.grad.numpy(), np.asarray(gx),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(wt.grad.numpy(), np.asarray(gw),
+                               rtol=2e-5, atol=2e-6)
+
+
+def _train_steps(fused, steps=3, optimizer='momentum'):
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.framework import functional as func_mod
+
+    paddle.seed(0)
+    cfg = dict(vocab_size=211, hidden_size=32, num_layers=2, num_heads=4,
+               max_position_embeddings=16, dropout=0.0)
+    model = GPTForCausalLM(GPTConfig(fused_loss=fused, **cfg))
+    if optimizer == 'momentum':
+        # linear in the grads: parity stays tight. Adam's m/sqrt(v)
+        # amplifies f32 reassociation noise on near-zero grads into
+        # sign-flipped whole-lr updates, so it cannot hold a tight
+        # param-parity bar even between two bit-different-but-correct
+        # implementations.
+        opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                        parameters=model.parameters())
+    else:
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+    step = func_mod.TrainStep(model, model.loss, opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 211, (2, 16)).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, 211, (2, 16)).astype(np.int32))
+    losses = [float(step(ids, labels).numpy()) for _ in range(steps)]
+    params = {k: np.asarray(v) for k, v in
+              func_mod.extract_params(model).items()}
+    return losses, params
+
+
+def test_gpt_fused_loss_trains_identically():
+    """fused_loss=True must track the straight model step-for-step —
+    including the tied wte.weight, whose head-side grad only flows if the
+    loss runs inside the TrainStep parameter binding."""
+    l0, p0 = _train_steps(fused=False)
+    l1, p1 = _train_steps(fused=True)
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    assert p0.keys() == p1.keys()
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-4, atol=1e-6,
+                                    err_msg=k)
+
+
+def test_gpt_fused_loss_adamw_loss_trajectory():
+    l0, _ = _train_steps(fused=False, steps=3, optimizer='adamw')
+    l1, _ = _train_steps(fused=True, steps=3, optimizer='adamw')
+    np.testing.assert_allclose(l0, l1, rtol=1e-4)
+
+
+def test_gpt_fused_loss_generate_unaffected():
+    """generate() (cache path) still produces logits under fused_loss."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = dict(vocab_size=64, hidden_size=16, num_layers=1, num_heads=2,
+               max_position_embeddings=24, dropout=0.0)
+    m_f = GPTForCausalLM(GPTConfig(fused_loss=True, **cfg))
+    paddle.seed(0)
+    m_p = GPTForCausalLM(GPTConfig(fused_loss=False, **cfg))
+    ids = np.random.RandomState(0).randint(0, 64, (1, 4)).astype(np.int32)
+    out_f = m_f.generate(paddle.to_tensor(ids), max_new_tokens=6)
+    out_p = m_p.generate(paddle.to_tensor(ids), max_new_tokens=6)
+    np.testing.assert_array_equal(out_f.numpy(), out_p.numpy())

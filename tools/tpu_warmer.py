@@ -38,44 +38,36 @@ LOCK = '/tmp/tpu_warmer.lock'
 # measurement (the expected driver rung) lands first in case the window
 # closes mid-run.
 CONFIGS = [
-    ('flash_disabled_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                              'PADDLE_TPU_FLASH_STRICT': '0',
-                              'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
-    ('flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
-    ('flash_disabled_b64_remat_scan4', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                                        'PADDLE_TPU_FLASH_STRICT': '0',
-                                        'PADDLE_TPU_BENCH_BATCH': '64',
-                                        'PADDLE_TPU_BENCH_REMAT': '1',
-                                        'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
-    ('flash_disabled_plain', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                              'PADDLE_TPU_FLASH_STRICT': '0'}),
-    ('blockwise_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                         'PADDLE_TPU_FLASH_STRICT': '0',
-                         'PADDLE_TPU_ATTN_IMPL': 'blockwise',
-                         'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
-    ('flash_disabled_scan8_b64', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                                  'PADDLE_TPU_FLASH_STRICT': '0',
-                                  'PADDLE_TPU_BENCH_BATCH': '64',
-                                  'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
-    # causal block-skip at seq 512: tq=4 computes 62.5% of the attention
-    # flops — does the chunking beat XLA's fused quadratic on-chip?
-    ('blockwise_b128_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
-                              'PADDLE_TPU_FLASH_STRICT': '0',
-                              'PADDLE_TPU_ATTN_IMPL': 'blockwise',
-                              'PADDLE_TPU_BLOCKWISE_BLOCK': '128',
-                              'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
-    # long-context: blockwise (pure-XLA flash-shape) vs quadratic+remat
-    ('blockwise_seq2048_b8_scan4', {'PADDLE_TPU_FLASH_DISABLE': '1',
+    # round-4 session-3 ladder: the fused head+CE lever (ops/fused_ce.py)
+    # first — it is the one unmeasured-on-TPU change; everything after
+    # re-captures the proven rungs. bench.py defaults PADDLE_TPU_FUSED_CE
+    # on, so the non-fused rungs set it to '0' explicitly.
+    ('fused_flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('fused_flash_plain', {}),
+    ('flash_scan8', {'PADDLE_TPU_FUSED_CE': '0',
+                     'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('fused_flash_disabled_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
                                     'PADDLE_TPU_FLASH_STRICT': '0',
-                                    'PADDLE_TPU_ATTN_IMPL': 'blockwise',
-                                    'PADDLE_TPU_BENCH_SEQ': '2048',
-                                    'PADDLE_TPU_BENCH_BATCH': '8',
-                                    'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
-    ('quadratic_seq2048_b8_remat_scan4',
-     {'PADDLE_TPU_FLASH_DISABLE': '1', 'PADDLE_TPU_FLASH_STRICT': '0',
-      'PADDLE_TPU_ATTN_IMPL': 'quadratic', 'PADDLE_TPU_BENCH_SEQ': '2048',
-      'PADDLE_TPU_BENCH_BATCH': '8', 'PADDLE_TPU_BENCH_REMAT': '1',
-      'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
+                                    'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('fused_flash_scan8_b64', {'PADDLE_TPU_BENCH_BATCH': '64',
+                               'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    ('fused_ce_chunk2048_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                  'PADDLE_TPU_FUSED_CE_CHUNK': '2048'}),
+    ('fused_ce_chunk8192_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                  'PADDLE_TPU_FUSED_CE_CHUNK': '8192'}),
+    # long-context with the full stack: flash + fused CE
+    ('fused_flash_seq2048_b8_scan4', {'PADDLE_TPU_BENCH_SEQ': '2048',
+                                      'PADDLE_TPU_BENCH_BATCH': '8',
+                                      'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
+    ('fused_flash_seq8192_b2_scan2', {'PADDLE_TPU_BENCH_SEQ': '8192',
+                                      'PADDLE_TPU_BENCH_BATCH': '2',
+                                      'PADDLE_TPU_BENCH_SCAN_STEPS': '2'}),
+    # the remaining driver-ladder fallback rungs (bench.py): warm their
+    # caches too, and keep refreshing r4's best plain capture
+    ('flash_plain', {'PADDLE_TPU_FUSED_CE': '0'}),
+    ('flash_disabled_plain', {'PADDLE_TPU_FUSED_CE': '0',
+                              'PADDLE_TPU_FLASH_DISABLE': '1',
+                              'PADDLE_TPU_FLASH_STRICT': '0'}),
 ]
 
 

@@ -36,6 +36,9 @@ def bench_resnet(on_tpu):
     step = func_mod.TrainStep(model, lambda lo, la: ce(lo, la), opt)
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(batch, 3, size, size).astype(np.float32))
+    if on_tpu:
+        # params are bf16 — conv requires matching operand dtypes
+        x = x.astype('bfloat16')
     y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int64))
     step(x, y).numpy()                      # compile
     warm = 10 if on_tpu else 1

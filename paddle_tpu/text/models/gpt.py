@@ -105,6 +105,15 @@ jax.tree_util.register_pytree_node(GPTStaticCache, _cache_flatten,
                                    _cache_unflatten)
 
 
+def _evict_oldest(cache, cap=8):
+    """Bound a per-model compiled-executable cache: a serving loop with
+    naturally varying prompt/generation shapes must not pin one XLA
+    executable per distinct shape forever (FIFO is enough — shape churn
+    is the failure mode, not hot-set reuse)."""
+    while len(cache) >= cap:
+        cache.pop(next(iter(cache)))
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, config):
         super().__init__()
@@ -381,34 +390,73 @@ class GPTForCausalLM(nn.Layer):
                 return jax.random.categorical(key, lg, axis=-1).astype(
                     jnp.int32)
 
-            # prefill: one pass over the prompt seeds the caches
-            logits, caches = model(ids, caches=caches)
-            last = logits[:, -1]._data
+            from ...framework import functional as _fm
+            _params = _fm.extract_params(self)
+            _bufs = _fm.extract_buffers(self)
 
-            # the decode step is ONE compiled program (params/buffers/
-            # caches are pytree args; GPTStaticCache is a registered
-            # node): same shapes every token, traced once
-            from ...framework import functional as func_mod
-            params = func_mod.extract_params(self)
-            bufs = func_mod.extract_buffers(self)
+            # prefill: ONE jitted pass over the prompt seeds the caches
+            # (eager prefill would dispatch every op separately — dozens
+            # of round-trips on a relayed accelerator)
+            pre_cache = getattr(self, '_prefill_cache', None)
+            if pre_cache is None:
+                pre_cache = self._prefill_cache = {}
+            _evict_oldest(pre_cache)
+            pre_jit = pre_cache.get((b, n0, max_len))
+            if pre_jit is None:
+                def _prefill(p, bf, cs, ids_):
+                    (lg, cs2), _ = _fm.functional_call(
+                        self, p, bf, args=(Tensor(ids_),),
+                        kwargs={'caches': cs}, training=False)
+                    return lg[:, -1], cs2
+                pre_jit = pre_cache[(b, n0, max_len)] = jax.jit(_prefill)
+            last, caches = pre_jit(_params, _bufs, caches, ids._data)
 
-            def _step(p, bf, cs, tok, key):
-                (lg, new_cs), _ = func_mod.functional_call(
-                    self, p, bf, args=(Tensor(tok),),
-                    kwargs={'caches': cs}, training=False)
-                return pick(lg[:, -1], key), new_cs
-            step_jit = jax.jit(_step)
+            # the whole decode is ONE compiled program: a lax.scan whose
+            # body is the static-shape cached step (params/buffers/caches
+            # are pytree args; GPTStaticCache is a registered node). The
+            # host dispatches once per generate() call, not once per
+            # token — on a relayed/tunneled accelerator the per-token
+            # dispatch toll dominates cached decode, the same lesson as
+            # TrainStep.multi_step for training.
+            func_mod = _fm
+            params, bufs = _params, _bufs
+
+            # one compiled executable per (generation length, prompt
+            # shape, sampling config) — cached on the model so repeated
+            # generate() calls replay it instead of re-jitting (a fresh
+            # closure every call would defeat jit's identity-keyed cache)
+            cache_key = (max_new_tokens, b, n0, bool(do_sample),
+                         int(top_k), float(temperature))
+            decode_cache = getattr(self, '_decode_cache', None)
+            if decode_cache is None:
+                decode_cache = self._decode_cache = {}
+            _evict_oldest(decode_cache)
+            decode_jit = decode_cache.get(cache_key)
+            if decode_jit is None:
+                def _decode(p, bf, cs, first, key):
+                    def body(carry, _):
+                        cs, tok, key = carry
+                        key, sub = jax.random.split(key)
+                        (lg, new_cs), _ = func_mod.functional_call(
+                            self, p, bf, args=(Tensor(tok),),
+                            kwargs={'caches': cs}, training=False)
+                        nxt = pick(lg[:, -1], sub)
+                        return (new_cs, nxt[:, None], key), nxt
+
+                    (_, _, _), toks = jax.lax.scan(
+                        body, (cs, first, key), None,
+                        length=max_new_tokens - 1)
+                    return toks  # [steps, b]
+                decode_jit = decode_cache[cache_key] = jax.jit(_decode)
 
             key = jax.random.PRNGKey(seed)
             out = [ids._data.astype(jnp.int32)]
             key, sub = jax.random.split(key)
             nxt = pick(last, sub)[:, None]
             out.append(nxt)
-            for step in range(max_new_tokens - 1):
-                key, sub = jax.random.split(key)
-                nxt_tok, caches = step_jit(params, bufs, caches, nxt, sub)
-                nxt = nxt_tok[:, None]
-                out.append(nxt)
+            if max_new_tokens > 1:
+                toks = decode_jit(params, bufs, caches, nxt, key)
+                out.append(jnp.transpose(toks, (1, 0)))
             return Tensor(jnp.concatenate(out, axis=1))
         finally:
             if was_training:
@@ -451,10 +499,12 @@ class GPTForCausalLM(nn.Layer):
         return pre, gpt.h, post
 
     def loss(self, logits, labels):
-        if getattr(self.config, 'fused_loss', False) and \
+        if getattr(self.config, 'fused_loss', False) and self.training and \
                 logits.shape[-1] == self.config.hidden_size:
-            # fused contract: `logits` is the final HIDDEN state (see
-            # forward); head matmul + CE fuse in one chunked op
+            # fused TRAINING contract: `logits` is the final HIDDEN state
+            # (forward's training gate); head matmul + CE fuse in one
+            # chunked op. Both gates mirror forward's, so eval-path real
+            # logits never misroute here even when vocab == hidden.
             if self.lm_head is None:
                 ce = F.linear_cross_entropy(
                     logits, self.gpt.wte.weight, labels,

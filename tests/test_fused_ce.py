@@ -181,6 +181,46 @@ def test_gpt_fused_loss_adamw_loss_trajectory():
     np.testing.assert_allclose(l0, l1, rtol=1e-4)
 
 
+def test_fused_loss_under_shardmap_dp():
+    """ShardMapDPStep must run the loss inside the parameter binding too:
+    with fused_loss the tied wte head-grad otherwise silently vanishes
+    (same hazard TrainStep's post_fn closes)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_optimizers import ShardMapDPStep
+    from paddle_tpu.framework.functional import extract_params
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    cfg = dict(vocab_size=97, hidden_size=16, num_layers=1, num_heads=2,
+               max_position_embeddings=8, dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 97, (8, 8)).astype(np.int32)
+    lab = rng.randint(0, 97, (8, 8)).astype(np.int32)
+
+    results = {}
+    for fused in (False, True):
+        paddle.seed(0)
+        m = GPTForCausalLM(GPTConfig(fused_loss=fused, **cfg))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = ShardMapDPStep(m, lambda o, l: m.loss(o, l), opt,
+                              mode='dense')
+        loss = float(step(paddle.to_tensor(ids),
+                          paddle.to_tensor(lab)).numpy())
+        results[fused] = (loss, {k: np.asarray(v) for k, v in
+                                 extract_params(m).items()})
+    l0, p0 = results[False]
+    l1, p1 = results[True]
+    np.testing.assert_allclose(l0, l1, rtol=1e-5)
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=1e-4, atol=1e-6,
+                                    err_msg=k)
+    # and the tied weight actually moved (grads were not dropped)
+    paddle.seed(0)
+    init = np.asarray(GPTForCausalLM(GPTConfig(**cfg)).gpt.wte.weight
+                      .numpy())
+    assert np.abs(p1['gpt.wte.weight'] - init).max() > 1e-6
+
+
 def test_gpt_fused_loss_generate_unaffected():
     """generate() (cache path) still produces logits under fused_loss."""
     import paddle_tpu as paddle

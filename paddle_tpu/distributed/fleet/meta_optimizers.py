@@ -206,22 +206,11 @@ class ShardMapDPStep:
                 gen = rng_mod.default_generator()
                 saved = gen._key
                 gen._key = key
-                def _loss_post(out):
-                    # inside the parameter binding: a loss referencing
-                    # model parameters (fused tied-embedding head) must
-                    # differentiate the traced arrays (same contract as
-                    # TrainStep's post_fn)
-                    outs = out if isinstance(out, (list, tuple)) else (out,)
-                    t_outs = [Tensor(o._data if isinstance(o, Tensor)
-                                     else o, stop_gradient=False)
-                              for o in outs]
-                    t_labels = [Tensor(l) for l in labels]
-                    return loss_fn(*t_outs, *t_labels)
-
                 try:
                     loss_arr, _ = func_mod.functional_call(
                         model, all_params, buffers, args=inputs,
-                        training=True, post_fn=_loss_post)
+                        training=True,
+                        post_fn=func_mod.make_loss_post(loss_fn, labels))
                     return loss_arr
                 finally:
                     gen._key = saved

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from .core import Tensor
 from . import random as rng_mod
 
-__all__ = ['extract_params', 'extract_buffers', 'functional_call', 'TrainStep']
+__all__ = ['extract_params', 'extract_buffers', 'functional_call',
+           'make_loss_post', 'TrainStep']
 
 
 def _cast_like(tree, ref):
@@ -119,6 +120,25 @@ def functional_call(layer, params, buffers, args=(), kwargs=None,
             layer.training = prev_mode
             for l in layer.sublayers(include_self=True):
                 l.training = prev_mode
+
+
+def make_loss_post(loss_fn, labels):
+    """functional_call post_fn computing loss_fn(*outputs, *labels).
+
+    Runs INSIDE the parameter binding (see functional_call): a loss that
+    references model parameters — a fused tied-embedding head, weight
+    penalties — must differentiate the traced arrays; calling it after
+    the binding restores would silently drop those grad contributions.
+    Shared by TrainStep and ShardMapDPStep so the unwrap/rewrap contract
+    lives in one place.
+    """
+    def _loss_post(out):
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        t_outs = [Tensor(o._data if isinstance(o, Tensor) else o,
+                         stop_gradient=False) for o in outs]
+        t_labels = [Tensor(l) for l in labels]
+        return loss_fn(*t_outs, *t_labels)
+    return _loss_post
 
 
 def write_back_params(layer, params):
@@ -310,24 +330,11 @@ class TrainStep:
                         return loss_val * opt_state['loss_scale'], \
                             ({}, loss_val)
                     return loss_val, {}
-                def _loss_post(out):
-                    # runs inside the parameter binding: a loss_fn that
-                    # references model parameters (fused tied-embedding
-                    # head, weight penalties) differentiates the traced
-                    # arrays, not the live ones
-                    outs = out if isinstance(out, (list, tuple)) else (out,)
-                    t_outs = [Tensor(o._data if isinstance(o, Tensor)
-                                     else o, stop_gradient=False)
-                              for o in outs]
-                    t_labels = [Tensor(l) for l in labels]
-                    return loss_fn(*t_outs, *t_labels)
-
                 with rng_mod.key_scope(key):
-                    loss_arr, new_buf = functional_call(model, all_params,
-                                                        call_buffers,
-                                                        args=call_inputs,
-                                                        training=True,
-                                                        post_fn=_loss_post)
+                    loss_arr, new_buf = functional_call(
+                        model, all_params, call_buffers, args=call_inputs,
+                        training=True,
+                        post_fn=make_loss_post(loss_fn, labels))
                 loss_val = loss_arr
                 if amp_dtype is not None:
                     loss_val = loss_val.astype(jnp.float32)

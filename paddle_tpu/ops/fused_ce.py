@@ -53,7 +53,9 @@ def env_chunk_rows():
 
 def _chunk_plan(rows, chunk):
     """(chunk, n_chunks, padded_rows) with the unroll bounded."""
-    chunk = max(1, int(chunk))
+    # never a chunk larger than the input: padding rounds rows up to a
+    # chunk multiple, and padded rows cost real (masked) matmul flops
+    chunk = max(1, min(int(chunk), rows))
     n = -(-rows // chunk)
     if n > _MAX_CHUNKS:  # keep the unrolled program a sane size
         chunk = -(-rows // _MAX_CHUNKS)

@@ -105,13 +105,19 @@ jax.tree_util.register_pytree_node(GPTStaticCache, _cache_flatten,
                                    _cache_unflatten)
 
 
-def _evict_oldest(cache, cap=8):
-    """Bound a per-model compiled-executable cache: a serving loop with
+def _cache_get(cache, key, build, cap=8):
+    """Bounded per-model compiled-executable cache: a serving loop with
     naturally varying prompt/generation shapes must not pin one XLA
-    executable per distinct shape forever (FIFO is enough — shape churn
-    is the failure mode, not hot-set reuse)."""
+    executable per distinct shape forever. Eviction happens only on a
+    miss (FIFO, before insert) — a hit must never evict, least of all
+    the entry being requested."""
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
     while len(cache) >= cap:
         cache.pop(next(iter(cache)))
+    val = cache[key] = build()
+    return val
 
 
 class GPTAttention(nn.Layer):
@@ -400,15 +406,15 @@ class GPTForCausalLM(nn.Layer):
             pre_cache = getattr(self, '_prefill_cache', None)
             if pre_cache is None:
                 pre_cache = self._prefill_cache = {}
-            _evict_oldest(pre_cache)
-            pre_jit = pre_cache.get((b, n0, max_len))
-            if pre_jit is None:
+
+            def _build_prefill():
                 def _prefill(p, bf, cs, ids_):
                     (lg, cs2), _ = _fm.functional_call(
                         self, p, bf, args=(Tensor(ids_),),
                         kwargs={'caches': cs}, training=False)
                     return lg[:, -1], cs2
-                pre_jit = pre_cache[(b, n0, max_len)] = jax.jit(_prefill)
+                return jax.jit(_prefill)
+            pre_jit = _cache_get(pre_cache, (b, n0, max_len), _build_prefill)
             last, caches = pre_jit(_params, _bufs, caches, ids._data)
 
             # the whole decode is ONE compiled program: a lax.scan whose
@@ -430,9 +436,8 @@ class GPTForCausalLM(nn.Layer):
             decode_cache = getattr(self, '_decode_cache', None)
             if decode_cache is None:
                 decode_cache = self._decode_cache = {}
-            _evict_oldest(decode_cache)
-            decode_jit = decode_cache.get(cache_key)
-            if decode_jit is None:
+
+            def _build_decode():
                 def _decode(p, bf, cs, first, key):
                     def body(carry, _):
                         cs, tok, key = carry
@@ -447,7 +452,8 @@ class GPTForCausalLM(nn.Layer):
                         body, (cs, first, key), None,
                         length=max_new_tokens - 1)
                     return toks  # [steps, b]
-                decode_jit = decode_cache[cache_key] = jax.jit(_decode)
+                return jax.jit(_decode)
+            decode_jit = _cache_get(decode_cache, cache_key, _build_decode)
 
             key = jax.random.PRNGKey(seed)
             out = [ids._data.astype(jnp.int32)]
@@ -485,10 +491,13 @@ class GPTForCausalLM(nn.Layer):
 
         def post(x, labels):
             h = gpt.ln_f(x)
-            if getattr(self.config, 'fused_loss', False) and \
-                    loss_fn == self.loss:
-                # last pipeline stage fuses head+CE directly off the
-                # hidden state — loss() handles the hidden-state input
+            if getattr(self.config, 'fused_loss', False):
+                # last pipeline stage hands the HIDDEN state to loss_fn —
+                # the same fused-loss contract the non-pipelined training
+                # forward has (loss_fn routes through model.loss, which
+                # fuses head+CE off the hidden input). Gating on loss_fn
+                # identity would silently disable the fusion for any
+                # wrapper lambda around model.loss.
                 return loss_fn(h, labels)
             if self.lm_head is None:
                 logits = F.linear(h, M.transpose(gpt.wte.weight, [1, 0]))

@@ -221,6 +221,75 @@ def test_fused_loss_under_shardmap_dp():
     assert np.abs(p1['gpt.wte.weight'] - init).max() > 1e-6
 
 
+def _fleet_losses(fused, strategy_kwargs, steps=2, schedule=None,
+                  layers=2, opt_cls='adamw', **train_kw):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=layers,
+                    num_heads=4, max_position_embeddings=32, dropout=0.0,
+                    fused_loss=fused)
+    model = GPTForCausalLM(cfg)
+    s = fleet.DistributedStrategy()
+    hybrid = {'dp_degree': 8, 'mp_degree': 1, 'pp_degree': 1,
+              'sharding_degree': 1, 'sp_degree': 1}
+    hybrid.update(strategy_kwargs)
+    s.hybrid_configs = hybrid
+    if schedule is not None:
+        s.pipeline = True
+        s.pipeline_configs['schedule_mode'] = schedule
+    fleet.init(is_collective=True, strategy=s)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    step = fleet.fleet_train_step(
+        model, lambda lg, lb: model.loss(lg, lb), opt, strategy=s,
+        **train_kw)
+    rng = np.random.RandomState(3)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 32)).astype(np.int32))
+    lbl = paddle.to_tensor(rng.randint(0, 128, (8, 32)).astype(np.int32))
+    return [float(step(ids, lbl).numpy()) for _ in range(steps)]
+
+
+@pytest.mark.parametrize('name,kw', [
+    ('1f1b_pp2', dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
+                      schedule='1F1B', layers=4)),
+    ('gpipe_pp2', dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
+                       schedule='GPipe', layers=4)),
+    ('sp4', dict(strategy_kwargs={'dp_degree': 2, 'sp_degree': 4})),
+])
+def test_fused_loss_composes_with_schedules(name, kw):
+    """fused_loss under pp (1F1B fused last stage, GPipe) and sp must
+    train to the same losses as the straight non-fused model."""
+    ref = _fleet_losses(False, **kw)
+    got = _fleet_losses(True, **kw)
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5,
+                               err_msg=name)
+
+
+def test_fused_loss_with_remat_and_grad_merge():
+    """jax.checkpoint over the custom_vjp + k-step accumulation."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import functional as func_mod
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    rng = np.random.RandomState(5)
+    ids = paddle.to_tensor(rng.randint(0, 97, (4, 16)).astype(np.int32))
+    lbl = paddle.to_tensor(rng.randint(0, 97, (4, 16)).astype(np.int32))
+    losses = {}
+    for fused in (False, True):
+        paddle.seed(7)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
+            max_position_embeddings=16, dropout=0.0, fused_loss=fused))
+        opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                        parameters=m.parameters())
+        step = func_mod.TrainStep(m, m.loss, opt, remat=True, k_steps=2)
+        losses[fused] = [float(step(ids, lbl).numpy()) for _ in range(4)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
 def test_gpt_fused_loss_generate_unaffected():
     """generate() (cache path) still produces logits under fused_loss."""
     import paddle_tpu as paddle

@@ -93,9 +93,17 @@ class BertForSequenceClassification(nn.Layer):
 
 
 class BertForPretraining(nn.Layer):
-    """MLM + NSP heads."""
+    """MLM + NSP heads.
 
-    def __init__(self, bert=None, **bert_kwargs):
+    fused_mlm=True switches the TRAINING forward to return the
+    transformed hidden states instead of MLM logits, and loss() fuses
+    the vocab-wide decoder matmul into a chunked cross-entropy
+    (F.linear_cross_entropy) that never materializes [batch*seq, vocab]
+    logits — the same contract as GPTConfig(fused_loss=True), and a
+    natural fit for MLM where ~85% of positions are ignore_index.
+    """
+
+    def __init__(self, bert=None, fused_mlm=False, **bert_kwargs):
         super().__init__()
         self.bert = bert or BertModel(**bert_kwargs)
         hidden = self.bert.pooler.dense._out_features
@@ -105,14 +113,37 @@ class BertForPretraining(nn.Layer):
         self.layer_norm = nn.LayerNorm(hidden)
         self.decoder = nn.Linear(hidden, vocab)
         self.seq_relationship = nn.Linear(hidden, 2)
+        self.fused_mlm = fused_mlm
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
         encoded, pooled = self.bert(input_ids, token_type_ids, position_ids,
                                     attention_mask)
-        mlm = self.decoder(self.layer_norm(self.act(self.transform(encoded))))
+        h = self.layer_norm(self.act(self.transform(encoded)))
         nsp = self.seq_relationship(pooled)
-        return mlm, nsp
+        if self.fused_mlm and self.training:
+            return h, nsp
+        return self.decoder(h), nsp
+
+    def loss(self, mlm_out, nsp_out, mlm_labels, nsp_labels,
+             ignore_index=-100):
+        """Mean MLM CE over non-ignored positions + NSP CE (the reference
+        ERNIE/BERT pretraining objective)."""
+        from ...nn import functional as F
+        hidden = self.transform._out_features
+        if self.fused_mlm and self.training and \
+                mlm_out.shape[-1] == hidden:
+            mlm = F.linear_cross_entropy(
+                mlm_out, self.decoder.weight, mlm_labels,
+                bias=self.decoder.bias, ignore_index=ignore_index)
+        else:
+            from ...tensor import manipulation as M
+            b, n, v = mlm_out.shape
+            mlm = F.cross_entropy(M.reshape(mlm_out, [b * n, v]),
+                                  M.reshape(mlm_labels, [b * n]),
+                                  ignore_index=ignore_index)
+        nsp = F.cross_entropy(nsp_out, nsp_labels)
+        return mlm + nsp
 
 
 # ERNIE-1.0 (BASELINE config-3 metric family) shares BERT's encoder

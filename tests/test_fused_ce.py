@@ -290,6 +290,83 @@ def test_fused_loss_with_remat_and_grad_merge():
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
 
 
+def test_fused_step_program_has_no_full_logits(monkeypatch):
+    """Program-transform assertion: the fused TrainStep's jaxpr must not
+    contain ANY [rows, vocab]-shaped array — fwd, residual, or backward —
+    only [chunk, vocab] tiles. (The non-fused step's jaxpr shows several
+    full-size ones; that is the traffic the op exists to remove.)"""
+    import re
+    import paddle_tpu as paddle
+    monkeypatch.setenv('PADDLE_TPU_FUSED_CE_CHUNK', '64')
+    from paddle_tpu.framework import functional as func_mod
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    vocab, hidden, b, s = 1024, 32, 4, 64  # rows=256, vocab >> hidden
+    rows = b * s
+    ids = np.zeros((b, s), np.int32)
+
+    def jaxpr_for(fused):
+        paddle.seed(0)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=vocab, hidden_size=hidden, num_layers=1,
+            num_heads=2, max_position_embeddings=s, dropout=0.0,
+            fused_loss=fused))
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        step = func_mod.TrainStep(m, m.loss, opt)
+        return step.trace_jaxpr(paddle.to_tensor(ids),
+                                paddle.to_tensor(ids))
+
+    full = re.compile(r'(?:f32|bf16|f16)\[%d,%d\]' % (rows, vocab))
+    assert full.search(jaxpr_for(False)), 'sanity: plain path has them'
+    fused_jaxpr = jaxpr_for(True)
+    assert not full.search(fused_jaxpr), \
+        'fused step still materializes [rows, vocab]'
+    # the embedding table grad [vocab, hidden] must still exist (tied
+    # weight trains) — the fusion removes activations, not param grads
+    assert re.search(r'f32\[%d,%d\]' % (vocab, hidden), fused_jaxpr)
+
+
+def test_bert_fused_mlm_matches_plain():
+    """BertForPretraining(fused_mlm=True): same losses/params as the
+    straight MLM path, with ~85% ignore_index labels (the MLM shape)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import functional as func_mod
+    from paddle_tpu.text.models.bert import BertForPretraining
+
+    rng = np.random.RandomState(0)
+    b, s, v = 4, 16, 211
+    ids = rng.randint(0, v, (b, s)).astype(np.int32)
+    mlm_lab = rng.randint(0, v, (b, s)).astype(np.int32)
+    mlm_lab[rng.rand(b, s) > 0.15] = -100  # only masked positions count
+    nsp_lab = rng.randint(0, 2, (b,)).astype(np.int64)
+
+    results = {}
+    for fused in (False, True):
+        paddle.seed(0)
+        m = BertForPretraining(
+            fused_mlm=fused, vocab_size=v, hidden_size=32,
+            num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, max_position_embeddings=16,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        opt = paddle.optimizer.Momentum(learning_rate=1e-2, momentum=0.9,
+                                        parameters=m.parameters())
+        step = func_mod.TrainStep(
+            m, lambda mo, no, ml, nl: m.loss(mo, no, ml, nl), opt)
+        losses = [float(step((paddle.to_tensor(ids),),
+                             (paddle.to_tensor(mlm_lab),
+                              paddle.to_tensor(nsp_lab))).numpy())
+                  for _ in range(3)]
+        results[fused] = (losses, {k: np.asarray(p) for k, p in
+                                   func_mod.extract_params(m).items()})
+    l0, p0 = results[False]
+    l1, p1 = results[True]
+    np.testing.assert_allclose(l1, l0, rtol=1e-5)
+    for k in p0:
+        np.testing.assert_allclose(p1[k], p0[k], rtol=1e-4, atol=1e-6,
+                                    err_msg=k)
+
+
 def test_gpt_fused_loss_generate_unaffected():
     """generate() (cache path) still produces logits under fused_loss."""
     import paddle_tpu as paddle

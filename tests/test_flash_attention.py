@@ -100,6 +100,30 @@ def test_bf16_forward_close():
                                np.asarray(ref), rtol=0.05, atol=0.05)
 
 
+def test_bf16_grads_close():
+    """bf16 operands route the backward kernels' matmuls through the
+    native-dtype + f32-accumulation path (_mm_f32); grads must track the
+    f32 reference within bf16 resolution."""
+    q, k, v = _mk(d=64)
+
+    def loss_flash(qq, kk, vv):
+        return jnp.sum(fa.flash_attention_bhnd(
+            qq, kk, vv, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_ref(qq, kk, vv):
+        return jnp.sum(fa._ref_bhnd(qq, kk, vv, True,
+                                    1.0 / np.sqrt(64)) ** 2)
+
+    gb = jax.grad(loss_flash, argnums=(0, 1, 2))(
+        *(t.astype(jnp.bfloat16) for t in (q, k, v)))
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gbi, gri in zip(gb, gr):
+        assert gbi.dtype == jnp.bfloat16
+        denom = max(float(jnp.abs(gri).max()), 1e-6)
+        rel = float(jnp.abs(gbi.astype(jnp.float32) - gri).max()) / denom
+        assert rel < 0.1, rel
+
+
 @pytest.mark.parametrize('causal', [False, True])
 def test_ring_flash_matches_jnp_ring(causal):
     """ring_flash_attention (Pallas blocks + ppermute + LSE merge, ring

@@ -75,7 +75,6 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
 
     def one_qblock(qi, i):
         # qi: [b, h, bq, d]; i: scalar q-block index
-        q32 = qi.astype(jnp.float32) * scale
 
         def body(carry, xs):
             kj, vj, j = xs
@@ -88,7 +87,7 @@ def blockwise_attention_bnhd(q, k, v, causal=False, scale=None,
                 qpos = (m - n) + i * bq + jnp.arange(bq)
                 kpos = j * bk + jnp.arange(bk)
                 keep = qpos[:, None] >= kpos[None, :]
-            return _online_step(carry, q32, kj, vj, keep), None
+            return _online_step(carry, qi, kj, vj, scale, keep), None
 
         init = _online_init(b, h, bq, d)
         (m_f, l_f, acc), _ = lax.scan(jax.checkpoint(body), init,
@@ -107,16 +106,21 @@ def _online_init(b, h, bq, d):
             jnp.zeros((b, h, bq, d), jnp.float32))
 
 
-def _online_step(carry, q32, kj, vj, keep=None):
+def _online_step(carry, qn, kj, vj, scale, keep=None):
     """One online-softmax accumulation step over a single KV block.
 
     carry = (running max, running denom, running weighted-V accum), all
-    f32. `keep` is an optional [bq, bk] visibility mask. The single copy
-    of this numerically delicate update serves the masked fallback, the
+    f32. qn/kj/vj stay in their NATIVE dtype: the two einsums contract
+    bf16 operands with f32 MXU accumulation (preferred_element_type) —
+    upcasting first would run the MXU at its f32 rate, ~8x slower on
+    v5e, for no accuracy gain (softmax math is f32 either way). `keep`
+    is an optional [bq, bk] visibility mask. The single copy of this
+    numerically delicate update serves the masked fallback, the
     causal-skip scan body, and the causal diagonal block.
     """
     m_prev, l_prev, acc = carry
-    s = jnp.einsum('bhqd,bhkd->bhqk', q32, kj.astype(jnp.float32))
+    s = jnp.einsum('bhqd,bhkd->bhqk', qn, kj,
+                   preferred_element_type=jnp.float32) * scale
     if keep is not None:
         s = jnp.where(keep, s, _NEG_INF)
     m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -128,7 +132,8 @@ def _online_step(carry, q32, kj, vj, keep=None):
     corr = jnp.exp(m_prev - m_cur)
     l_cur = l_prev * corr + jnp.sum(p, axis=-1)
     acc = acc * corr[..., None] + jnp.einsum(
-        'bhqk,bhkd->bhqd', p, vj.astype(jnp.float32))
+        'bhqk,bhkd->bhqd', p.astype(vj.dtype), vj,
+        preferred_element_type=jnp.float32)
     return m_cur, l_cur, acc
 
 
@@ -146,23 +151,24 @@ def _causal_skip(qb, kb, vb, scale, out_dtype):
     b, h, tq, bq, d = qb.shape
     tri = jnp.arange(bq)[:, None] >= jnp.arange(bq)[None, :]
 
-    def make_body(q32):
+    def make_body(qn):
         def body(carry, xs):
-            return _online_step(carry, q32, *xs), None
+            kj, vj = xs
+            return _online_step(carry, qn, kj, vj, scale), None
         return body
 
-    def diag_step(carry, q32, kj, vj):
-        return _online_step(carry, q32, kj, vj, tri)
+    def diag_step(carry, qn, kj, vj):
+        return _online_step(carry, qn, kj, vj, scale, tri)
 
     outs = []
     for i in range(tq):
-        q32 = qb[:, :, i].astype(jnp.float32) * scale
+        qn = qb[:, :, i]
         carry = _online_init(b, h, bq, d)
         if i > 0:
-            carry, _ = lax.scan(jax.checkpoint(make_body(q32)), carry,
+            carry, _ = lax.scan(jax.checkpoint(make_body(qn)), carry,
                                 (kb[:i], vb[:i]))
         # diagonal block: the only one needing the triangle mask
-        m_f, l_f, acc = jax.checkpoint(diag_step)(carry, q32, kb[i], vb[i])
+        m_f, l_f, acc = jax.checkpoint(diag_step)(carry, qn, kb[i], vb[i])
         outs.append((acc / jnp.maximum(l_f, 1e-30)[..., None]
                      ).astype(out_dtype))
     return jnp.stack(outs, axis=2).reshape(b, h, tq * bq, d)

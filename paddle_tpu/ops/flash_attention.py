@@ -52,6 +52,12 @@ def _supported(q, k, v):
     """None if the Pallas kernels can run on these shapes, else the reason."""
     b, h, n, d = q.shape
     m = k.shape[2]
+    if not (q.dtype == k.dtype == v.dtype):
+        # the kernels contract in the operands' native dtype (_mm_f32);
+        # lax.dot_general has no implicit promotion, so mixed dtypes must
+        # take the documented fallback path rather than an opaque error
+        return 'mixed operand dtypes (%s, %s, %s)' % (q.dtype, k.dtype,
+                                                      v.dtype)
     if d % 64:
         return 'head_dim %d %% 64 != 0' % d
     if n % min(_DEFAULT_BLOCK_Q, n) or m % min(_DEFAULT_BLOCK_K, m):

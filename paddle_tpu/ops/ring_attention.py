@@ -82,8 +82,6 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
     my_idx = lax.axis_index(axis_name)
     b, n_loc, h, d = q.shape
 
-    q32 = q.astype(jnp.float32)
-
     # positions of the local q block (global)
     q_pos = my_idx * n_loc + jnp.arange(n_loc)
 
@@ -98,7 +96,9 @@ def ring_attention(q, k, v, axis_name='sp', causal=False, scale=None,
             mask = None
         blk_key = (jax.random.fold_in(dropout_key, src)
                    if dropout_p and dropout_key is not None else None)
-        blk = _block_attn(q32, k_cur, v_cur, scale, mask,
+        # q in its native dtype: _block_attn contracts with f32 MXU
+        # accumulation; a pre-upcast would force an f32-rate matmul
+        blk = _block_attn(q, k_cur, v_cur, scale, mask,
                           dropout_p, blk_key)
         m_new, l_new, acc_new = _merge_blocks((m_prev, l_prev, acc_prev),
                                               blk)
@@ -150,8 +150,9 @@ def zigzag_ring_attention(q, k, v, axis_name='sp', scale=None,
     c = n_loc // 2
     two_p = 2 * n_dev
 
-    q32 = q.astype(jnp.float32)
-    q_lo, q_hi = q32[:, :c], q32[:, c:]
+    # native dtype: see ring_attention (f32 accumulation lives in
+    # _block_attn's preferred_element_type)
+    q_lo, q_hi = q[:, :c], q[:, c:]
     lo_chunk, hi_chunk = r, two_p - 1 - r
     tri = jnp.tril(jnp.ones((c, c), bool))
 
@@ -271,7 +272,7 @@ def ulysses_attention(q, k, v, axis_name='sp', causal=False, scale=None,
             of = blockwise_attention(qf, kf, vf, causal=True, scale=scale,
                                      block_q=blk, block_k=blk)
         else:
-            s = jnp.einsum('bqhd,bkhd->bhqk', qf.astype(jnp.float32), kf,
+            s = jnp.einsum('bqhd,bkhd->bhqk', qf, kf,
                            preferred_element_type=jnp.float32) * scale
             if causal:
                 cm = jnp.tril(jnp.ones((n_full, n_full), bool))

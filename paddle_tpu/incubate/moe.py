@@ -106,15 +106,28 @@ class SwitchMoE(nn.Layer):
                 combine = combine + d_k * gates[:, k][:, None, None]
                 counts = counts + oh_k.sum(0)
 
-            xin = jnp.einsum('tec,th->ech', dispatch,
-                             xt.astype(jnp.float32))
+            # expert matmuls contract in the compute dtype with f32 MXU
+            # accumulation — upcasting the operands would run the MXU at
+            # its f32 rate (~8x slower on v5e). dispatch/combine are
+            # exact in bf16 (0/1 capacity masks; combine's gate weights
+            # round at bf16, the same precision the probs would reach as
+            # activations anyway); the f32 routing math above is
+            # unaffected.
+            cdt = xt.dtype
+            # dispatch is a 0/1 capacity mask: each (e, c) slot sums at
+            # most ONE token, so f32 accumulation buys nothing — contract
+            # straight in the compute dtype
+            xin = jnp.einsum('tec,th->ech', dispatch.astype(cdt), xt)
             h1 = jax.nn.gelu(
-                jnp.einsum('ech,ehf->ecf', xin, w1.astype(jnp.float32))
+                jnp.einsum('ech,ehf->ecf', xin, w1,
+                           preferred_element_type=jnp.float32)
                 + b1.astype(jnp.float32)[:, None])
-            out_e = jnp.einsum('ecf,efh->ech', h1,
-                               w2.astype(jnp.float32)) \
+            out_e = jnp.einsum('ecf,efh->ech', h1.astype(cdt), w2,
+                               preferred_element_type=jnp.float32) \
                 + b2.astype(jnp.float32)[:, None]
-            y = jnp.einsum('tec,ech->th', combine, out_e)
+            y = jnp.einsum('tec,ech->th', combine.astype(cdt),
+                           out_e.astype(cdt),
+                           preferred_element_type=jnp.float32)
 
             # Switch aux loss: E * sum_e frac_tokens_e * mean_prob_e
             frac = jnp.mean(onehot, axis=0)

@@ -349,8 +349,10 @@ def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)  # [B, H, N, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
     reason = (None if fa.is_available() else 'flash unavailable on this '
-              'backend') or fa._supported(qt, qt, qt)
+              'backend') or fa._supported(qt, kt, vt)
     if reason is not None:
         if fa.strict_mode():
             raise RuntimeError(
@@ -429,8 +431,6 @@ def ring_flash_attention(q, k, v, axis_name='sp', causal=False, scale=None,
 
     _ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
 
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
     return jnp.swapaxes(_ring(qt, kt, vt), 1, 2)
 
 

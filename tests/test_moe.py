@@ -166,3 +166,22 @@ def test_top2_capacity_overflow_drops_second_choice_first():
     norms = np.linalg.norm(y.numpy()[0], axis=-1)
     norms_full = np.linalg.norm(y_full[0], axis=-1)
     assert (norms < norms_full - 1e-6).any()
+
+
+def test_switch_moe_bf16_close_to_f32():
+    """The low-precision expert path (native-dtype contractions with f32
+    MXU accumulation) must track the f32 layer within bf16 resolution —
+    locks the dtype contract the f32-matmul audit installed."""
+    paddle.seed(0)
+    moe = SwitchMoE(hidden_size=16, ffn_size=32, num_experts=4,
+                    capacity_factor=4.0)
+    rng = np.random.RandomState(2)
+    x = rng.randn(10, 16).astype(np.float32)
+    y32 = moe(paddle.to_tensor(x)).numpy()
+
+    moe.bfloat16()
+    yb = moe(paddle.to_tensor(x).astype('bfloat16'))
+    assert str(yb.dtype).endswith('bfloat16')
+    yb = np.asarray(yb.numpy(), np.float32)
+    denom = max(float(np.abs(y32).max()), 1e-6)
+    assert float(np.abs(yb - y32).max()) / denom < 0.05

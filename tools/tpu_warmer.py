@@ -169,6 +169,25 @@ def main():
         record('profile_' + label, result, err, wall)
         log('profile(%s): %s (%.0fs)' % (
             label, 'ok -> %s' % pdir if result is not None else err, wall))
+        if result is not None:
+            # self-documenting window: roofline summary of the fresh
+            # trace lands next to the profile for post-hoc analysis
+            try:
+                proc = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, 'tools', 'profile_analysis.py'),
+                     pdir], capture_output=True, text=True, timeout=120)
+                if proc.returncode != 0:
+                    log('profile summary failed rc=%d: %s'
+                        % (proc.returncode, (proc.stderr or '')[-300:]))
+                else:
+                    out_path = os.path.join(REPO, 'docs',
+                                            'profile_summary_r4.txt')
+                    with open(out_path, 'w') as f:
+                        f.write('rung: %s\n%s' % (label, proc.stdout))
+                    log('profile summary -> %s' % out_path)
+            except Exception as e:
+                log('profile summary failed: %r' % (e,))
     # BASELINE configs 2/4 (ResNet train throughput, YOLO inference):
     # bench_extra prints one JSON line per config
     if probe_tpu():

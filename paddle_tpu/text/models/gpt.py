@@ -5,6 +5,8 @@ placements, ZeRO sharding specs, pipeline stages) apply uniformly. Causal
 attention routes through F.scaled_dot_product_attention → pallas flash
 kernel on TPU.
 """
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -137,10 +139,26 @@ class GPTAttention(nn.Layer):
     def forward(self, x, cache=None):
         b, n = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
-        qkv = M.reshape(qkv, [b, n, 3, self.num_heads, self.head_dim])
-        q = qkv[:, :, 0]
-        k = qkv[:, :, 1]
-        v = qkv[:, :, 2]
+        if os.environ.get('PADDLE_TPU_QKV_SPLIT') == 'last':
+            # experimental A/B (bench rung): slice the packed minor axis
+            # at 128-aligned offsets instead of reshaping to 5-D and
+            # slicing the middle axis. The round-4 profile shows
+            # ~5 ms/step of [b,n,3,h,d] layout-copy traffic on the
+            # middle-axis path; whether last-axis slicing removes it is
+            # measured in-window, not assumed. NOT the default: under
+            # tensor parallelism the packed 2304 axis is mp-sharded and
+            # q/k/v offsets straddle shard boundaries (the [3, heads, d]
+            # head-axis slicing keeps each shard self-contained).
+            hs = self.hidden_size
+            hd = [b, n, self.num_heads, self.head_dim]
+            q = M.reshape(qkv[:, :, :hs], hd)
+            k = M.reshape(qkv[:, :, hs:2 * hs], hd)
+            v = M.reshape(qkv[:, :, 2 * hs:], hd)
+        else:
+            qkv = M.reshape(qkv, [b, n, 3, self.num_heads, self.head_dim])
+            q = qkv[:, :, 0]
+            k = qkv[:, :, 1]
+            v = qkv[:, :, 2]
         if isinstance(cache, GPTStaticCache):
             import jax
             from ...framework.core import is_grad_enabled

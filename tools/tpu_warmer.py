@@ -62,6 +62,10 @@ CONFIGS = [
     ('fused_flash_seq8192_b2_scan2', {'PADDLE_TPU_BENCH_SEQ': '8192',
                                       'PADDLE_TPU_BENCH_BATCH': '2',
                                       'PADDLE_TPU_BENCH_SCAN_STEPS': '2'}),
+    # A/B: last-axis qkv split (layout-copy hypothesis from the r4
+    # profile — ~5 ms/step of [b,n,3,h,d] copies on the default path)
+    ('fused_flash_scan8_qkvlast', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                   'PADDLE_TPU_QKV_SPLIT': 'last'}),
     # the remaining driver-ladder fallback rungs (bench.py): warm their
     # caches too, and keep refreshing r4's best plain capture
     ('flash_plain', {'PADDLE_TPU_FUSED_CE': '0'}),

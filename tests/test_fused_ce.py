@@ -382,28 +382,3 @@ def test_gpt_fused_loss_generate_unaffected():
     out_f = m_f.generate(paddle.to_tensor(ids), max_new_tokens=6)
     out_p = m_p.generate(paddle.to_tensor(ids), max_new_tokens=6)
     np.testing.assert_array_equal(out_f.numpy(), out_p.numpy())
-
-
-def test_qkv_split_last_is_bitwise_identical(monkeypatch):
-    """PADDLE_TPU_QKV_SPLIT=last picks the same q/k/v channels as the
-    default 5-D-reshape path — the flat [3*h*d] axis maps identically
-    ([i3, ih, id] <-> i3*h*d + ih*d + id) so outputs must match exactly."""
-    import paddle_tpu as paddle
-    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
-
-    cfg = dict(vocab_size=97, hidden_size=32, num_layers=2, num_heads=4,
-               max_position_embeddings=16, dropout=0.0)
-    ids = np.random.RandomState(0).randint(0, 97, (2, 16)).astype(np.int32)
-
-    # the reference must take the DEFAULT path even if a shell left the
-    # A/B knob exported — otherwise the test compares last vs last
-    monkeypatch.delenv('PADDLE_TPU_QKV_SPLIT', raising=False)
-    paddle.seed(0)
-    ref = GPTForCausalLM(GPTConfig(**cfg))
-    out_ref = ref(paddle.to_tensor(ids)).numpy()
-
-    monkeypatch.setenv('PADDLE_TPU_QKV_SPLIT', 'last')
-    paddle.seed(0)
-    alt = GPTForCausalLM(GPTConfig(**cfg))
-    out_alt = alt(paddle.to_tensor(ids)).numpy()
-    np.testing.assert_array_equal(out_ref, out_alt)

@@ -72,6 +72,14 @@ CONFIGS = [
     ('flash_disabled_plain', {'PADDLE_TPU_FUSED_CE': '0',
                               'PADDLE_TPU_FLASH_DISABLE': '1',
                               'PADDLE_TPU_FLASH_STRICT': '0'}),
+    # flash kernel block-size sweep (kernels read PADDLE_TPU_FLASH_BLOCK_*
+    # at import; each bench child re-imports): defaults are 256/512
+    ('fused_flash_scan8_bq128_bk128', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                       'PADDLE_TPU_FLASH_BLOCK_Q': '128',
+                                       'PADDLE_TPU_FLASH_BLOCK_K': '128'}),
+    ('fused_flash_scan8_bq512_bk512', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                       'PADDLE_TPU_FLASH_BLOCK_Q': '512',
+                                       'PADDLE_TPU_FLASH_BLOCK_K': '512'}),
 ]
 
 

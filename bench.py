@@ -308,10 +308,13 @@ def _inwindow_log_path():
 
 
 def _attach_tpu_capture(result):
-    """Attach the best warmer-captured REAL-TPU measurement (platform
-    'tpu', not degraded) to a degraded result, clearly labeled. Purely
-    opportunistic: ANY failure reading the log must not cost the real
-    measured number."""
+    """Attach the round's best warmer-captured REAL-TPU measurement
+    (platform 'tpu', not degraded) to ANY result: a degraded run carries
+    it as the round's genuine TPU evidence, a live run carries it for
+    comparison (the warmer may have measured a better rung). Its
+    presence does NOT imply degradation — check result['degraded'].
+    Purely opportunistic: ANY failure reading the log must not cost the
+    real measured number."""
     try:
         best = None
         with open(_inwindow_log_path(), errors='replace') as f:
@@ -419,8 +422,11 @@ def _orchestrate(errors):
             if result is not None:
                 if label:
                     result['retry'] = label
-                if result.get('degraded'):
-                    _attach_tpu_capture(result)
+                # context either way: a degraded result carries the
+                # round's best REAL capture as its evidence; a live TPU
+                # result carries it for comparison (the warmer may have
+                # measured a better rung than the one that ran here)
+                _attach_tpu_capture(result)
                 print(json.dumps(result))
                 return
             errors.append('run %d: %s' % (attempt, err))

@@ -595,15 +595,12 @@ class TrainStep:
         self._write_opt_state(new_opt_state)
         return Tensor(losses)
 
-    def compiled_hlo(self, inputs, labels):
-        """Optimized (post-SPMD-partitioning) HLO of the step, plus the
-        compiled executable's input shardings for the params pytree.
-
-        Returns (hlo_text, param_shardings dict). Tests assert the
-        partitioner REALLY inserted the expected collectives and sharded
-        the parameters at realistic dims — the TPU analog of the
-        reference's program-transform assertions
-        (test_fleet_*_meta_optimizer.py, SURVEY §4.2)."""
+    def compiled_executable(self, inputs, labels):
+        """Compile the step for this batch and return the jax Compiled
+        object (without executing) — tests read its HLO text, input
+        shardings, and memory_analysis() (peak temp bytes is the honest
+        metric for 'does this transformation actually save memory';
+        HLO-text tensor counts are only a proxy)."""
         in_arrays, lab_arrays = self._step_args(inputs, labels)
         if self._batch_sharding is not None:
             in_arrays = tuple(jax.device_put(a, self._batch_sharding)
@@ -618,9 +615,20 @@ class TrainStep:
             opt_state = self._opt_state()
             lr = self._lr_array()
             key = rng_mod.default_generator()._key
-            compiled = self._jitted.lower(
+            return self._jitted.lower(
                 params, buffers, opt_state, (in_arrays, lab_arrays), lr,
                 key).compile()
+
+    def compiled_hlo(self, inputs, labels):
+        """Optimized (post-SPMD-partitioning) HLO of the step, plus the
+        compiled executable's input shardings for the params pytree.
+
+        Returns (hlo_text, param_shardings dict). Tests assert the
+        partitioner REALLY inserted the expected collectives and sharded
+        the parameters at realistic dims — the TPU analog of the
+        reference's program-transform assertions
+        (test_fleet_*_meta_optimizer.py, SURVEY §4.2)."""
+        compiled = self.compiled_executable(inputs, labels)
         hlo = compiled.as_text()
         try:
             pshard = compiled.input_shardings[0][0]

@@ -80,6 +80,15 @@ def _tile_logits(xc, w, bias):
     return logits.astype(jnp.float32)
 
 
+def _label_onehot(safe, shape):
+    """[rows, vocab] bool mask selecting each row's label column, built
+    by iota-compare rather than gather/one_hot: elementwise over the
+    vocab axis, so GSPMD keeps it sharded with the logits tile (a
+    vocab-axis gather would make the partitioner all-gather the tile).
+    Shared by fwd (label-logit pick) and bwd (softmax - onehot)."""
+    return jax.lax.broadcasted_iota(jnp.int32, shape, 1) == safe[:, None]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def linear_cross_entropy_arrays(x, w, labels, bias, ignore_index, chunk):
     """Mean softmax-CE of (x @ w + bias) vs labels over valid rows.
@@ -107,7 +116,14 @@ def _lce_fwd(x, w, labels, bias, ignore_index, chunk):
         m = af.max(axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(af - m[:, None]), axis=-1))
         safe = jnp.clip(lc, 0, v - 1).astype(jnp.int32)
-        picked = jnp.take_along_axis(af, safe[:, None], axis=-1)[:, 0]
+        # pick the label logit as a masked SUM, not take_along_axis: a
+        # gather along the vocab axis defeats GSPMD when the head weight
+        # is mp-sharded, while iota-compare + sum partitions into a
+        # local reduce + a tiny all-reduce — the vocab-parallel CE
+        # pattern (reference: c_softmax_with_cross_entropy). The
+        # elementwise cost fuses into the pass that reads af anyway.
+        picked = jnp.sum(jnp.where(_label_onehot(safe, af.shape),
+                                   af, 0.0), axis=-1)
         lse_parts.append(lse)
         picked_parts.append(picked)
     lse = jnp.concatenate(lse_parts)
@@ -138,8 +154,7 @@ def _lce_bwd(ignore_index, chunk, res, g):
         p = jnp.exp(af - lse_c[:, None])
         valid = lc != ignore_index
         safe = jnp.clip(lc, 0, v - 1).astype(jnp.int32)
-        onehot = jax.lax.broadcasted_iota(
-            jnp.int32, (p.shape[0], v), 1) == safe[:, None]
+        onehot = _label_onehot(safe, p.shape)
         # d(CE)/d(logits) = softmax - onehot, zeroed on ignored rows; the
         # whole epilogue is elementwise so XLA fuses it into both
         # consuming matmuls — p never round-trips HBM at full precision

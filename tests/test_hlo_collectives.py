@@ -23,11 +23,11 @@ from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
 HIDDEN, HEADS, VOCAB, SEQ = 768, 12, 30522, 512
 
 
-def _model(seed=0):
+def _model(seed=0, **overrides):
     paddle.seed(seed)
     cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=2,
                     num_heads=HEADS, max_position_embeddings=SEQ,
-                    dropout=0.0)
+                    dropout=0.0, **overrides)
     return GPTForCausalLM(cfg)
 
 
@@ -130,3 +130,35 @@ def test_dp_only_grad_allreduce_present():
     qkv = [n for n in pshard if 'qkv_proj' in n and 'weight' in n]
     shape = pshard[qkv[0]].shard_shape((HIDDEN, 3 * HIDDEN))
     assert shape == (HIDDEN, 3 * HIDDEN), shape
+
+
+def test_fused_loss_dp_mp_memory_and_collectives():
+    """fused_loss at BERT-base dims under dp2 x mp4.
+
+    Measured behavior (2026-07): GSPMD gathers the vocab dimension for
+    the CE region in BOTH the plain and the fused path (f32[2048,30522]
+    tiles appear per device) — the partitioner's cost model prefers
+    replicated-vocab compute over vocab-parallel reductions here. The
+    single-device no-full-logits guarantee is locked by
+    test_fused_ce.py::test_fused_step_program_has_no_full_logits; THIS
+    test pins the multi-chip contract on the honest metric: the dp/mp
+    collectives are present, rows stay dp-sharded, and the fused
+    executable's peak TEMP memory is strictly below the plain one's
+    (measured ~769 MB vs ~1011 MB)."""
+    ids, lbl = _batch()
+
+    def build(fused):
+        model = _model(fused_loss=fused)
+        step = _step(model, _strategy(dp_degree=2, mp_degree=4))
+        compiled = step.compiled_executable(ids, lbl)
+        hlo = compiled.as_text()
+        counts = _collective_counts(hlo)
+        assert counts['all-reduce'] >= 2, counts
+        rows = ids.shape[0] * SEQ
+        assert not re.search(r'\[%d,%d\]' % (rows, VOCAB), hlo), \
+            'replicated-rows full logits'
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    fused_tmp = build(True)
+    plain_tmp = build(False)
+    assert fused_tmp < plain_tmp, (fused_tmp, plain_tmp)

@@ -60,13 +60,14 @@ def _conv(name, nd, x, weight, bias, stride, padding, dilation, groups,
             # paddle weights are OIHW regardless of data layout; transpose to HWIO
             perm = tuple(range(2, 2 + nd)) + (1, 0)
             ww = jnp.transpose(ww, perm)
+        # NOTE: no preferred_element_type here. The TPU MXU accumulates conv
+        # in f32 regardless of operand dtype, and a bf16 output rounds once
+        # either way — while an f32 output + astype(bf16) breaks the VJP (the
+        # astype's cotangent arrives f32 at the bf16 conv transpose).
         out = lax.conv_general_dilated(
             a, ww, window_strides=stride, padding=pad,
             rhs_dilation=dilation, dimension_numbers=dn,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
-        if out.dtype != a.dtype:
-            out = out.astype(a.dtype)
+            feature_group_count=groups)
         if maybe_b:
             b = maybe_b[0]
             shape = [1] * out.ndim

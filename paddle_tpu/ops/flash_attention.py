@@ -85,6 +85,17 @@ def _ref_bhnd(q, k, v, causal, scale):
 
 # -- forward -----------------------------------------------------------------
 
+def _causal_mask(s, q_start, k_start):
+    """Mask scores [bq, bk] whose global k position exceeds the global q
+    position (top-left-aligned causal; the kernels' n == m contract —
+    cross-length causal routes to blockwise before any kernel runs).
+    q_start/k_start are the blocks' global offsets."""
+    bq, bk = s.shape
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
 def _mm_f32(a, b, transpose_a=False, transpose_b=False):
     """a @ b (with either operand logically transposed) in the operands'
     NATIVE dtype with f32 MXU accumulation (preferred_element_type).
@@ -120,11 +131,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
         v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = _mm_f32(q, k_blk, transpose_b=True) * scale  # [bq, bk] f32
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi * block_q, kb * block_k)
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
         p = jnp.exp(s - m_cur[:, None])
         alpha = jnp.exp(m_prev - m_cur)
@@ -186,6 +193,265 @@ def _fwd_impl(q, k, v, causal, scale):
     return o, lse
 
 
+# -- long-sequence kernels ---------------------------------------------------
+#
+# The short-seq kernels above stage the FULL K/V (and in the dk/dv pass,
+# full Q/dO) into VMEM per grid cell and fori_loop over them — simple and
+# fast at seq <= ~4k, but at 8192 the staged operands plus the loop-body
+# temporaries exceed scoped VMEM (the r4 in-window failure:
+# "kernel-vmem-stack-oom", docs/bench_inwindow_r4.jsonl 11:58). The long
+# variants below use the canonical Mosaic structure instead: the KV (or
+# Q) walk is the LAST grid dimension ("arbitrary" = sequential on TPU),
+# each cell sees one [block, d] tile, and the online-softmax carry lives
+# in VMEM scratch that persists across sequential grid steps. Staged
+# bytes are then O(block) regardless of sequence length.
+
+_LONG_SEQ = int(os.environ.get('PADDLE_TPU_FLASH_LONG_SEQ', 4096))
+
+
+def _use_long_path(n, m):
+    if os.environ.get('PADDLE_TPU_FLASH_FORCE_LONG', '0') == '1':
+        return True
+    return max(n, m) > _LONG_SEQ
+
+
+def _fwd_kernel_long(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                     m_scr, l_scr, acc_scr, *, scale, causal, num_kb,
+                     block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: whole block above the diagonal contributes nothing
+    diag_ok = True
+    if causal:
+        diag_ok = kb * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(diag_ok)
+    def _step():
+        q = q_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        s = _mm_f32(q, k_blk, transpose_b=True) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, kb * block_k)
+        m_prev = m_scr[...][:, 0]
+        l_prev = l_scr[...][:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = alpha * l_prev + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + \
+            _mm_f32(p.astype(v_blk.dtype), v_blk)
+        m_scr[...] = m_cur[:, None]
+        l_scr[...] = l_cur[:, None]
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_scr[...][:, 0], 1e-30)
+        o_ref[...] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[...] = (m_scr[...][:, 0] + jnp.log(l_safe))[:, None]
+
+
+def _fwd_impl_long(q, k, v, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    block_q = min(_DEFAULT_BLOCK_Q, n)
+    block_k = min(_DEFAULT_BLOCK_K, m)
+    num_kb = m // block_k
+
+    grid = (b, h, n // block_q, num_kb)
+    kernel = functools.partial(_fwd_kernel_long, scale=scale, causal=causal,
+                               num_kb=num_kb, block_q=block_q,
+                               block_k=block_k)
+    kwargs = {}
+    if interpret_mode():
+        kwargs['interpret'] = True
+    else:
+        kwargs['compiler_params'] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+    o, lse = pl.pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, n, 1), jnp.float32)],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        **kwargs,
+    )(q, k, v)
+    return o, lse
+
+
+def _bwd_dq_kernel_long(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dq_scr, *, scale, causal, num_kb,
+                        block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+    kb = pl.program_id(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    diag_ok = True
+    if causal:
+        diag_ok = kb * block_k <= (qi + 1) * block_q - 1
+
+    @pl.when(diag_ok)
+    def _step():
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        s = _mm_f32(q, k_blk, transpose_b=True) * scale
+        if causal:
+            s = _causal_mask(s, qi * block_q, kb * block_k)
+        p = jnp.exp(jnp.minimum(s - lse, 30.0))  # see _bwd_dq_kernel
+        dp = _mm_f32(do, v_blk, transpose_b=True)
+        ds = p * (dp - delta) * scale
+        dq_scr[...] = dq_scr[...] + _mm_f32(ds.astype(k_blk.dtype), k_blk)
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_long(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                         num_qb, block_q, block_k):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(2)
+    qb = pl.program_id(3)
+
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    diag_ok = True
+    if causal:
+        # rows strictly above the diagonal see nothing of this k block
+        diag_ok = (qb + 1) * block_q - 1 >= ki * block_k
+
+    @pl.when(diag_ok)
+    def _step():
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
+        q_b = q_ref[...]
+        do_b = do_ref[...]
+        lse_b = lse_ref[...]
+        delta_b = delta_ref[...]
+        s = _mm_f32(q_b, k_blk, transpose_b=True) * scale
+        if causal:
+            s = _causal_mask(s, qb * block_q, ki * block_k)
+        p = jnp.exp(jnp.minimum(s - lse_b, 30.0))
+        dv_scr[...] = dv_scr[...] + _mm_f32(p.astype(do_b.dtype), do_b,
+                                            transpose_a=True)
+        dp = _mm_f32(do_b, v_blk, transpose_b=True)
+        ds = p * (dp - delta_b) * scale
+        dk_scr[...] = dk_scr[...] + _mm_f32(ds.astype(q_b.dtype), q_b,
+                                            transpose_a=True)
+
+    @pl.when(qb == num_qb - 1)
+    def _finish():
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl_long(q, k, v, o, lse, do, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    block_q = min(_DEFAULT_BLOCK_Q, n)
+    block_k = min(_DEFAULT_BLOCK_K, m)
+    num_kb = m // block_k
+    num_qb = n // block_q
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [b, h, n, 1]
+
+    kwargs = {}
+    if interpret_mode():
+        kwargs['interpret'] = True
+    else:
+        kwargs['compiler_params'] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+
+    qspec = pl.BlockSpec((None, None, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kspec_q = pl.BlockSpec((None, None, block_k, d),
+                           lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    rowq = pl.BlockSpec((None, None, block_q, 1),
+                        lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_long, scale=scale, causal=causal,
+                          num_kb=num_kb, block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+        grid=(b, h, num_qb, num_kb),
+        in_specs=[qspec, kspec_q, kspec_q, qspec, rowq, rowq],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        **kwargs,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: k block is the parallel axis, q walk is sequential
+    qspec_k = pl.BlockSpec((None, None, block_q, d),
+                           lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kspec = pl.BlockSpec((None, None, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    rowq_k = pl.BlockSpec((None, None, block_q, 1),
+                          lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_long, scale=scale, causal=causal,
+                          num_qb=num_qb, block_q=block_q, block_k=block_k),
+        out_shape=[jax.ShapeDtypeStruct((b, h, m, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, m, d), v.dtype)],
+        grid=(b, h, m // block_k, num_qb),
+        in_specs=[qspec_k, kspec, kspec, qspec_k, rowq_k, rowq_k],
+        out_specs=[kspec, kspec],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        **kwargs,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
 # -- backward ----------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -207,11 +473,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
         s = _mm_f32(q, k_blk, transpose_b=True) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qi * block_q, kb * block_k)
         # clamped exp: for valid rows s - lse <= ~0; the headroom only
         # matters when a caller (ring attention) zero-weights a block it
         # computed unmasked — without the clamp an overflowing exp would
@@ -250,11 +512,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta_b = delta_ref[pl.ds(qb * block_q, block_q), :]  # [bq, 1]
         s = _mm_f32(q_b, k_blk, transpose_b=True) * scale  # [bq, bk]
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _causal_mask(s, qb * block_q, ki * block_k)
         p = jnp.exp(jnp.minimum(s - lse_b, 30.0))  # [bq, bk]; see dq kernel
         dv_cur = dv_prev + _mm_f32(p.astype(do_b.dtype), do_b,
                                    transpose_a=True)
@@ -357,10 +615,12 @@ def _dispatch_fwd(q, k, v, causal, scale):
                 'PADDLE_TPU_FLASH_STRICT=1 but the Pallas flash kernel '
                 'cannot run: ' + reason)
         return _ref_bhnd(q, k, v, causal, scale), None
+    impl = _fwd_impl_long if _use_long_path(q.shape[2], k.shape[2]) \
+        else _fwd_impl
     if strict_mode():
-        return _fwd_impl(q, k, v, causal, scale)
+        return impl(q, k, v, causal, scale)
     try:
-        return _fwd_impl(q, k, v, causal, scale)
+        return impl(q, k, v, causal, scale)
     except Exception:
         return _ref_bhnd(q, k, v, causal, scale), None
 
@@ -378,10 +638,12 @@ def _bwd_rule(causal, scale, res, do):
             a, b, c, causal=True, scale=scale), q, k, v)
         return vjp(do)
     if lse is not None:
+        impl = _bwd_impl_long if _use_long_path(q.shape[2], k.shape[2]) \
+            else _bwd_impl
         if strict_mode():
-            return _bwd_impl(q, k, v, o, lse, do, causal, scale)
+            return impl(q, k, v, o, lse, do, causal, scale)
         try:
-            return _bwd_impl(q, k, v, o, lse, do, causal, scale)
+            return impl(q, k, v, o, lse, do, causal, scale)
         except Exception:
             pass
     # jnp fallback: recomputed reference backward (numerically exact)

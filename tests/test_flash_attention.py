@@ -168,3 +168,54 @@ def test_ring_flash_matches_jnp_ring(causal):
                                    rtol=2e-3, atol=2e-3,
                                    err_msg='grad %s causal=%s'
                                            % (name, causal))
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_long_path_forward_matches_reference(causal):
+    """The long-seq kernels (KV walk as a sequential grid dim + VMEM
+    scratch carry — the r5 fix for the 8k scoped-vmem OOM,
+    docs/bench_inwindow_r4.jsonl 11:58) vs the jnp reference. FORCE_LONG
+    exercises them at a CPU-interpretable size with multiple kv blocks."""
+    os.environ['PADDLE_TPU_FLASH_FORCE_LONG'] = '1'
+    try:
+        q, k, v = _mk(n=1024, d=64)
+        scale = 1.0 / np.sqrt(64)
+        out = fa.flash_attention_bhnd(q, k, v, causal=causal)
+        ref = fa._ref_bhnd(q, k, v, causal, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        os.environ.pop('PADDLE_TPU_FLASH_FORCE_LONG', None)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_long_path_backward_matches_reference(causal):
+    os.environ['PADDLE_TPU_FLASH_FORCE_LONG'] = '1'
+    try:
+        q, k, v = _mk(n=1024, d=64)
+        scale = 1.0 / np.sqrt(64)
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                fa.flash_attention_bhnd(q, k, v, causal=causal) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(fa._ref_bhnd(q, k, v, causal, scale) ** 2)
+
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for gf, gr, name in zip(g_flash, g_ref, 'qkv'):
+            np.testing.assert_allclose(
+                np.asarray(gf), np.asarray(gr), rtol=2e-4, atol=2e-4,
+                err_msg='long-path grad %s causal=%s' % (name, causal))
+    finally:
+        os.environ.pop('PADDLE_TPU_FLASH_FORCE_LONG', None)
+
+
+def test_long_path_auto_threshold():
+    """seq > PADDLE_TPU_FLASH_LONG_SEQ routes to the long kernels
+    automatically (the 8k bench rung path); short seqs keep the proven
+    short-seq kernels."""
+    assert not fa._use_long_path(512, 512)
+    assert fa._use_long_path(8192, 8192)
+    assert fa._use_long_path(512, 8192)

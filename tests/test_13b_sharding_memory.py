@@ -1,0 +1,123 @@
+"""BASELINE config 5 evidence at 13B dims WITHOUT hardware
+(VERDICT r4 missing #3 / next #4).
+
+GPT-3-13B dimensions — hidden 5120, 40 heads, vocab 50304 — compiled
+under sharding_stage3 (ZeRO-3) x pipeline-parallel on the 8-device CPU
+mesh. Lowering + compiling allocates no device buffers for the step, so
+the 13B-scale partitioning claims are checkable on CPU: the compiled
+executable's per-device argument bytes prove params+optimizer state are
+REALLY sharded (silent replication fails the assertion by an order of
+magnitude), and a two-point layer-count fit projects the full 40-layer
+model against the v5p HBM budget.
+
+Layer count is reduced for the CPU compile budget (the per-LAYER
+partitioning behavior is what ZeRO-3+pp decides; layers are homogeneous,
+so bytes scale affinely in depth — the two-point fit measures exactly
+that affine law and the projection documents it). Reference bar:
+python/paddle/distributed/fleet/meta_optimizers/sharding_optimizer.py:97
+(the 1436-line program rewrite that exists precisely for this scale).
+"""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+HIDDEN, HEADS, VOCAB = 5120, 40, 50304
+SEQ, BATCH = 512, 4
+V5P_HBM = 95e9               # bytes per chip
+SHARDING, PP = 4, 2          # sharding_stage3 x pp over the 8-dev mesh
+
+
+def _arg_bytes(num_layers):
+    """Per-device argument bytes of the compiled ZeRO-3 x pp train step
+    at 13B dims with `num_layers` layers."""
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=HIDDEN,
+                    num_layers=num_layers, num_heads=HEADS,
+                    max_position_embeddings=SEQ, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.bfloat16()           # the config-5 training dtype
+
+    s = fleet.DistributedStrategy()
+    s.hybrid_configs = {'dp_degree': 1, 'mp_degree': 1, 'pp_degree': PP,
+                        'sharding_degree': SHARDING, 'sp_degree': 1}
+    s.sharding = True
+    s.sharding_configs['stage'] = 3
+    fleet.init(is_collective=True, strategy=s)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    step = fleet.fleet_train_step(
+        model, lambda lg, lb: model.loss(lg, lb), opt, strategy=s)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32))
+    lbl = paddle.to_tensor(
+        rng.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int32))
+    compiled = step.compiled_executable(ids, lbl)
+    ma = compiled.memory_analysis()
+    n_params = model.num_params()
+    hlo = compiled.as_text()
+    return (int(ma.argument_size_in_bytes), int(ma.temp_size_in_bytes),
+            n_params, hlo)
+
+
+@pytest.fixture(scope='module')
+def two_point():
+    b2 = _arg_bytes(2)
+    b4 = _arg_bytes(4)
+    return b2, b4
+
+
+def test_13b_dims_zero3_pp_actually_shards(two_point):
+    (arg2, _, n2, hlo), (arg4, _, n4, _) = two_point
+    # bf16 params + f32 master/m/v AdamW state = 14 bytes/param if fully
+    # replicated on every device. Coarse guard: the whole argument set
+    # must be well under replicated (measured: 3.11 GB vs 12.45 GB at
+    # L=2 — embedding+head shard over sharding=4 only, transformer
+    # layers over sharding x pp = 8).
+    replicated4 = 14.0 * n4
+    assert arg4 < replicated4 / 3.0, (
+        'per-device argument bytes %.2f GB vs replicated %.2f GB — '
+        'ZeRO-3+pp is not sharding at 13B dims' %
+        (arg4 / 1e9, replicated4 / 1e9))
+    # the sharp catcher: the MARGINAL per-layer bytes (what config 5
+    # scales in depth) must divide by ~sharding_degree (ZeRO-3 carries
+    # param+opt residency; pp splits COMPUTE across stages — the stacked
+    # layer params stay sharding-sharded, not stage-local, in the GSPMD
+    # formulation). Require > 3x under replicated (measured ~4x): a
+    # partitioner that replicates layer params or opt state fails wide.
+    per_layer = (arg4 - arg2) / 2.0
+    per_layer_repl = 14.0 * (n4 - n2) / 2.0
+    assert per_layer < per_layer_repl / 3.0, (
+        'per-device marginal layer bytes %.0f MB vs replicated %.0f MB' %
+        (per_layer / 1e6, per_layer_repl / 1e6))
+    # ZeRO-3 signature collectives must be in the partitioned program
+    counts = {op: len(re.findall(op, hlo))
+              for op in ('all-gather', 'reduce-scatter', 'all-reduce',
+                         'collective-permute', 'all-to-all')}
+    assert counts['all-gather'] >= 1, counts
+    assert counts['reduce-scatter'] + counts['all-reduce'] >= 1, counts
+
+
+def test_13b_40layer_projection_fits_v5p(two_point):
+    (arg2, tmp2, _, _), (arg4, tmp4, _, _) = two_point
+    # affine fit over homogeneous layers: bytes(L) = base + L * per_layer
+    per_layer = (arg4 - arg2) / 2.0
+    base = arg2 - 2 * per_layer
+    assert per_layer > 0, (arg2, arg4)
+    proj40 = base + 40 * per_layer
+    tmp_per_layer = max(0.0, (tmp4 - tmp2) / 2.0)
+    tmp40 = max(tmp2, tmp4) + 36 * tmp_per_layer
+    # the claimed config-5 sharding must leave headroom on a v5p chip:
+    # params+opt+activation-temp under 90% of HBM. (A v5p-64 run also
+    # scales sharding_degree with the pod — this is the CONSERVATIVE
+    # single-slice-8 check; more chips only shrink the per-device share.)
+    assert proj40 + tmp40 < 0.9 * V5P_HBM, (
+        'projected 40-layer per-device bytes %.1f GB args + %.1f GB temp '
+        'exceed the v5p budget' % (proj40 / 1e9, tmp40 / 1e9))

@@ -2,13 +2,16 @@
 bench.py's single-line contract does not cover:
 
   config 2 — ResNet-50 train throughput (images/sec), @to_static -> XLA
-  config 4 — YOLO-family inference latency through AnalysisPredictor
+  config 4 — YOLO-family inference latency/QPS through AnalysisPredictor
+  (plus)   — GPT decode tokens/sec through the single-dispatch scan path
 
 Prints one JSON line per config. Safe anywhere: CPU runs are tagged
 degraded (tiny shapes); TPU runs use the real config. Not invoked by the
-driver — evidence harness for manual runs (python bench_extra.py).
+driver — evidence harness for the warmer and manual runs
+(python bench_extra.py).
 """
 import json
+import statistics
 import time
 
 import numpy as np
@@ -17,6 +20,13 @@ import numpy as np
 def _platform():
     import jax
     return jax.devices()[0].platform
+
+
+def _enable_cache():
+    # same repo-local persistent XLA cache bench.py children use: every
+    # executable compiled in an up-window is a warm artifact later
+    import bench
+    bench._enable_persistent_cache()
 
 
 def bench_resnet(on_tpu):
@@ -58,6 +68,15 @@ def bench_resnet(on_tpu):
 
 
 def bench_yolo_infer(on_tpu):
+    """Config 4: PP-YOLOv2 inference, batch 1 AND 8, median-of-repeats.
+
+    Round-4 single-run captures varied 1.5x (205.9 vs 140.2 ms same
+    config) — each batch size now reports the median of `reps` timed
+    passes plus the spread, so a noisy relay shows up as spread instead
+    of silently biasing the number. Budget (docs/PERF_NOTES_r5.md): the
+    v5e roofline for this graph is ~10 ms/img; <50 ms/img batch-1 is the
+    pass bar, QPS scales with batch.
+    """
     import paddle_tpu as paddle
     from paddle_tpu.vision.models.yolo import ppyolov2
     paddle.seed(0)
@@ -76,22 +95,44 @@ def bench_yolo_infer(on_tpu):
                                  training=False)
         return out
     jfwd = jax.jit(fwd)
-    img = np.random.RandomState(0).rand(1, 3, size, size).astype(np.float32)
-    out = jfwd(params, buffers, img)
-    jax.block_until_ready(out)
-    n = 20 if on_tpu else 2
-    t0 = time.time()
-    for _ in range(n):
-        out = jfwd(params, buffers, img)
-    _ = np.asarray(jax.tree_util.tree_leaves(out)[0])
-    dt = (time.time() - t0) / n
-    return {'metric': 'yolo_infer_latency_ms', 'value': round(dt * 1e3, 2),
-            'unit': 'ms', 'image_size': size, 'degraded': not on_tpu}
+    rows = []
+    for batch in ((1, 8) if on_tpu else (1,)):
+        img = np.random.RandomState(0).rand(
+            batch, 3, size, size).astype(np.float32)
+        out = jfwd(params, buffers, img)    # compile
+        _ = np.asarray(jax.tree_util.tree_leaves(out)[0])
+        n = 10 if on_tpu else 2
+        reps = 3 if on_tpu else 1
+        per_rep = []
+        for _ in range(reps):
+            t0 = time.time()
+            for _ in range(n):
+                out = jfwd(params, buffers, img)
+            _ = np.asarray(jax.tree_util.tree_leaves(out)[0])
+            per_rep.append((time.time() - t0) / n)
+        med = statistics.median(per_rep)
+        rows.append({'metric': 'yolo_infer_latency_ms',
+                     'value': round(med * 1e3 / batch, 2), 'unit': 'ms/img',
+                     'batch': batch,
+                     'batch_latency_ms': round(med * 1e3, 2),
+                     'qps': round(batch / med, 2),
+                     'spread_ms': round((max(per_rep) - min(per_rep)) * 1e3,
+                                        2),
+                     'reps': reps, 'image_size': size,
+                     'degraded': not on_tpu})
+    return rows
 
 
 def bench_gpt_decode(on_tpu):
-    """Autoregressive decode throughput (tokens/sec) through the jitted
-    static-cache step (GPTForCausalLM.generate)."""
+    """Autoregressive decode throughput (tokens/sec) through the
+    single-dispatch scan decode (GPTForCausalLM.generate: jitted prefill
+    + ONE lax.scan program — reference serving path analog:
+    AnalysisPredictor, analysis_predictor.cc:381).
+
+    Reports the HBM roofline alongside: cached decode is weight-bound —
+    each token step must stream the bf16 weights once, so
+    steps/s <= HBM_BW / param_bytes, tokens/s <= batch * that.
+    """
     import paddle_tpu as paddle
     from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
 
@@ -120,18 +161,31 @@ def bench_gpt_decode(on_tpu):
     out = model.generate(prompt, max_new_tokens=new_tokens)
     _ = out.numpy()
     dt = time.time() - t0
+    toks = batch * new_tokens / dt
+    param_bytes = 2.0 * model.num_params()          # bf16 weights
+    hbm = 819e9 if on_tpu else 50e9                 # v5e HBM BW
+    roofline = batch * hbm / param_bytes
     return {'metric': 'gpt_decode_tokens_per_sec',
-            'value': round(batch * new_tokens / dt, 2),
+            'value': round(toks, 2),
             'unit': 'tokens/sec', 'batch': batch,
+            'tokens_per_sec_per_seq': round(toks / batch, 2),
+            'roofline_tokens_per_sec': round(roofline, 0),
+            'roofline_frac': round(toks / roofline, 4),
             'prompt_len': prompt_len, 'new_tokens': new_tokens,
             'degraded': not on_tpu}
 
 
 def main():
+    try:
+        _enable_cache()
+    except Exception:
+        pass
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode):
         try:
-            print(json.dumps(fn(on_tpu)))
+            res = fn(on_tpu)
+            for row in (res if isinstance(res, list) else [res]):
+                print(json.dumps(row))
         except Exception as e:  # never die half-way
             print(json.dumps({'metric': fn.__name__, 'error': repr(e)[:300]}))
 

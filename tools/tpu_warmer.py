@@ -1,21 +1,30 @@
 #!/usr/bin/env python
-"""Opportunistic TPU bench runner + compile-cache warmer.
+"""Opportunistic TPU bench runner + compile-cache warmer (round 5).
 
 The axon TPU pool wedges for hours at a time (memory: every backend touch
 must live in a child process with a hard timeout). This script is invoked
 by the probe loop (tools/tpu_probe.sh) the moment a probe sees the pool
 up. It then:
 
-1. runs the SAME bench.py child configs the driver's end-of-round bench
-   ladder uses — with the repo-local persistent compilation cache enabled
-   (bench.py `_enable_persistent_cache`), so every XLA executable compiled
-   in this up-window is a warm artifact for the driver's later run even if
-   the pool wedges again in between;
-2. records every result (+ timestamp + config label) to
-   docs/bench_inwindow_r4.jsonl for PERF_NOTES;
-3. compares configs (scan-K device loop vs single dispatch, flash vs
-   blockwise vs quadratic attention) so the ladder ordering in bench.py
-   can be tuned from data.
+1. snapshots the repo at HEAD into /tmp and runs every bench child from
+   the snapshot — a half-edited working tree can no longer poison a
+   window (r4 lost a rung to a mid-edit import error), and every number
+   is attributable to a commit (recorded as `git_rev`);
+2. runs the SAME bench.py child configs the driver's end-of-round bench
+   ladder uses — with the repo-local persistent compilation cache
+   (PADDLE_TPU_CACHE_DIR pins it to the REAL repo's .jax_cache), so every
+   XLA executable compiled in this up-window is a warm artifact for the
+   driver's later run even if the pool wedges again in between;
+3. records every result (+ timestamp + config label) to
+   docs/bench_inwindow_r5.jsonl in the real repo;
+4. re-runs the first successful rung as a CANARY every few rungs and at
+   window end: if a canary reads >15% below the window's reference
+   canary, every sample since the last good canary is rewritten with
+   `suspect: true` — a mid-window pool degradation can no longer leave
+   plausible-but-throttled numbers unmarked (the r4 12:06 problem);
+5. runs bench_extra (ResNet / YOLO batch-1+8 / scan decode) EARLY —
+   BASELINE configs 2 and 4 have the thinnest evidence, so they must not
+   be the first casualties of a short window.
 
 A lockfile serializes warmers (probe fires every ~3 min; a warm run takes
 longer). Never touches the backend in-process.
@@ -23,25 +32,28 @@ longer). Never touches the backend in-process.
 import fcntl
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BENCH = os.path.join(REPO, 'bench.py')
 OUT = os.environ.get(
     'PADDLE_TPU_BENCH_INWINDOW_LOG',
-    os.path.join(REPO, 'docs', 'bench_inwindow_r4.jsonl'))
+    os.path.join(REPO, 'docs', 'bench_inwindow_r5.jsonl'))
 LOCK = '/tmp/tpu_warmer.lock'
+SNAP = '/tmp/paddle_tpu_warm_snapshot'
 
-# config ladder: label -> extra env. Ordered so the most valuable
-# measurement (the expected driver rung) lands first in case the window
-# closes mid-run.
-CONFIGS = [
-    # round-4 session-3 ladder: the fused head+CE lever (ops/fused_ce.py)
-    # first — it is the one unmeasured-on-TPU change; everything after
-    # re-captures the proven rungs. bench.py defaults PADDLE_TPU_FUSED_CE
-    # on, so the non-fused rungs set it to '0' explicitly.
+CANARY_DRIFT = 0.15      # >15% below the window reference => suspect
+CANARY_EVERY = 4         # re-run the canary after every N ladder rungs
+
+# config ladder: label -> extra env, grouped in priority phases.
+# Phase A: the round-5 headline shot — fused head+CE x flash x
+# native-dtype matmuls, never yet measured on TPU (projection ~54%
+# 6N-MFU, docs/PERF_NOTES_r4.md). Phase B: BASELINE configs 2/4 + decode
+# via bench_extra. Phase C: fallbacks, sweeps, long-context.
+PHASE_A = [
     ('fused_flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
     ('fused_flash_plain', {}),
     ('flash_scan8', {'PADDLE_TPU_FUSED_CE': '0',
@@ -49,25 +61,46 @@ CONFIGS = [
     ('fused_flash_disabled_scan8', {'PADDLE_TPU_FLASH_DISABLE': '1',
                                     'PADDLE_TPU_FLASH_STRICT': '0',
                                     'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+]
+PHASE_C = [
     ('fused_flash_scan8_b64', {'PADDLE_TPU_BENCH_BATCH': '64',
                                'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
     ('fused_ce_chunk2048_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                   'PADDLE_TPU_FUSED_CE_CHUNK': '2048'}),
     ('fused_ce_chunk8192_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                   'PADDLE_TPU_FUSED_CE_CHUNK': '8192'}),
-    # long-context with the full stack: flash + fused CE
+    # long-context ladder: 2k/4k/8k with the full stack; each seq also
+    # gets the pure-XLA blockwise fallback rung so a flash limit at that
+    # scale still yields an honest measured number (VERDICT r4 #5)
     ('fused_flash_seq2048_b8_scan4', {'PADDLE_TPU_BENCH_SEQ': '2048',
                                       'PADDLE_TPU_BENCH_BATCH': '8',
                                       'PADDLE_TPU_BENCH_SCAN_STEPS': '4'}),
+    ('fused_flash_seq4096_b4_scan2', {'PADDLE_TPU_BENCH_SEQ': '4096',
+                                      'PADDLE_TPU_BENCH_BATCH': '4',
+                                      'PADDLE_TPU_BENCH_SCAN_STEPS': '2'}),
     ('fused_flash_seq8192_b2_scan2', {'PADDLE_TPU_BENCH_SEQ': '8192',
                                       'PADDLE_TPU_BENCH_BATCH': '2',
                                       'PADDLE_TPU_BENCH_SCAN_STEPS': '2'}),
+    ('fused_blockwise_seq8192_b2_scan2', {
+        'PADDLE_TPU_BENCH_SEQ': '8192',
+        'PADDLE_TPU_BENCH_BATCH': '2',
+        'PADDLE_TPU_BENCH_SCAN_STEPS': '2',
+        'PADDLE_TPU_FLASH_DISABLE': '1',
+        'PADDLE_TPU_FLASH_STRICT': '0',
+        'PADDLE_TPU_ATTN_IMPL': 'blockwise'}),
+    ('fused_blockwise_seq4096_b4_scan2', {
+        'PADDLE_TPU_BENCH_SEQ': '4096',
+        'PADDLE_TPU_BENCH_BATCH': '4',
+        'PADDLE_TPU_BENCH_SCAN_STEPS': '2',
+        'PADDLE_TPU_FLASH_DISABLE': '1',
+        'PADDLE_TPU_FLASH_STRICT': '0',
+        'PADDLE_TPU_ATTN_IMPL': 'blockwise'}),
     # A/B: last-axis qkv split (layout-copy hypothesis from the r4
     # profile — ~5 ms/step of [b,n,3,h,d] copies on the default path)
     ('fused_flash_scan8_qkvlast', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                    'PADDLE_TPU_QKV_SPLIT': 'last'}),
-    # the remaining driver-ladder fallback rungs (bench.py): warm their
-    # caches too, and keep refreshing r4's best plain capture
+    # remaining driver-ladder fallback rungs: warm their caches and keep
+    # refreshing r4's best plain capture
     ('flash_plain', {'PADDLE_TPU_FUSED_CE': '0'}),
     ('flash_disabled_plain', {'PADDLE_TPU_FUSED_CE': '0',
                               'PADDLE_TPU_FLASH_DISABLE': '1',
@@ -90,6 +123,79 @@ def log(msg):
         f.write(line + '\n')
 
 
+def _snapshot_repo():
+    """Export HEAD into SNAP; return (snap_dir, rev) or (REPO, None)."""
+    try:
+        rev = subprocess.run(['git', '-C', REPO, 'rev-parse', '--short',
+                              'HEAD'], capture_output=True, text=True,
+                             timeout=30).stdout.strip()
+        if os.path.isdir(SNAP):
+            shutil.rmtree(SNAP)
+        os.makedirs(SNAP)
+        ar = subprocess.run(['git', '-C', REPO, 'archive', 'HEAD'],
+                            capture_output=True, timeout=120)
+        if ar.returncode != 0:
+            raise RuntimeError(ar.stderr[-200:])
+        subprocess.run(['tar', '-x', '-C', SNAP], input=ar.stdout,
+                       timeout=120, check=True)
+        return SNAP, rev
+    except Exception as e:
+        log('snapshot failed (%r) — running from the live tree' % (e,))
+        return REPO, None
+
+
+class Recorder(object):
+    """Append jsonl entries; support retro-tagging a line range."""
+
+    def __init__(self, path):
+        self.path = path
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self.lines = []          # indexes (in this run) -> file line no
+        with open(path, 'a'):
+            pass
+        with open(path) as f:
+            self.base = sum(1 for _ in f)
+
+    def record(self, label, result, err, wall, rev=None):
+        entry = {'ts': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+                 'label': label, 'wall_s': round(wall, 1)}
+        if rev:
+            entry['git_rev'] = rev
+        if result is not None:
+            entry.update(result)
+        else:
+            entry['error'] = err
+        with open(self.path, 'a') as f:
+            f.write(json.dumps(entry) + '\n')
+        self.lines.append(self.base + len(self.lines))
+        return len(self.lines) - 1
+
+    def mark_suspect(self, first_idx, reason, end_idx=None):
+        """Rewrite rows [first_idx:end_idx) of THIS run with
+        suspect: true (end_idx None = through the latest row)."""
+        if end_idx is None:
+            end_idx = len(self.lines)
+        tag = [self.lines[i] for i in range(first_idx, end_idx)]
+        if not tag:
+            return
+        with open(self.path) as f:
+            rows = f.readlines()
+        for ln in tag:
+            if ln >= len(rows):
+                continue
+            try:
+                e = json.loads(rows[ln])
+            except ValueError:
+                continue
+            e['suspect'] = True
+            e['suspect_reason'] = reason
+            rows[ln] = json.dumps(e) + '\n'
+        tmp = self.path + '.tmp'
+        with open(tmp, 'w') as f:
+            f.writelines(rows)
+        os.replace(tmp, self.path)
+
+
 def _json_lines(stdout):
     out = []
     for line in (stdout or '').strip().splitlines():
@@ -102,33 +208,24 @@ def _json_lines(stdout):
     return out
 
 
-def run_child(label, extra_env, timeout=1500):
+def run_child(script, extra_env, timeout=1500, snap=REPO):
     env = dict(os.environ)
     env['PADDLE_TPU_BENCH_CHILD'] = '1'
+    # the cache must live in the REAL repo so later driver runs hit it
+    env.setdefault('PADDLE_TPU_CACHE_DIR', os.path.join(REPO, '.jax_cache'))
     env.update(extra_env)
     t0 = time.time()
     try:
-        proc = subprocess.run([sys.executable, BENCH], capture_output=True,
-                              text=True, env=env, timeout=timeout)
+        proc = subprocess.run([sys.executable, os.path.join(snap, script)],
+                              capture_output=True, text=True, env=env,
+                              timeout=timeout, cwd=snap)
     except subprocess.TimeoutExpired:
         return None, 'timeout>%ds' % timeout, time.time() - t0
     entries = _json_lines(proc.stdout)
     if entries:
-        return entries[-1], None, time.time() - t0
+        return entries, None, time.time() - t0
     return None, 'rc=%d: %s' % (proc.returncode,
                                 (proc.stderr or '')[-300:]), time.time() - t0
-
-
-def record(label, result, err, wall):
-    os.makedirs(os.path.dirname(OUT), exist_ok=True)
-    entry = {'ts': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
-             'label': label, 'wall_s': round(wall, 1)}
-    if result is not None:
-        entry.update(result)
-    else:
-        entry['error'] = err
-    with open(OUT, 'a') as f:
-        f.write(json.dumps(entry) + '\n')
 
 
 def probe_tpu(timeout=90):
@@ -138,6 +235,163 @@ def probe_tpu(timeout=90):
                               timeout=timeout).returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+class Warmer(object):
+    def __init__(self):
+        self.snap, self.rev = _snapshot_repo()
+        self.rec = Recorder(OUT)
+        self.best = None           # (label, result, extra)
+        self.canary = None         # (label, extra)
+        self.canary_ref = None     # reference mfu for drift checks
+        self.last_good_idx = 0     # first row index not yet vouched
+        self.tainted = False       # a drifted/failed canary with no
+        #                            healthy canary since: nothing after
+        #                            it may be vouched retroactively
+        self.rungs_since_canary = 0
+
+    def bench_rung(self, label, extra, timeout=1500):
+        entries, err, wall = run_child('bench.py', extra, timeout,
+                                       self.snap)
+        result = entries[-1] if entries else None
+        idx = self.rec.record(label, result, err, wall, self.rev)
+        if result is not None:
+            log('%s: %.1fms/step mfu=%.4f (%.0fs)' % (
+                label, result.get('step_ms', -1), result.get('mfu', 0),
+                wall))
+            if self.best is None or result.get('mfu_6n', 0) > \
+                    self.best[1].get('mfu_6n', 0):
+                self.best = (label, result, extra)
+        else:
+            log('%s: FAILED %s (%.0fs)' % (label, err, wall))
+        return result, idx
+
+    def maybe_canary(self, force=False):
+        """Re-run the reference rung; retro-tag on drift."""
+        if self.canary is None:
+            return True
+        self.rungs_since_canary += 1
+        if not force and self.rungs_since_canary < CANARY_EVERY:
+            return True
+        self.rungs_since_canary = 0
+        label, extra = self.canary
+        result, idx = self.bench_rung('canary_' + label, extra)
+        if result is None:
+            # a failed canary is itself a strong degradation signal
+            self.rec.mark_suspect(self.last_good_idx,
+                                  'canary %s failed' % label)
+            self.tainted = True
+            return False
+        mfu = result.get('mfu_6n', 0)
+        if self.canary_ref and mfu < (1 - CANARY_DRIFT) * self.canary_ref:
+            reason = 'canary %.4f < %.4f ref -15%%' % (mfu, self.canary_ref)
+            log('CANARY DRIFT: ' + reason)
+            self.rec.mark_suspect(self.last_good_idx, reason)
+            self.tainted = True
+            return False
+        if self.tainted:
+            # a drift happened since the last healthy canary: rows
+            # measured in between sit next to a confirmed-throttled
+            # reading and can NOT be vouched retroactively — tag them
+            # (idempotent for already-tagged rows), excluding this
+            # healthy canary row itself
+            self.rec.mark_suspect(self.last_good_idx,
+                                  'between drifted and healthy canary',
+                                  end_idx=idx)
+            self.tainted = False
+        # window healthy from here: later rows vouch against this point
+        self.last_good_idx = idx + 1
+        return True
+
+    def run(self):
+        log('TPU up — warming (rev %s)' % (self.rev or 'dirty-tree'))
+        # Phase A: headline rungs; the first success becomes the canary
+        for label, extra in PHASE_A:
+            result, idx = self.bench_rung(label, extra)
+            if result is not None and self.canary is None:
+                self.canary = (label, extra)
+                self.canary_ref = result.get('mfu_6n', 0)
+                self.last_good_idx = idx + 1
+            if result is None and not probe_tpu():
+                log('pool went down mid-window; stopping')
+                return
+        # Phase B: BASELINE configs 2/4 + decode (thinnest evidence) —
+        # behind a fresh probe: a wedged pool must cost a 90s probe, not
+        # the 1800s bench_extra child timeout
+        if probe_tpu():
+            self.extras()
+        else:
+            log('pool went down before extras; stopping')
+            return
+        if not self.maybe_canary(force=True):
+            if not probe_tpu():
+                log('pool went down; stopping')
+                return
+        # Phase C: sweeps, long context, fallbacks
+        for label, extra in PHASE_C:
+            result, _ = self.bench_rung(label, extra)
+            if result is None and not probe_tpu():
+                log('pool went down mid-window; stopping')
+                return
+            if not self.maybe_canary() and not probe_tpu():
+                log('pool went down at canary; stopping')
+                return
+        self.profile_best()
+        # end-of-window canary: vouch for (or flag) the tail samples
+        self.maybe_canary(force=True)
+
+    def extras(self):
+        entries, err, wall = run_child('bench_extra.py', {}, 1800,
+                                       self.snap)
+        if entries is None:
+            self.rec.record('bench_extra', None, err, wall, self.rev)
+            log('bench_extra: %s' % err)
+            return
+        for entry in entries:
+            # wall covers the whole multi-config process; per-row timing
+            # is not observable from outside, so mark it shared
+            self.rec.record(entry.get('metric', 'bench_extra'),
+                            dict(entry, wall_shared=True), None, wall,
+                            self.rev)
+            log('extra %s: %s' % (entry.get('metric'), entry.get('value')))
+
+    def profile_best(self):
+        """Capture an on-chip profile of the best rung — the data that
+        tells WHERE the remaining MFU gap is, which no step-time number
+        can. Raw xplane blobs live under docs/tpu_profile_r5 (gitignored);
+        the committed evidence is the roofline summary text."""
+        if self.best is None or not probe_tpu():
+            return
+        label, _, extra = self.best
+        pdir = os.path.join(REPO, 'docs', 'tpu_profile_r5')
+        prof_env = dict(extra, PADDLE_TPU_BENCH_PROFILE=pdir,
+                        PADDLE_TPU_BENCH_STEPS='8',
+                        PADDLE_TPU_BENCH_WARMUP='4')
+        entries, err, wall = run_child('bench.py', prof_env, 1500,
+                                       self.snap)
+        result = entries[-1] if entries else None
+        self.rec.record('profile_' + label, result, err, wall, self.rev)
+        log('profile(%s): %s (%.0fs)' % (
+            label, 'ok -> %s' % pdir if result is not None else err, wall))
+        if result is None:
+            return
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, 'tools', 'profile_analysis.py'), pdir],
+                capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                log('profile summary failed rc=%d: %s'
+                    % (proc.returncode, (proc.stderr or '')[-300:]))
+            else:
+                out_path = os.path.join(REPO, 'docs',
+                                        'profile_summary_r5.txt')
+                with open(out_path, 'w') as f:
+                    f.write('rung: %s (rev %s)\n%s'
+                            % (label, self.rev, proc.stdout))
+                log('profile summary -> %s' % out_path)
+        except Exception as e:
+            log('profile summary failed: %r' % (e,))
 
 
 def main():
@@ -150,81 +404,7 @@ def main():
     if not probe_tpu():
         log('TPU not up at warmer start; exiting')
         return
-    log('TPU up — warming')
-    best = None
-    for label, extra in CONFIGS:
-        result, err, wall = run_child(label, extra)
-        record(label, result, err, wall)
-        if result is not None:
-            log('%s: %.1fms/step mfu=%.4f (%.0fs)' % (
-                label, result.get('step_ms', -1), result.get('mfu', 0),
-                wall))
-            if best is None or result.get('mfu', 0) > best[1].get('mfu', 0):
-                best = (label, result, extra)
-        else:
-            log('%s: FAILED %s (%.0fs)' % (label, err, wall))
-            # if the pool wedged mid-window, stop burning child timeouts
-            if not probe_tpu():
-                log('pool went down mid-window; stopping')
-                break
-    # window still open after the ladder: capture an on-chip profile of
-    # the best rung — the data that tells WHERE the remaining MFU gap is
-    # (XLA schedule vs attention vs dispatch), which no step-time number
-    # can. Written under docs/ so it survives for analysis.
-    if best is not None and probe_tpu():
-        label, _, extra = best
-        pdir = os.path.join(REPO, 'docs', 'tpu_profile_r4')
-        prof_env = dict(extra, PADDLE_TPU_BENCH_PROFILE=pdir,
-                        PADDLE_TPU_BENCH_STEPS='8',
-                        PADDLE_TPU_BENCH_WARMUP='4')
-        result, err, wall = run_child('profile_' + label, prof_env)
-        record('profile_' + label, result, err, wall)
-        log('profile(%s): %s (%.0fs)' % (
-            label, 'ok -> %s' % pdir if result is not None else err, wall))
-        if result is not None:
-            # self-documenting window: roofline summary of the fresh
-            # trace lands next to the profile for post-hoc analysis
-            try:
-                proc = subprocess.run(
-                    [sys.executable,
-                     os.path.join(REPO, 'tools', 'profile_analysis.py'),
-                     pdir], capture_output=True, text=True, timeout=120)
-                if proc.returncode != 0:
-                    log('profile summary failed rc=%d: %s'
-                        % (proc.returncode, (proc.stderr or '')[-300:]))
-                else:
-                    out_path = os.path.join(REPO, 'docs',
-                                            'profile_summary_r4.txt')
-                    with open(out_path, 'w') as f:
-                        f.write('rung: %s\n%s' % (label, proc.stdout))
-                    log('profile summary -> %s' % out_path)
-            except Exception as e:
-                log('profile summary failed: %r' % (e,))
-    # BASELINE configs 2/4 (ResNet train throughput, YOLO inference):
-    # bench_extra prints one JSON line per config
-    if probe_tpu():
-        t0 = time.time()
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(REPO, 'bench_extra.py')],
-                capture_output=True, text=True, timeout=1800)
-            entries = _json_lines(proc.stdout)
-            wall = time.time() - t0
-            if not entries:
-                record('bench_extra', None,
-                       'rc=%d: %s' % (proc.returncode,
-                                      (proc.stderr or '')[-300:]), wall)
-                log('bench_extra: no JSON output (rc=%d)' % proc.returncode)
-            for entry in entries:
-                # wall is the whole two-config process; per-row timing is
-                # not observable from outside, so mark it as shared
-                record(entry.get('metric', 'bench_extra'),
-                       dict(entry, wall_shared=True), None, wall)
-                log('extra %s: %s' % (entry.get('metric'),
-                                      entry.get('value')))
-        except subprocess.TimeoutExpired:
-            record('bench_extra', None, 'timeout>1800s', time.time() - t0)
-            log('bench_extra timed out')
+    Warmer().run()
     log('warmer done')
 
 

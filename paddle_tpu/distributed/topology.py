@@ -18,6 +18,20 @@ from jax.sharding import Mesh
 _AXES = ('dp', 'pp', 'sharding', 'ep', 'mp', 'sp')
 
 
+def _dcn_aware_order(devices):
+    """Order devices (slice_index, process_index, id) so the mesh reshape
+    keeps the INNER axes (mp/sp/ep/sharding/pp) inside one ICI slice and
+    only the outermost dp axis crosses DCN slice boundaries — the
+    TPU-native analog of the reference's NVLink-vs-IB multi-ring
+    hierarchy (nccl_helper.h:190 NCCLCommunicator). Single-slice and CPU
+    devices have no slice_index; the sort is then a stable no-op.
+    Full design: docs/dcn_multislice.md."""
+    return sorted(devices,
+                  key=lambda d: (getattr(d, 'slice_index', 0) or 0,
+                                 getattr(d, 'process_index', 0) or 0,
+                                 getattr(d, 'id', 0) or 0))
+
+
 class CommunicateTopology:
     def __init__(self, hybrid_group_names=('data', 'pipe', 'sharding', 'model'),
                  dims=(1, 1, 1, 1)):
@@ -50,7 +64,8 @@ class HybridCommunicateGroup:
 
     def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
                  sharding_degree=1, sp_degree=1, ep_degree=1, devices=None):
-        devices = devices if devices is not None else jax.devices()
+        if devices is None:
+            devices = _dcn_aware_order(jax.devices())
         n = len(devices)
         degrees = {'dp': dp_degree, 'pp': pp_degree,
                    'sharding': sharding_degree, 'mp': mp_degree,

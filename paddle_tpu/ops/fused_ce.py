@@ -48,7 +48,20 @@ def env_chunk_rows():
     larger transient logits tile. 4096 rows x 30k vocab bf16 = 250 MB —
     comfortably HBM-resident on any TPU generation.
     """
-    return int(os.environ.get('PADDLE_TPU_FUSED_CE_CHUNK', 4096))
+    raw = os.environ.get('PADDLE_TPU_FUSED_CE_CHUNK')
+    if raw is None:
+        return 4096
+    try:
+        val = int(raw)
+    except ValueError:
+        import warnings
+        warnings.warn('PADDLE_TPU_FUSED_CE_CHUNK=%r is not an integer; '
+                      'using the default 4096' % (raw,))
+        return 4096
+    if val < 1:
+        raise ValueError(
+            'PADDLE_TPU_FUSED_CE_CHUNK must be >= 1, got %d' % val)
+    return val
 
 
 def _chunk_plan(rows, chunk):

@@ -113,6 +113,14 @@ class BertForPretraining(nn.Layer):
         self.layer_norm = nn.LayerNorm(hidden)
         self.decoder = nn.Linear(hidden, vocab)
         self.seq_relationship = nn.Linear(hidden, 2)
+        # loss() tells hidden states from logits by the trailing dim —
+        # refuse the ambiguous vocab == hidden configuration up front
+        # (same contract as GPTConfig.fused_loss)
+        if fused_mlm and vocab == hidden:
+            raise ValueError(
+                'fused_mlm=True requires vocab_size != hidden_size '
+                '(loss() distinguishes hidden states from logits by '
+                'their trailing dimension); got both = %d' % vocab)
         self.fused_mlm = fused_mlm
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,

@@ -47,6 +47,15 @@ class GPTConfig:
         # fuses head matmul + CE via F.linear_cross_entropy, never
         # materializing [batch*seq, vocab] logits (ops/fused_ce.py).
         # Decode/generate paths (caches=...) still produce logits.
+        # loss() tells the two apart by the trailing dim, so the fusion
+        # is only safe when vocab and hidden differ — refuse the
+        # ambiguous configuration up front rather than misroute a real
+        # logits tensor into the fused head at runtime.
+        if fused_loss and vocab_size == hidden_size:
+            raise ValueError(
+                'fused_loss=True requires vocab_size != hidden_size '
+                '(loss() distinguishes hidden states from logits by '
+                'their trailing dimension); got both = %d' % vocab_size)
         self.fused_loss = fused_loss
 
     @staticmethod
@@ -135,11 +144,15 @@ class GPTAttention(nn.Layer):
         self.qkv_proj.weight.placement = (None, 'mp')
         self.qkv_proj.bias.placement = ('mp',)
         self.out_proj.weight.placement = ('mp', None)
+        # bench A/B knob, latched at construction: reading the env per
+        # forward call costs in eager mode and lets a mid-process env
+        # change mix layouts across traced vs eager executions
+        self._qkv_split_last = os.environ.get('PADDLE_TPU_QKV_SPLIT') == 'last'
 
     def forward(self, x, cache=None):
         b, n = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
-        if os.environ.get('PADDLE_TPU_QKV_SPLIT') == 'last':
+        if self._qkv_split_last:
             # experimental A/B (bench rung): slice the packed minor axis
             # at 128-aligned offsets instead of reshaping to 5-D and
             # slicing the middle axis. The round-4 profile shows
@@ -553,9 +566,16 @@ class GPTForCausalLM(nn.Layer):
         import numpy as np
         return int(sum(np.prod(p.shape) for p in self.parameters()))
 
-    def flops_per_token(self):
-        """Approximate fwd+bwd FLOPs/token (6N + attention quadratic term)."""
+    def flops_per_token(self, seq_len=None):
+        """Approximate fwd+bwd FLOPs/token (6N + attention quadratic term).
+
+        The quadratic term scales with the ACTUAL sequence length; pass it
+        explicitly when benching seq < max_position_embeddings, otherwise
+        the MFU computed from this is inflated.
+        """
         c = self.config
+        if seq_len is None:
+            seq_len = c.max_position_embeddings
         n_params = self.num_params()
-        attn = 12 * c.num_layers * c.hidden_size * c.max_position_embeddings
+        attn = 12 * c.num_layers * c.hidden_size * int(seq_len)
         return 6 * n_params + attn

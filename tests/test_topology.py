@@ -1,0 +1,46 @@
+"""Topology: DCN-aware device ordering (docs/dcn_multislice.md; the
+TPU-native analog of the reference's NVLink-vs-IB ring hierarchy,
+nccl_helper.h:190)."""
+import collections
+
+import numpy as np
+
+from paddle_tpu.distributed.topology import _AXES, _dcn_aware_order
+
+Stub = collections.namedtuple('Stub', ['slice_index', 'process_index', 'id'])
+
+
+def _stub_devices(n_slices=2, per_slice=4, shuffled_seed=7):
+    devs = [Stub(s, s, s * per_slice + i)
+            for s in range(n_slices) for i in range(per_slice)]
+    rng = np.random.RandomState(shuffled_seed)
+    order = rng.permutation(len(devs))
+    return [devs[i] for i in order]
+
+
+def test_dcn_aware_device_order():
+    """2 slices x 4 chips, dp outermost over slices: after ordering +
+    the topology reshape, every inner-axes block is slice-pure and only
+    dp groups mix slices."""
+    devs = _dcn_aware_order(_stub_devices())
+    # sorted: slice-major
+    assert [d.slice_index for d in devs] == [0] * 4 + [1] * 4
+    # the topology reshape: dp=2 outermost, mp=4 innermost
+    shape = {a: 1 for a in _AXES}
+    shape['dp'], shape['mp'] = 2, 4
+    arr = np.empty(len(devs), dtype=object)
+    arr[:] = devs
+    mesh = arr.reshape(tuple(shape[a] for a in _AXES))
+    # every mp group (fixed dp index) lives inside ONE slice => ICI
+    for dp in range(2):
+        grp = mesh[dp].reshape(-1)
+        assert len({d.slice_index for d in grp}) == 1, grp
+    # every dp group (fixed mp index) spans both slices => DCN, amortized
+    flat = mesh.reshape(2, 4)
+    for mp in range(4):
+        assert {d.slice_index for d in flat[:, mp]} == {0, 1}
+
+
+def test_single_slice_order_is_stable():
+    devs = [Stub(0, 0, i) for i in range(8)]
+    assert _dcn_aware_order(devs) == devs

@@ -294,6 +294,25 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
     elif sdict['recompute']:
         remat = True
 
+    # vocab-parallel fused CE (reference: c_softmax_with_cross_entropy,
+    # operators/collective/): under plain tensor parallelism constrain
+    # the fused-loss logits tiles to [rows over dp/sharding, vocab over
+    # mp] so GSPMD computes the CE vocab-parallel (local max/sum + small
+    # all-reduce) instead of gathering the vocab axis per device — the
+    # r4 HLO evidence showed gathered f32[rows, vocab] tiles dominating
+    # CE-region memory (769 -> 435 MB peak temp at BERT dims dp2 x mp4).
+    # Restricted to sp/pp == 1: under sp the flattened rows mix
+    # sp-sharded sequence, under pp the loss runs inside the pipeline
+    # engine — both have their own layouts.
+    fce_sharding = None
+    mshape = dict(hcg.mesh.shape)
+    if mshape.get('mp', 1) > 1 and mshape.get('sp', 1) <= 1 \
+            and mshape.get('pp', 1) <= 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rows = tuple(a for a in ('dp', 'sharding') if mshape.get(a, 1) > 1)
+        fce_sharding = NamedSharding(
+            hcg.mesh, P(rows if rows else None, 'mp'))
+
     cfg = strategy_mod.build_shardings(model, optimizer, hcg.mesh, sdict)
     strategy_mod.place_params(model, cfg['param_shardings'])
     strategy_mod.place_opt_slots(model, optimizer, cfg['out_shardings'][2])
@@ -310,7 +329,8 @@ def fleet_train_step(model, loss_fn, optimizer, strategy=None, hcg=None):
         sp_state=sp_state,
         pp_state=pp_state,
         init_loss_scaling=s.amp_configs.get('init_loss_scaling', 65536.0),
-        ls_growth_interval=s.amp_configs.get('incr_every_n_steps', 2000))
+        ls_growth_interval=s.amp_configs.get('incr_every_n_steps', 2000),
+        fce_sharding=fce_sharding)
     return step
 
 

@@ -169,12 +169,15 @@ class TrainStep:
                  batch_sharding=None, grad_sync=None, k_steps=1,
                  grad_merge_avg=True, amp_dtype=None, remat=False,
                  sp_state=None, pp_state=None, init_loss_scaling=65536.0,
-                 ls_growth_interval=2000):
+                 ls_growth_interval=2000, fce_sharding=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self._jitted = None
         self._mesh = mesh
+        # vocab-parallel fused-CE constraint (ops/fused_ce.logits_sharding),
+        # entered around every trace/step by _sp_scope
+        self._fce_sharding = fce_sharding
         self._in_shardings = in_shardings
         self._out_shardings = out_shardings
         self._batch_sharding = batch_sharding
@@ -507,6 +510,12 @@ class TrainStep:
         if self._pp_state is not None:
             from ..distributed.pipeline import pp_scope
             stack.enter_context(pp_scope(self._pp_state))
+        fce = self._fce_sharding
+        if fce is not None:
+            # vocab-parallel fused CE under tensor parallelism: constrain
+            # the transient logits tiles (set by fleet_train_step)
+            from ..ops.fused_ce import logits_sharding
+            stack.enter_context(logits_sharding(fce))
         return stack
 
     def trace_jaxpr(self, inputs, labels):

@@ -35,9 +35,44 @@ import os
 import jax
 import jax.numpy as jnp
 
-__all__ = ['linear_cross_entropy_arrays', 'env_chunk_rows']
+__all__ = ['linear_cross_entropy_arrays', 'env_chunk_rows',
+           'logits_sharding']
 
 _MAX_CHUNKS = 64
+
+# Vocab-parallel hint (reference: the c_softmax_with_cross_entropy
+# vocab-PARALLEL collective op). Under tensor parallelism GSPMD's cost
+# model prefers gathering the vocab axis for the CE region over
+# vocab-parallel local reductions + a small all-reduce
+# (test_hlo_collectives documents the r4 behavior). When a strategy
+# enters `logits_sharding(s)` around the step trace, every transient
+# logits tile is constrained to `s` ([rows-axes, 'mp']), which forces
+# the partitioner onto the vocab-parallel plan. Scoped, not global: a
+# sharding baked into an eval trace on a different mesh would be wrong.
+_LOGITS_SHARDING = [None]
+
+
+class logits_sharding:
+    """Context manager: constrain fused-CE logits tiles to `sharding`."""
+
+    def __init__(self, sharding):
+        self.sharding = sharding
+
+    def __enter__(self):
+        self._prev = _LOGITS_SHARDING[0]
+        _LOGITS_SHARDING[0] = self.sharding
+        return self
+
+    def __exit__(self, *exc):
+        _LOGITS_SHARDING[0] = self._prev
+        return False
+
+
+def _maybe_constrain(af):
+    s = _LOGITS_SHARDING[0]
+    if s is None:
+        return af
+    return jax.lax.with_sharding_constraint(af, s)
 
 
 def env_chunk_rows():
@@ -90,7 +125,7 @@ def _tile_logits(xc, w, bias):
     logits = jnp.matmul(xc, w)
     if bias is not None:
         logits = logits + bias
-    return logits.astype(jnp.float32)
+    return _maybe_constrain(logits.astype(jnp.float32))
 
 
 def _label_onehot(safe, shape):
@@ -121,10 +156,19 @@ def _lce_fwd(x, w, labels, bias, ignore_index, chunk):
     v = w.shape[1]
     chunk, n, rows_p = _chunk_plan(rows, chunk)
     xp, lp = _pad_rows(x, labels, rows_p, ignore_index)
+    # STRIDED chunking (chunk i = rows i, i+n, i+2n, ...): under data
+    # parallelism the flattened row axis is dp-sharded contiguously, so
+    # contiguous chunks would each live on ONE dp group — every chunk
+    # would either run on a fraction of the devices or force a per-chunk
+    # redistribution. Strided chunks hit every dp shard evenly. Rows are
+    # independent in CE, so order only matters for the final stitch
+    # (the [chunk, n] stack below mirrors the reshape here).
+    x3 = xp.reshape(chunk, n, -1)
+    l2 = lp.reshape(chunk, n)
     lse_parts, picked_parts = [], []
     for i in range(n):
-        xc = jax.lax.slice_in_dim(xp, i * chunk, (i + 1) * chunk)
-        lc = jax.lax.slice_in_dim(lp, i * chunk, (i + 1) * chunk)
+        xc = x3[:, i, :]
+        lc = l2[:, i]
         af = _tile_logits(xc, w, bias)
         m = af.max(axis=-1)
         lse = m + jnp.log(jnp.sum(jnp.exp(af - m[:, None]), axis=-1))
@@ -139,8 +183,8 @@ def _lce_fwd(x, w, labels, bias, ignore_index, chunk):
                                    af, 0.0), axis=-1)
         lse_parts.append(lse)
         picked_parts.append(picked)
-    lse = jnp.concatenate(lse_parts)
-    picked = jnp.concatenate(picked_parts)
+    lse = jnp.stack(lse_parts, axis=1).reshape(rows_p)
+    picked = jnp.stack(picked_parts, axis=1).reshape(rows_p)
     valid = lp != ignore_index
     per_row = jnp.where(valid, lse - picked, 0.0)
     denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
@@ -159,10 +203,14 @@ def _lce_bwd(ignore_index, chunk, res, g):
     dx_parts = []
     dw = jnp.zeros((d, v), jnp.float32)
     db = jnp.zeros((v,), jnp.float32) if bias is not None else None
+    # same strided chunk layout as the forward (see _lce_fwd)
+    x3 = xp.reshape(chunk, n, d)
+    l2 = lp.reshape(chunk, n)
+    lse2 = lse.reshape(chunk, n)
     for i in range(n):
-        xc = jax.lax.slice_in_dim(xp, i * chunk, (i + 1) * chunk)
-        lc = jax.lax.slice_in_dim(lp, i * chunk, (i + 1) * chunk)
-        lse_c = jax.lax.slice_in_dim(lse, i * chunk, (i + 1) * chunk)
+        xc = x3[:, i, :]
+        lc = l2[:, i]
+        lse_c = lse2[:, i]
         af = _tile_logits(xc, w, bias)
         p = jnp.exp(af - lse_c[:, None])
         valid = lc != ignore_index
@@ -179,7 +227,7 @@ def _lce_bwd(ignore_index, chunk, res, g):
         dw = dw + jnp.matmul(xc.T, pc, preferred_element_type=jnp.float32)
         if db is not None:
             db = db + p.sum(axis=0)
-    dx = jnp.concatenate(dx_parts)[:rows]
+    dx = jnp.stack(dx_parts, axis=1).reshape(rows_p, d)[:rows]
     dlabels = jnp.zeros(labels.shape, jax.dtypes.float0)
     return (dx, dw.astype(w.dtype), dlabels,
             None if bias is None else db.astype(bias.dtype))

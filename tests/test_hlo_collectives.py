@@ -133,18 +133,18 @@ def test_dp_only_grad_allreduce_present():
 
 
 def test_fused_loss_dp_mp_memory_and_collectives():
-    """fused_loss at BERT-base dims under dp2 x mp4.
+    """fused_loss at BERT-base dims under dp2 x mp4 runs VOCAB-PARALLEL.
 
-    Measured behavior (2026-07): GSPMD gathers the vocab dimension for
-    the CE region in BOTH the plain and the fused path (f32[2048,30522]
-    tiles appear per device) — the partitioner's cost model prefers
-    replicated-vocab compute over vocab-parallel reductions here. The
-    single-device no-full-logits guarantee is locked by
-    test_fused_ce.py::test_fused_step_program_has_no_full_logits; THIS
-    test pins the multi-chip contract on the honest metric: the dp/mp
-    collectives are present, rows stay dp-sharded, and the fused
-    executable's peak TEMP memory is strictly below the plain one's
-    (measured ~769 MB vs ~1011 MB)."""
+    r4 measured GSPMD gathering the vocab dimension for the CE region
+    (f32[2048,30522] tiles per device — the cost model preferred
+    replicated-vocab compute). Since r5, fleet_train_step constrains the
+    fused logits tiles to [rows@dp, vocab@mp]
+    (ops/fused_ce.logits_sharding — the c_softmax_with_cross_entropy
+    vocab-parallel pattern), which this test pins: NO per-device
+    full-vocab f32 tile may appear anywhere in the fused program, the
+    dp/mp collectives are present, and peak TEMP memory is strictly
+    below the plain path's (measured 435 MB vs ~1011 MB; the unhinted
+    fused path was 769 MB)."""
     ids, lbl = _batch()
 
     def build(fused):
@@ -157,6 +157,14 @@ def test_fused_loss_dp_mp_memory_and_collectives():
         rows = ids.shape[0] * SEQ
         assert not re.search(r'\[%d,%d\]' % (rows, VOCAB), hlo), \
             'replicated-rows full logits'
+        if fused:
+            assert step._fce_sharding is not None
+            # any rank, vocab as the minor dim: a rank-3 gather
+            # (f32[2,2048,30522]) must fail this too
+            full_vocab = re.findall(r'f32\[[0-9,]+,%d\]' % VOCAB, hlo)
+            assert not full_vocab, (
+                'vocab axis gathered in the fused CE region: '
+                '%s' % sorted(set(full_vocab)))
         return compiled.memory_analysis().temp_size_in_bytes
 
     fused_tmp = build(True)

@@ -47,9 +47,14 @@ _MAX_CHUNKS = 64
 # (test_hlo_collectives documents the r4 behavior). When a strategy
 # enters `logits_sharding(s)` around the step trace, every transient
 # logits tile is constrained to `s` ([rows-axes, 'mp']), which forces
-# the partitioner onto the vocab-parallel plan. Scoped, not global: a
-# sharding baked into an eval trace on a different mesh would be wrong.
-_LOGITS_SHARDING = [None]
+# the partitioner onto the vocab-parallel plan. A ContextVar, not a
+# module global: concurrent traces (a hinted train step and an
+# unhinted eval step on another thread) must not see each other's
+# sharding — a wrong-mesh constraint is a trace error at best.
+import contextvars
+
+_LOGITS_SHARDING = contextvars.ContextVar('fused_ce_logits_sharding',
+                                          default=None)
 
 
 class logits_sharding:
@@ -59,17 +64,16 @@ class logits_sharding:
         self.sharding = sharding
 
     def __enter__(self):
-        self._prev = _LOGITS_SHARDING[0]
-        _LOGITS_SHARDING[0] = self.sharding
+        self._token = _LOGITS_SHARDING.set(self.sharding)
         return self
 
     def __exit__(self, *exc):
-        _LOGITS_SHARDING[0] = self._prev
+        _LOGITS_SHARDING.reset(self._token)
         return False
 
 
 def _maybe_constrain(af):
-    s = _LOGITS_SHARDING[0]
+    s = _LOGITS_SHARDING.get()
     if s is None:
         return af
     return jax.lax.with_sharding_constraint(af, s)

@@ -170,3 +170,21 @@ def test_fused_loss_dp_mp_memory_and_collectives():
     fused_tmp = build(True)
     plain_tmp = build(False)
     assert fused_tmp < plain_tmp, (fused_tmp, plain_tmp)
+
+
+def test_fused_loss_multichunk_stays_dp_balanced(monkeypatch):
+    """The STRIDED chunk layout (fused_ce chunk i = rows i::n): with the
+    row axis dp-sharded and n > 1 chunks, no chunk may concentrate on
+    one dp group — a contiguous-chunk regression would force per-chunk
+    redistribution, which under pure dp x mp shows up as
+    collective-permutes. This program must have ZERO."""
+    monkeypatch.setenv('PADDLE_TPU_FUSED_CE_CHUNK', '512')  # 2048 rows -> 4
+    ids, lbl = _batch(b=4)
+    model = _model(fused_loss=True)
+    step = _step(model, _strategy(dp_degree=2, mp_degree=4))
+    hlo, _ = step.compiled_hlo(ids, lbl)
+    counts = _collective_counts(hlo)
+    assert counts['collective-permute'] == 0, counts
+    assert counts['all-reduce'] >= 2, counts
+    full_vocab = re.findall(r'f32\[[0-9,]+,%d\]' % VOCAB, hlo)
+    assert not full_vocab, sorted(set(full_vocab))

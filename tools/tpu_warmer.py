@@ -55,6 +55,11 @@ CANARY_EVERY = 4         # re-run the canary after every N ladder rungs
 # via bench_extra. Phase C: fallbacks, sweeps, long-context.
 PHASE_A = [
     ('fused_flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
+    # the qkv layout copies (~5 ms/step, r4 profile fusion.825 family)
+    # are the next known byte mover after fused-CE+flash — the A/B
+    # belongs in the must-measure phase, not the tail
+    ('fused_flash_scan8_qkvlast', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                   'PADDLE_TPU_QKV_SPLIT': 'last'}),
     ('fused_flash_plain', {}),
     ('flash_scan8', {'PADDLE_TPU_FUSED_CE': '0',
                      'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
@@ -69,6 +74,11 @@ PHASE_C = [
                                   'PADDLE_TPU_FUSED_CE_CHUNK': '2048'}),
     ('fused_ce_chunk8192_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                   'PADDLE_TPU_FUSED_CE_CHUNK': '8192'}),
+    # single-chunk: no f32 dw-accumulator read-modify-write passes at
+    # all, at the cost of one 2 GB transient f32 logits tile — the
+    # other end of the chunk tradeoff curve
+    ('fused_ce_chunk16384_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                   'PADDLE_TPU_FUSED_CE_CHUNK': '16384'}),
     # long-context ladder: 2k/4k/8k with the full stack; each seq also
     # gets the pure-XLA blockwise fallback rung so a flash limit at that
     # scale still yields an honest measured number (VERDICT r4 #5)
@@ -95,10 +105,6 @@ PHASE_C = [
         'PADDLE_TPU_FLASH_DISABLE': '1',
         'PADDLE_TPU_FLASH_STRICT': '0',
         'PADDLE_TPU_ATTN_IMPL': 'blockwise'}),
-    # A/B: last-axis qkv split (layout-copy hypothesis from the r4
-    # profile — ~5 ms/step of [b,n,3,h,d] copies on the default path)
-    ('fused_flash_scan8_qkvlast', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
-                                   'PADDLE_TPU_QKV_SPLIT': 'last'}),
     # remaining driver-ladder fallback rungs: warm their caches and keep
     # refreshing r4's best plain capture
     ('flash_plain', {'PADDLE_TPU_FUSED_CE': '0'}),

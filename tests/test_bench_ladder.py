@@ -1,0 +1,98 @@
+"""bench.py self-tuning replay: the driver's end-of-round bench must
+replay the best warmer-measured config verbatim (capture row -> child
+env, EVERY knob pinned both ways so stray operator env can't leak),
+ranked in the 6N convention with suspect samples excluded, restricted
+to the headline seq-512 workload, and deduplicated against the fixed
+ladder."""
+import importlib.util
+import json
+import os
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        'bench_mod', os.path.join(os.path.dirname(__file__), os.pardir,
+                                  'bench.py'))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_capture_replay_env_fully_pinned():
+    b = _bench()
+    env = b._capture_replay_env({
+        'scan_steps': 8, 'fused_ce': True, 'flash_in_program': True,
+        'qkv_split': 'last', 'attn_impl': 'auto', 'fused_ce_chunk': 8192,
+        'flash_block_q': 128, 'flash_block_k': 128,
+        'batch': 32, 'seq': 512})
+    assert env['PADDLE_TPU_BENCH_SCAN_STEPS'] == '8'
+    assert env['PADDLE_TPU_FUSED_CE'] == '1'
+    assert env['PADDLE_TPU_QKV_SPLIT'] == 'last'
+    assert env['PADDLE_TPU_FUSED_CE_CHUNK'] == '8192'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q'] == '128'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K'] == '128'
+    # flash ran: disable pinned OFF and strict pinned ON — an inherited
+    # FLASH_DISABLE=1 or STRICT=0 must not survive the replay
+    assert env['PADDLE_TPU_FLASH_DISABLE'] == '0'
+    assert env['PADDLE_TPU_FLASH_STRICT'] == '1'
+    assert env['PADDLE_TPU_BENCH_BATCH'] == '32'
+    assert env['PADDLE_TPU_BENCH_SEQ'] == '512'
+
+    env = b._capture_replay_env({
+        'scan_steps': 0, 'fused_ce': False, 'flash_in_program': False,
+        'attn_impl': 'blockwise', 'blockwise_block': 128,
+        'batch': 32, 'seq': 512})
+    assert env['PADDLE_TPU_FLASH_DISABLE'] == '1'
+    assert env['PADDLE_TPU_FLASH_STRICT'] == '0'
+    assert env['PADDLE_TPU_FUSED_CE'] == '0'
+    assert env['PADDLE_TPU_ATTN_IMPL'] == 'blockwise'
+    assert env['PADDLE_TPU_BLOCKWISE_BLOCK'] == '128'
+    assert env['PADDLE_TPU_BENCH_SCAN_STEPS'] == '0'
+    # old defaults pinned even though the capture used none of them
+    assert env['PADDLE_TPU_QKV_SPLIT'] == 'headaxis'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q'] == '256'
+
+
+def test_effective_env_dedup():
+    b = _bench()
+    # the fixed ladder's head rung and a replay of a capture it produced
+    # must compare EQUAL as effective configs (the driver must not burn
+    # two child timeouts on one config)
+    ladder_head = {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}
+    replay = b._capture_replay_env({
+        'scan_steps': 8, 'fused_ce': True, 'flash_in_program': True,
+        'qkv_split': 'headaxis', 'attn_impl': 'auto',
+        'fused_ce_chunk': 4096, 'flash_block_q': 256,
+        'flash_block_k': 512, 'batch': 32, 'seq': 512})
+    assert b._effective_env(ladder_head) == b._effective_env(replay)
+    # but a genuinely different config (qkv last) stays distinct
+    replay2 = dict(replay, PADDLE_TPU_QKV_SPLIT='last')
+    assert b._effective_env(ladder_head) != b._effective_env(replay2)
+
+
+def test_best_capture_ranking_suspect_and_headline(tmp_path, monkeypatch):
+    b = _bench()
+    log = tmp_path / 'inwindow.jsonl'
+    rows = [
+        # higher mfu but suspect: must lose
+        {'platform': 'tpu', 'mfu_6n': 0.52, 'suspect': True, 'seq': 512,
+         'label': 'throttle-adjacent'},
+        # higher mfu but long-context: must lose the HEADLINE ranking
+        {'platform': 'tpu', 'mfu_6n': 0.60, 'seq': 8192, 'label': 'long'},
+        {'platform': 'tpu', 'mfu_6n': 0.42, 'seq': 512, 'label': 'good'},
+        {'platform': 'cpu', 'mfu_6n': 0.9, 'degraded': True},
+        {'platform': 'tpu', 'mfu_6n': 0.40, 'seq': 512, 'label': 'worse'},
+    ]
+    log.write_text('\n'.join(json.dumps(r) for r in rows) + '\n')
+    monkeypatch.setenv('PADDLE_TPU_BENCH_INWINDOW_LOG', str(log))
+    assert b._best_capture(headline_seq=512)['label'] == 'good'
+    # the unfiltered rank (the attached-evidence rule) may pick the
+    # long-context row — it carries its own batch/seq labeling
+    assert b._best_capture()['label'] == 'long'
+
+
+def test_best_capture_missing_log(monkeypatch, tmp_path):
+    b = _bench()
+    monkeypatch.setenv('PADDLE_TPU_BENCH_INWINDOW_LOG',
+                       str(tmp_path / 'nope.jsonl'))
+    assert b._best_capture() is None

@@ -437,7 +437,9 @@ def _attach_tpu_capture(result):
         if best is not None:
             keep = ('ts', 'label', 'mfu', 'mfu_6n', 'step_ms', 'value',
                     'unit', 'batch', 'seq', 'scan_steps', 'attn_impl',
-                    'fused_ce', 'platform')
+                    'fused_ce', 'fused_ce_chunk', 'qkv_split',
+                    'flash_in_program', 'flash_block_q', 'flash_block_k',
+                    'git_rev', 'platform')
             cap = {k: best[k] for k in keep if k in best}
             # the capture carries its OWN vs_baseline (6N convention /
             # the 50% north star) — the top-level vs_baseline belongs to

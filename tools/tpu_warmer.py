@@ -122,6 +122,28 @@ PHASE_C = [
 ]
 
 
+def _load_custom_ladder():
+    """PADDLE_TPU_WARMER_LADDER=<path.json> replaces the built-in ladder.
+
+    Schema: {"phase_a": [[label, {env}], ...], "phase_c": [...],
+    "skip_extras": bool}. Lets an in-window iteration fire a handful of
+    targeted rungs (e.g. combinations of knobs that just won their A/Bs)
+    without paying for the whole default ladder again.
+    """
+    global PHASE_A, PHASE_C, SKIP_EXTRAS
+    path = os.environ.get('PADDLE_TPU_WARMER_LADDER')
+    if not path:
+        return
+    with open(path) as f:
+        spec = json.load(f)
+    PHASE_A = [(l, e) for l, e in spec.get('phase_a', [])]
+    PHASE_C = [(l, e) for l, e in spec.get('phase_c', [])]
+    SKIP_EXTRAS = bool(spec.get('skip_extras', False))
+
+
+SKIP_EXTRAS = False
+
+
 def log(msg):
     line = '%s %s' % (time.strftime('%H:%M:%S'), msg)
     print(line, flush=True)
@@ -324,7 +346,9 @@ class Warmer(object):
         # Phase B: BASELINE configs 2/4 + decode (thinnest evidence) —
         # behind a fresh probe: a wedged pool must cost a 90s probe, not
         # the 1800s bench_extra child timeout
-        if probe_tpu():
+        if SKIP_EXTRAS:
+            pass
+        elif probe_tpu():
             self.extras()
         else:
             log('pool went down before extras; stopping')
@@ -401,6 +425,7 @@ class Warmer(object):
 
 
 def main():
+    _load_custom_ladder()
     lock = open(LOCK, 'w')
     try:
         fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)

@@ -256,16 +256,32 @@ def _run_measurement():
         'attn_impl': os.environ.get('PADDLE_TPU_ATTN_IMPL', 'auto'),
         'qkv_split': os.environ.get('PADDLE_TPU_QKV_SPLIT', 'headaxis'),
         'fused_ce_chunk': _fce_chunk(),
-        'flash_block_q': int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_Q',
-                                            256)),
-        'flash_block_k': int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_K',
-                                            512)),
+        # effective flash knobs from the ONE defaults table (the same
+        # resolve() the kernel module latches at import)
+        **{'flash_%s' % k: v for k, v in _flash_knobs().items()},
         **({'blockwise_block': int(os.environ['PADDLE_TPU_BLOCKWISE_BLOCK'])}
            if 'PADDLE_TPU_BLOCKWISE_BLOCK' in os.environ else {}),
         'platform': platform,
         'degraded': not on_tpu,
         **({'dispatch_ms': dispatch_ms} if dispatch_ms else {}),
     }))
+
+
+def _flash_defaults_mod():
+    """Load ops/flash_defaults.py WITHOUT importing the paddle_tpu
+    package: the parent process must never trigger the package's jax
+    import (backend touches belong in children with timeouts)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'paddle_tpu', 'ops', 'flash_defaults.py')
+    spec = importlib.util.spec_from_file_location('_flash_defaults', path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _flash_knobs():
+    return _flash_defaults_mod().resolve()
 
 
 def _fce_chunk():
@@ -287,10 +303,29 @@ def _capture_replay_env(entry):
         'PADDLE_TPU_FUSED_CE': '1' if entry.get('fused_ce') else '0',
         'PADDLE_TPU_QKV_SPLIT': str(entry.get('qkv_split') or 'headaxis'),
         'PADDLE_TPU_ATTN_IMPL': str(entry.get('attn_impl') or 'auto'),
+        # rows from before a knob existed must replay at the value that
+        # era's code actually used, NOT today's default — legacy fwd
+        # blocks were 256/512, the legacy long path reused the fwd
+        # blocks, and the legacy router was '> 4096' (= today's
+        # '>= 4097')
         'PADDLE_TPU_FLASH_BLOCK_Q':
             str(int(entry.get('flash_block_q') or 256)),
         'PADDLE_TPU_FLASH_BLOCK_K':
             str(int(entry.get('flash_block_k') or 512)),
+        'PADDLE_TPU_FLASH_BLOCK_Q_BWD':
+            str(int(entry.get('flash_block_q_bwd')
+                    or entry.get('flash_block_q') or 256)),
+        'PADDLE_TPU_FLASH_BLOCK_K_BWD':
+            str(int(entry.get('flash_block_k_bwd')
+                    or entry.get('flash_block_k') or 512)),
+        'PADDLE_TPU_FLASH_BLOCK_Q_LONG':
+            str(int(entry.get('flash_block_q_long')
+                    or entry.get('flash_block_q') or 256)),
+        'PADDLE_TPU_FLASH_BLOCK_K_LONG':
+            str(int(entry.get('flash_block_k_long')
+                    or entry.get('flash_block_k') or 512)),
+        'PADDLE_TPU_FLASH_LONG_SEQ':
+            str(int(entry.get('flash_long_seq') or 4097)),
     }
     if entry.get('flash_in_program'):
         env['PADDLE_TPU_FLASH_DISABLE'] = '0'
@@ -320,8 +355,13 @@ _KNOB_DEFAULTS = {
     'PADDLE_TPU_FUSED_CE_CHUNK': '4096',
     'PADDLE_TPU_QKV_SPLIT': 'headaxis',
     'PADDLE_TPU_ATTN_IMPL': 'auto',
-    'PADDLE_TPU_FLASH_BLOCK_Q': '256',
-    'PADDLE_TPU_FLASH_BLOCK_K': '512',
+    # flash knobs: one source of truth (ops/flash_defaults.py)
+    **{'PADDLE_TPU_FLASH_%s' % k.upper(): str(v)
+       for k, v in (lambda d: {
+           'BLOCK_Q': d.BLOCK_Q, 'BLOCK_K': d.BLOCK_K,
+           'BLOCK_Q_BWD': d.BLOCK_Q, 'BLOCK_K_BWD': d.BLOCK_K,
+           'BLOCK_Q_LONG': d.BLOCK_Q_LONG, 'BLOCK_K_LONG': d.BLOCK_K_LONG,
+           'LONG_SEQ': d.LONG_SEQ})(_flash_defaults_mod()).items()},
     'PADDLE_TPU_FLASH_DISABLE': '0',
     'PADDLE_TPU_FLASH_STRICT': '1',
     'PADDLE_TPU_BENCH_BATCH': '32',
@@ -333,6 +373,13 @@ def _effective_env(extra):
     """Complete a partial child-env dict with the knob defaults."""
     eff = dict(_KNOB_DEFAULTS)
     eff.update(extra or {})
+    # the bwd blocks inherit the (possibly overridden) fwd blocks when
+    # unset — mirror the kernel's env contract so two spellings of the
+    # same effective config compare equal
+    if 'PADDLE_TPU_FLASH_BLOCK_Q_BWD' not in (extra or {}):
+        eff['PADDLE_TPU_FLASH_BLOCK_Q_BWD'] = eff['PADDLE_TPU_FLASH_BLOCK_Q']
+    if 'PADDLE_TPU_FLASH_BLOCK_K_BWD' not in (extra or {}):
+        eff['PADDLE_TPU_FLASH_BLOCK_K_BWD'] = eff['PADDLE_TPU_FLASH_BLOCK_K']
     return eff
 
 
@@ -439,7 +486,9 @@ def _attach_tpu_capture(result):
                     'unit', 'batch', 'seq', 'scan_steps', 'attn_impl',
                     'fused_ce', 'fused_ce_chunk', 'qkv_split',
                     'flash_in_program', 'flash_block_q', 'flash_block_k',
-                    'git_rev', 'platform')
+                    'flash_block_q_bwd', 'flash_block_k_bwd',
+                    'flash_block_q_long', 'flash_block_k_long',
+                    'flash_long_seq', 'git_rev', 'platform')
             cap = {k: best[k] for k in keep if k in best}
             # the capture carries its OWN vs_baseline (6N convention /
             # the 50% north star) — the top-level vs_baseline belongs to

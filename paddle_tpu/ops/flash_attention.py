@@ -21,8 +21,19 @@ import os
 import jax
 import jax.numpy as jnp
 
-_DEFAULT_BLOCK_Q = int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_Q', 256))
-_DEFAULT_BLOCK_K = int(os.environ.get('PADDLE_TPU_FLASH_BLOCK_K', 512))
+from . import flash_defaults as _fd
+
+# knob values latched at import (each bench child re-imports); the
+# defaults, their rationale, and the bwd-inherits-fwd rule live in ONE
+# place: ops/flash_defaults.py (bench.py records/replays from the same
+# table)
+_knobs = _fd.resolve()
+_DEFAULT_BLOCK_Q = _knobs['block_q']
+_DEFAULT_BLOCK_K = _knobs['block_k']
+_BLOCK_Q_BWD = _knobs['block_q_bwd']
+_BLOCK_K_BWD = _knobs['block_k_bwd']
+_BLOCK_Q_LONG = _knobs['block_q_long']
+_BLOCK_K_LONG = _knobs['block_k_long']
 _NEG_INF = -1e30
 
 
@@ -60,8 +71,16 @@ def _supported(q, k, v):
                                                       v.dtype)
     if d % 64:
         return 'head_dim %d %% 64 != 0' % d
-    if n % min(_DEFAULT_BLOCK_Q, n) or m % min(_DEFAULT_BLOCK_K, m):
-        return 'seq (%d, %d) not divisible by block sizes' % (n, m)
+    # validate against the blocks the dispatched path will actually use:
+    # the long path has its own (wider) block defaults, and the standard
+    # backward blocks are independently overridable
+    if _use_long_path(n, m):
+        if _long_blocks(n, m) is None:
+            return 'seq (%d, %d) not tileable by any long-path block' \
+                % (n, m)
+    elif _std_blocks(n, m) is None or _std_bwd_blocks(n, m) is None:
+        return 'seq (%d, %d) not tileable by any standard-path block' \
+            % (n, m)
     if n % 8 or m % 128:
         return 'seq (%d, %d) below TPU tile granularity' % (n, m)
     return None
@@ -159,8 +178,7 @@ def _fwd_impl(q, k, v, causal, scale):
 
     b, h, n, d = q.shape
     m = k.shape[2]
-    block_q = min(_DEFAULT_BLOCK_Q, n)
-    block_k = min(_DEFAULT_BLOCK_K, m)
+    block_q, block_k = _std_blocks(n, m)
 
     grid = (b, h, n // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -206,13 +224,54 @@ def _fwd_impl(q, k, v, causal, scale):
 # in VMEM scratch that persists across sequential grid steps. Staged
 # bytes are then O(block) regardless of sequence length.
 
-_LONG_SEQ = int(os.environ.get('PADDLE_TPU_FLASH_LONG_SEQ', 4096))
+_LONG_SEQ = _knobs['long_seq']
 
 
 def _use_long_path(n, m):
     if os.environ.get('PADDLE_TPU_FLASH_FORCE_LONG', '0') == '1':
         return True
-    return max(n, m) > _LONG_SEQ
+    return max(n, m) >= _LONG_SEQ
+
+
+def _fit_block(desired, dim):
+    """Largest block <= desired that divides dim (halving from desired,
+    floor 128 — the TPU lane tile; dims at/below 128 run as ONE block,
+    preserving the old min(block, dim) behavior for short q). None if
+    nothing fits: the caller routes to the fallback instead of
+    truncating the walk."""
+    if dim <= 128:
+        return dim
+    b = min(desired, dim)
+    while b >= 128:
+        if dim % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def _clamped(desired_q, desired_k, n, m):
+    """(block_q, block_k) clamped so every sequence that divides SOME
+    power-of-two block >= 128 stays on the kernel (e.g. seq 4608 runs
+    the long path at 512/512 when the preferred 1024 KV block doesn't
+    divide it; seq 768 runs the standard path at 256), or None if the
+    shape can't tile."""
+    bq = _fit_block(desired_q, n)
+    bk = _fit_block(desired_k, m)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+def _long_blocks(n, m):
+    return _clamped(_BLOCK_Q_LONG, _BLOCK_K_LONG, n, m)
+
+
+def _std_blocks(n, m):
+    return _clamped(_DEFAULT_BLOCK_Q, _DEFAULT_BLOCK_K, n, m)
+
+
+def _std_bwd_blocks(n, m):
+    return _clamped(_BLOCK_Q_BWD, _BLOCK_K_BWD, n, m)
 
 
 def _fwd_kernel_long(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -266,8 +325,7 @@ def _fwd_impl_long(q, k, v, causal, scale):
 
     b, h, n, d = q.shape
     m = k.shape[2]
-    block_q = min(_DEFAULT_BLOCK_Q, n)
-    block_k = min(_DEFAULT_BLOCK_K, m)
+    block_q, block_k = _long_blocks(n, m)
     num_kb = m // block_k
 
     grid = (b, h, n // block_q, num_kb)
@@ -396,8 +454,7 @@ def _bwd_impl_long(q, k, v, o, lse, do, causal, scale):
 
     b, h, n, d = q.shape
     m = k.shape[2]
-    block_q = min(_DEFAULT_BLOCK_Q, n)
-    block_k = min(_DEFAULT_BLOCK_K, m)
+    block_q, block_k = _long_blocks(n, m)
     num_kb = m // block_k
     num_qb = n // block_q
 
@@ -539,8 +596,7 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale):
 
     b, h, n, d = q.shape
     m = k.shape[2]
-    block_q = min(_DEFAULT_BLOCK_Q, n)
-    block_k = min(_DEFAULT_BLOCK_K, m)
+    block_q, block_k = _std_bwd_blocks(n, m)
 
     # delta = rowsum(do * o): one fused elementwise+reduce, tiny vs the
     # kernel FLOPs — leave it to XLA

@@ -1,0 +1,40 @@
+"""Flash-attention block-size knob defaults — the ONE copy.
+
+Pure module (no jax import): bench.py's parent process reads it to
+record/replay capture rows without touching a backend, and
+ops/flash_attention.py reads it at import to configure the kernels.
+Defaults are the measured in-window optima on v5e
+(docs/bench_inwindow_r5.jsonl): 512/512 fwd blocks beat 256/512 by 5%
+on the BERT-base rung; the long-path kernels stage O(block) bytes so
+they prefer a wider KV block (8k rung: 285.6 ms at 512/1024 vs 426.6 ms
+at 256/512).
+"""
+import os
+
+BLOCK_Q = 512
+BLOCK_K = 512
+BLOCK_Q_LONG = 512
+BLOCK_K_LONG = 1024
+LONG_SEQ = 4096
+
+
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def resolve():
+    """Effective knob values under the current environment. The bwd
+    blocks inherit the (possibly overridden) fwd blocks when unset."""
+    bq = env_int('PADDLE_TPU_FLASH_BLOCK_Q', BLOCK_Q)
+    bk = env_int('PADDLE_TPU_FLASH_BLOCK_K', BLOCK_K)
+    return {
+        'block_q': bq,
+        'block_k': bk,
+        'block_q_bwd': env_int('PADDLE_TPU_FLASH_BLOCK_Q_BWD', bq),
+        'block_k_bwd': env_int('PADDLE_TPU_FLASH_BLOCK_K_BWD', bk),
+        'block_q_long': env_int('PADDLE_TPU_FLASH_BLOCK_Q_LONG',
+                                BLOCK_Q_LONG),
+        'block_k_long': env_int('PADDLE_TPU_FLASH_BLOCK_K_LONG',
+                                BLOCK_K_LONG),
+        'long_seq': env_int('PADDLE_TPU_FLASH_LONG_SEQ', LONG_SEQ),
+    }

@@ -24,13 +24,20 @@ def test_capture_replay_env_fully_pinned():
         'scan_steps': 8, 'fused_ce': True, 'flash_in_program': True,
         'qkv_split': 'last', 'attn_impl': 'auto', 'fused_ce_chunk': 8192,
         'flash_block_q': 128, 'flash_block_k': 128,
-        'batch': 32, 'seq': 512})
+        'flash_block_q_bwd': 256, 'flash_block_k_bwd': 128,
+        'flash_block_q_long': 512, 'flash_block_k_long': 2048,
+        'flash_long_seq': 2048, 'batch': 32, 'seq': 512})
     assert env['PADDLE_TPU_BENCH_SCAN_STEPS'] == '8'
     assert env['PADDLE_TPU_FUSED_CE'] == '1'
     assert env['PADDLE_TPU_QKV_SPLIT'] == 'last'
     assert env['PADDLE_TPU_FUSED_CE_CHUNK'] == '8192'
     assert env['PADDLE_TPU_FLASH_BLOCK_Q'] == '128'
     assert env['PADDLE_TPU_FLASH_BLOCK_K'] == '128'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q_BWD'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K_BWD'] == '128'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q_LONG'] == '512'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K_LONG'] == '2048'
+    assert env['PADDLE_TPU_FLASH_LONG_SEQ'] == '2048'
     # flash ran: disable pinned OFF and strict pinned ON — an inherited
     # FLASH_DISABLE=1 or STRICT=0 must not survive the replay
     assert env['PADDLE_TPU_FLASH_DISABLE'] == '0'
@@ -48,9 +55,27 @@ def test_capture_replay_env_fully_pinned():
     assert env['PADDLE_TPU_ATTN_IMPL'] == 'blockwise'
     assert env['PADDLE_TPU_BLOCKWISE_BLOCK'] == '128'
     assert env['PADDLE_TPU_BENCH_SCAN_STEPS'] == '0'
-    # old defaults pinned even though the capture used none of them
+    # knobs the row never recorded still get pinned — at the ERA
+    # values (this row has no block fields, so it predates them:
+    # 256/512 was that code's default)
     assert env['PADDLE_TPU_QKV_SPLIT'] == 'headaxis'
     assert env['PADDLE_TPU_FLASH_BLOCK_Q'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q_BWD'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K_LONG'] == '512'
+
+
+def test_capture_replay_env_legacy_rows_pin_era_values():
+    b = _bench()
+    env = b._capture_replay_env({
+        'scan_steps': 8, 'fused_ce': False, 'flash_in_program': True,
+        'batch': 32, 'seq': 512})  # r4-era row: block knobs predate it
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K'] == '512'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q_BWD'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_Q_LONG'] == '256'
+    assert env['PADDLE_TPU_FLASH_BLOCK_K_LONG'] == '512'
+    # legacy router was '> 4096', i.e. today's '>= 4097'
+    assert env['PADDLE_TPU_FLASH_LONG_SEQ'] == '4097'
 
 
 def test_effective_env_dedup():
@@ -62,8 +87,11 @@ def test_effective_env_dedup():
     replay = b._capture_replay_env({
         'scan_steps': 8, 'fused_ce': True, 'flash_in_program': True,
         'qkv_split': 'headaxis', 'attn_impl': 'auto',
-        'fused_ce_chunk': 4096, 'flash_block_q': 256,
-        'flash_block_k': 512, 'batch': 32, 'seq': 512})
+        'fused_ce_chunk': 4096, 'flash_block_q': 512,
+        'flash_block_k': 512, 'flash_block_q_bwd': 512,
+        'flash_block_k_bwd': 512, 'flash_block_q_long': 512,
+        'flash_block_k_long': 1024, 'flash_long_seq': 4096,
+        'batch': 32, 'seq': 512})
     assert b._effective_env(ladder_head) == b._effective_env(replay)
     # but a genuinely different config (qkv last) stays distinct
     replay2 = dict(replay, PADDLE_TPU_QKV_SPLIT='last')

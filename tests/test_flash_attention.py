@@ -219,3 +219,79 @@ def test_long_path_auto_threshold():
     assert not fa._use_long_path(512, 512)
     assert fa._use_long_path(8192, 8192)
     assert fa._use_long_path(512, 8192)
+
+
+def test_supported_gate_checks_the_dispatched_paths_blocks():
+    # seq 4608 routes to the LONG path (>= 4096); the preferred KV block
+    # (1024) doesn't divide it, so the kernels must CLAMP to 512/512 —
+    # not truncate the KV walk, and not reject a shape the kernel can
+    # serve (it ran at 256/512 before the wide defaults)
+    assert fa._long_blocks(4608, 4608) == (512, 512)
+    q = jnp.zeros((1, 1, 4608, 64), jnp.float32)
+    assert fa._supported(q, q, q) is None
+    # preferred blocks used when they fit
+    assert fa._long_blocks(8192, 8192) == (512, 1024)
+    q = jnp.zeros((1, 1, 8192, 64), jnp.float32)
+    assert fa._supported(q, q, q) is None
+    # a shape no power-of-two block >= 128 tiles: rejected, with the
+    # long-path reason (4616 = 8 x 577 passes the %8 granularity check)
+    assert fa._long_blocks(4616, 4616) is None
+    q = jnp.zeros((1, 1, 4616, 64), jnp.float32)
+    reason = fa._supported(q, q, q)
+    assert reason is not None and 'tileable' in reason
+    # the standard path still validates against its own blocks
+    q = jnp.zeros((1, 1, 512, 64), jnp.float32)
+    assert fa._supported(q, q, q) is None
+    # n == 768 divides 256 but not the preferred 512 q block: the
+    # standard path must clamp (as it did when 256 WAS the default),
+    # not reject
+    assert fa._std_blocks(768, 1024) == (256, 512)
+    q = jnp.zeros((1, 1, 768, 64), jnp.float32)
+    k = jnp.zeros((1, 1, 1024, 64), jnp.float32)
+    assert fa._supported(q, k, k) is None
+    # short-q cross-attention over a long KV: q runs as a single block
+    q = jnp.zeros((1, 1, 64, 64), jnp.float32)
+    k = jnp.zeros((1, 1, 8192, 64), jnp.float32)
+    assert fa._long_blocks(64, 8192) == (64, 1024)
+    assert fa._supported(q, k, k) is None
+
+
+def test_long_path_short_q_cross_attention_parity(monkeypatch):
+    # q shorter than the 128 lane tile over a longer KV, forced onto the
+    # long path: single-block q, clamped KV walk
+    monkeypatch.setenv('PADDLE_TPU_FLASH_FORCE_LONG', '1')
+    import numpy as _np
+    rng = _np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(1, 2, 64, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    out = fa.flash_attention_bhnd(q, k, v)
+    ref = fa._ref_bhnd(q, k, v, False, 1.0 / np.sqrt(64))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_long_path_clamped_blocks_parity(monkeypatch):
+    # force the long path onto a seq where the preferred 512/1024 blocks
+    # don't divide (640 -> clamps to bq=128, bk=640): outputs and grads
+    # must match the reference exactly like the aligned case
+    monkeypatch.setenv('PADDLE_TPU_FLASH_FORCE_LONG', '1')
+    import numpy as _np
+    rng = _np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    assert fa._long_blocks(640, 640) == (128, 640)
+    scale = 1.0 / np.sqrt(64)
+
+    def f(q, k, v):
+        return (fa.flash_attention_bhnd(q, k, v, causal=True) ** 2).sum()
+
+    def ref(q, k, v):
+        return (fa._ref_bhnd(q, k, v, True, scale) ** 2).sum()
+
+    g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

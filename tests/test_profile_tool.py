@@ -1,32 +1,116 @@
-"""tools/profile_analysis.py parses a real captured TPU trace.
+"""tools/profile_analysis.py contract tests.
 
-The committed round-4 profile (docs/tpu_profile_r4) is the fixture: the
-tool must load it, attribute device time to XLA ops, infer the step
-count, and produce the roofline totals the perf notes cite.
+Two tiers:
+- a synthetic trace fixture (always runs, hardware-free): exercises
+  load_trace / device_ops / aggregate end-to-end on the exact
+  trace-viewer JSON shape jax.profiler writes;
+- a captured on-TPU profile, when one exists locally (docs/tpu_profile_r5
+  is written by the warmer's auto-profile pass; the raw blobs are
+  gitignored per the r4 advisor, so CI machines skip this tier).
 """
+import glob
+import gzip
+import json
 import os
 
 import pytest
 
 import tools.profile_analysis as pa
 
-_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), 'docs', 'tpu_profile_r4')
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# first profile dir (newest round first) that holds a trace, else None —
+# single source of truth for both the skip condition and the test body
+_CAPTURED_DIR = next(
+    (os.path.join(_ROOT, 'docs', d)
+     for d in ('tpu_profile_r5', 'tpu_profile_r4')
+     if glob.glob(os.path.join(_ROOT, 'docs', d, '**', '*.trace.json.gz'),
+                  recursive=True)),
+    None)
 
 
-@pytest.mark.skipif(not os.path.isdir(_DIR), reason='no committed profile')
-def test_parses_committed_profile():
-    trace, path = pa.load_trace(_DIR)
+def _synthetic_trace(tmp_path, steps=8, step_us=1000.0):
+    """A minimal trace-viewer JSON mirroring jax.profiler's layout: a
+    device pid with 'XLA Ops' / 'XLA Modules' lanes plus a host pid that
+    must be ignored."""
+    dev, host = 7, 3
+    events = [
+        {'ph': 'M', 'pid': dev, 'name': 'process_name',
+         'args': {'name': '/device:TPU:0'}},
+        {'ph': 'M', 'pid': dev, 'tid': 1, 'name': 'thread_name',
+         'args': {'name': 'XLA Ops'}},
+        {'ph': 'M', 'pid': dev, 'tid': 2, 'name': 'thread_name',
+         'args': {'name': 'XLA Modules'}},
+        {'ph': 'M', 'pid': host, 'name': 'process_name',
+         'args': {'name': 'host worker'}},
+        {'ph': 'M', 'pid': host, 'tid': 1, 'name': 'thread_name',
+         'args': {'name': 'XLA Ops'}},  # host lane: must not be counted
+    ]
+    for s in range(steps):
+        t0 = s * step_us
+        events.append({'ph': 'X', 'pid': dev, 'tid': 2, 'ts': t0,
+                       'dur': step_us, 'name': 'jit_train_step'})
+        # one matmul-ish op (flops-heavy) + one copy (bytes-heavy)
+        events.append({'ph': 'X', 'pid': dev, 'tid': 1, 'ts': t0,
+                       'dur': 600.0, 'name': 'fusion.1',
+                       'args': {'model_flops': 2.4e11,
+                                'bytes_accessed': 1e7,
+                                'hlo_category': 'convolution fusion',
+                                'long_name': '%fusion.1 = bf16[...]'}})
+        events.append({'ph': 'X', 'pid': dev, 'tid': 1, 'ts': t0 + 600,
+                       'dur': 400.0, 'name': 'copy.2',
+                       'args': {'model_flops': 0,
+                                'bytes_accessed': 3.2e8,
+                                'hlo_category': 'copy',
+                                'long_name': '%copy.2 = f32[...]'}})
+        # host-lane noise with the same name: ignored by device_ops
+        events.append({'ph': 'X', 'pid': host, 'tid': 1, 'ts': t0,
+                       'dur': 5000.0, 'name': 'fusion.1', 'args': {}})
+    pdir = tmp_path / 'prof' / 'plugins' / 'profile' / 'run1'
+    pdir.mkdir(parents=True)
+    with gzip.open(str(pdir / 'vm.trace.json.gz'), 'wt') as f:
+        json.dump({'traceEvents': events}, f)
+    return str(tmp_path / 'prof')
+
+
+def test_synthetic_trace_roundtrip(tmp_path):
+    pdir = _synthetic_trace(tmp_path)
+    trace, path = pa.load_trace(pdir)
+    assert path.endswith('.trace.json.gz')
     ops, n_modules = pa.device_ops(trace)
+    # 8 steps x 2 device ops; the 8 host events must be excluded
+    assert len(ops) == 16
+    assert n_modules == 8
+    rows = pa.aggregate(ops)
+    assert set(rows) == {'fusion.1', 'copy.2'}
+    f = rows['fusion.1']
+    assert f['n'] == 8 and f['dur_us'] == pytest.approx(4800.0)
+    assert f['flops'] == pytest.approx(2.4e11)
+    assert f['cat'] == 'convolution fusion'
+    c = rows['copy.2']
+    assert c['bytes'] == pytest.approx(3.2e8)
+    # per-step totals: (600+400) us
+    steps = 8
+    tot_ms = sum(r['dur_us'] for r in rows.values()) / 1e3 / steps
+    assert tot_ms == pytest.approx(1.0)
+
+
+@pytest.mark.skipif(_CAPTURED_DIR is None,
+                    reason='no locally captured profile (raw blobs are '
+                           'gitignored; the warmer writes them in-window)')
+def test_parses_captured_profile():
+    trace, _ = pa.load_trace(_CAPTURED_DIR)
+    ops, _ = pa.device_ops(trace)
     assert ops, 'no device ops found'
     rows = pa.aggregate(ops)
-    # the bench profiled 8 steps; the modal op count must agree
     import collections
     steps = collections.Counter(r['n'] for r in rows.values()).most_common(
         1)[0][0]
-    assert steps == 8
+    # the warmer profiles multiple steps: step inference must detect the
+    # repetition, not collapse to 1 (which would inflate every per-step
+    # total this tool reports)
+    assert steps >= 2
     tot_ms = sum(r['dur_us'] for r in rows.values()) / 1e3 / steps
-    # the captured flash_disabled_plain rung ran ~129 ms/step on-chip
-    assert 100 < tot_ms < 160, tot_ms
+    assert tot_ms > 10, tot_ms
     tot_bytes = sum(r['bytes'] * r['n'] for r in rows.values()) / steps
-    assert tot_bytes > 5e10  # the step moves tens of GB — sanity
+    # a real BERT-base training step moves tens of GB
+    assert tot_bytes > 1e10

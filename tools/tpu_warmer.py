@@ -112,12 +112,13 @@ PHASE_C = [
                               'PADDLE_TPU_FLASH_DISABLE': '1',
                               'PADDLE_TPU_FLASH_STRICT': '0'}),
     # flash kernel block-size sweep (kernels read PADDLE_TPU_FLASH_BLOCK_*
-    # at import; each bench child re-imports): defaults are 256/512
+    # at import; each bench child re-imports): defaults are 512/512 as of
+    # r5, so sweep the smaller references
     ('fused_flash_scan8_bq128_bk128', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                        'PADDLE_TPU_FLASH_BLOCK_Q': '128',
                                        'PADDLE_TPU_FLASH_BLOCK_K': '128'}),
-    ('fused_flash_scan8_bq512_bk512', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
-                                       'PADDLE_TPU_FLASH_BLOCK_Q': '512',
+    ('fused_flash_scan8_bq256_bk512', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                                       'PADDLE_TPU_FLASH_BLOCK_Q': '256',
                                        'PADDLE_TPU_FLASH_BLOCK_K': '512'}),
 ]
 
@@ -136,8 +137,18 @@ def _load_custom_ladder():
         return
     with open(path) as f:
         spec = json.load(f)
-    PHASE_A = [(l, e) for l, e in spec.get('phase_a', [])]
-    PHASE_C = [(l, e) for l, e in spec.get('phase_c', [])]
+    def _env_str(v):
+        # env-safe strings: a natural JSON spec writes ints and bools,
+        # and the knob consumers compare against '1'/'0' (str(True)
+        # would silently read as off)
+        if isinstance(v, bool):
+            return '1' if v else '0'
+        return str(v)
+
+    PHASE_A = [(l, {k: _env_str(v) for k, v in e.items()})
+               for l, e in spec.get('phase_a', [])]
+    PHASE_C = [(l, {k: _env_str(v) for k, v in e.items()})
+               for l, e in spec.get('phase_c', [])]
     SKIP_EXTRAS = bool(spec.get('skip_extras', False))
 
 

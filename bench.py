@@ -326,6 +326,9 @@ def _capture_replay_env(entry):
                     or entry.get('flash_block_k') or 512)),
         'PADDLE_TPU_FLASH_LONG_SEQ':
             str(int(entry.get('flash_long_seq') or 4097)),
+        # rows predating the fused-backward kernel ran the two-pass path
+        'PADDLE_TPU_FLASH_FUSED_BWD':
+            '1' if entry.get('flash_fused_bwd') else '0',
     }
     if entry.get('flash_in_program'):
         env['PADDLE_TPU_FLASH_DISABLE'] = '0'
@@ -361,7 +364,9 @@ _KNOB_DEFAULTS = {
            'BLOCK_Q': d.BLOCK_Q, 'BLOCK_K': d.BLOCK_K,
            'BLOCK_Q_BWD': d.BLOCK_Q, 'BLOCK_K_BWD': d.BLOCK_K,
            'BLOCK_Q_LONG': d.BLOCK_Q_LONG, 'BLOCK_K_LONG': d.BLOCK_K_LONG,
-           'LONG_SEQ': d.LONG_SEQ})(_flash_defaults_mod()).items()},
+           'LONG_SEQ': d.LONG_SEQ,
+           'FUSED_BWD': '1' if d.FUSED_BWD else '0',
+           })(_flash_defaults_mod()).items()},
     'PADDLE_TPU_FLASH_DISABLE': '0',
     'PADDLE_TPU_FLASH_STRICT': '1',
     'PADDLE_TPU_BENCH_BATCH': '32',
@@ -488,7 +493,8 @@ def _attach_tpu_capture(result):
                     'flash_in_program', 'flash_block_q', 'flash_block_k',
                     'flash_block_q_bwd', 'flash_block_k_bwd',
                     'flash_block_q_long', 'flash_block_k_long',
-                    'flash_long_seq', 'git_rev', 'platform')
+                    'flash_long_seq', 'flash_fused_bwd', 'git_rev',
+                    'platform')
             cap = {k: best[k] for k in keep if k in best}
             # the capture carries its OWN vs_baseline (6N convention /
             # the 50% north star) — the top-level vs_baseline belongs to

@@ -590,6 +590,72 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal):
+    """Single-cell backward: dq, dk, dv from ONE kernel invocation.
+
+    When one (block_q, block_k) tile covers the whole [n, m] score
+    matrix (the seq-512 training shape at the 512/512 defaults), the
+    two-pass backward wastes work: the dq pass and the dk/dv pass each
+    recompute s, p and dp (8 MXU contractions total). Computing them
+    once and emitting all three grads needs 5. One pallas_call per
+    (b, h) also halves the Mosaic dispatches."""
+    q = q_ref[...]
+    k_blk = k_ref[...]
+    v_blk = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...]      # [n, 1]
+    delta = delta_ref[...]  # [n, 1]
+    s = _mm_f32(q, k_blk, transpose_b=True) * scale
+    if causal:
+        s = _causal_mask(s, 0, 0)
+    p = jnp.exp(jnp.minimum(s - lse, 30.0))  # clamp: see _bwd_dq_kernel
+    dp = _mm_f32(do, v_blk, transpose_b=True)
+    ds = p * (dp - delta) * scale
+    dq_ref[...] = _mm_f32(ds.astype(k_blk.dtype),
+                          k_blk).astype(dq_ref.dtype)
+    dk_ref[...] = _mm_f32(ds.astype(q.dtype), q,
+                          transpose_a=True).astype(dk_ref.dtype)
+    dv_ref[...] = _mm_f32(p.astype(do.dtype), do,
+                          transpose_a=True).astype(dv_ref.dtype)
+
+
+def _bwd_impl_fused(q, k, v, lse, do, delta, causal, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, n, d = q.shape
+    m = k.shape[2]
+    kwargs = {}
+    if interpret_mode():
+        kwargs['interpret'] = True
+    else:
+        kwargs['compiler_params'] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"))
+    full_q = pl.BlockSpec((None, None, n, d), lambda bi, hi: (bi, hi, 0, 0))
+    full_k = pl.BlockSpec((None, None, m, d), lambda bi, hi: (bi, hi, 0, 0))
+    full_rowq = pl.BlockSpec((None, None, n, 1),
+                             lambda bi, hi: (bi, hi, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal),
+        out_shape=[jax.ShapeDtypeStruct((b, h, n, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, m, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, m, d), v.dtype)],
+        grid=(b, h),
+        in_specs=[full_q, full_k, full_k, full_q, full_rowq, full_rowq],
+        out_specs=[full_q, full_k, full_k],
+        **kwargs,
+    )(q, k, v, do, lse, delta)
+
+
+def _fused_bwd_enabled():
+    # re-read the env (not the import-latched copy): tests A/B this knob
+    # in-process, and a kernel choice — unlike a block size — changes no
+    # traced shapes, so late reads can't mix layouts. The default comes
+    # from the ONE knob table (ops/flash_defaults.py).
+    return _fd.resolve()['fused_bwd']
+
+
 def _bwd_impl(q, k, v, o, lse, do, causal, scale):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -602,6 +668,10 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale):
     # kernel FLOPs — leave it to XLA
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [b, h, n, 1]
+
+    if block_q == n and block_k == m and _fused_bwd_enabled():
+        # one tile covers the whole score matrix: single fused kernel
+        return _bwd_impl_fused(q, k, v, lse, do, delta, causal, scale)
 
     kwargs = {}
     if interpret_mode():

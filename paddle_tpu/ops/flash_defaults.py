@@ -16,6 +16,7 @@ BLOCK_K = 512
 BLOCK_Q_LONG = 512
 BLOCK_K_LONG = 1024
 LONG_SEQ = 4096
+FUSED_BWD = True
 
 
 def env_int(name, default):
@@ -37,4 +38,7 @@ def resolve():
         'block_k_long': env_int('PADDLE_TPU_FLASH_BLOCK_K_LONG',
                                 BLOCK_K_LONG),
         'long_seq': env_int('PADDLE_TPU_FLASH_LONG_SEQ', LONG_SEQ),
+        'fused_bwd': os.environ.get(
+            'PADDLE_TPU_FLASH_FUSED_BWD',
+            '1' if FUSED_BWD else '0') != '0',
     }

@@ -76,6 +76,8 @@ def test_capture_replay_env_legacy_rows_pin_era_values():
     assert env['PADDLE_TPU_FLASH_BLOCK_K_LONG'] == '512'
     # legacy router was '> 4096', i.e. today's '>= 4097'
     assert env['PADDLE_TPU_FLASH_LONG_SEQ'] == '4097'
+    # the fused backward kernel postdates this row: two-pass pinned
+    assert env['PADDLE_TPU_FLASH_FUSED_BWD'] == '0'
 
 
 def test_effective_env_dedup():
@@ -91,7 +93,7 @@ def test_effective_env_dedup():
         'flash_block_k': 512, 'flash_block_q_bwd': 512,
         'flash_block_k_bwd': 512, 'flash_block_q_long': 512,
         'flash_block_k_long': 1024, 'flash_long_seq': 4096,
-        'batch': 32, 'seq': 512})
+        'flash_fused_bwd': True, 'batch': 32, 'seq': 512})
     assert b._effective_env(ladder_head) == b._effective_env(replay)
     # but a genuinely different config (qkv last) stays distinct
     replay2 = dict(replay, PADDLE_TPU_QKV_SPLIT='last')

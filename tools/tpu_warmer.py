@@ -49,10 +49,11 @@ CANARY_DRIFT = 0.15      # >15% below the window reference => suspect
 CANARY_EVERY = 4         # re-run the canary after every N ladder rungs
 
 # config ladder: label -> extra env, grouped in priority phases.
-# Phase A: the round-5 headline shot — fused head+CE x flash x
-# native-dtype matmuls, never yet measured on TPU (projection ~54%
-# 6N-MFU, docs/PERF_NOTES_r4.md). Phase B: BASELINE configs 2/4 + decode
-# via bench_extra. Phase C: fallbacks, sweeps, long-context.
+# Phase A: the headline rungs. As of f6b6242 the code DEFAULTS equal the
+# measured in-window optimum (fused CE x flash 512/512 x fused single-
+# tile backward), so `fused_flash_scan8_qkvlast` IS the winner config —
+# 101.8 ms/step, 53.4% 6N-MFU on v5e. Phase B: BASELINE configs 2/4 +
+# decode via bench_extra. Phase C: fallbacks, sweeps, long-context.
 PHASE_A = [
     ('fused_flash_scan8', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}),
     # the qkv layout copies (~5 ms/step, r4 profile fusion.825 family)
@@ -120,6 +121,11 @@ PHASE_C = [
     ('fused_flash_scan8_bq256_bk512', {'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
                                        'PADDLE_TPU_FLASH_BLOCK_Q': '256',
                                        'PADDLE_TPU_FLASH_BLOCK_K': '512'}),
+    # fused-backward A/B reference (the winner minus one lever)
+    ('fused_flash_scan8_qkvlast_twopassbwd', {
+        'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+        'PADDLE_TPU_QKV_SPLIT': 'last',
+        'PADDLE_TPU_FLASH_FUSED_BWD': '0'}),
 ]
 
 

@@ -552,12 +552,18 @@ def _orchestrate(errors):
     #    the Pallas flash kernel so a kernel-compile failure still yields
     #    an honest number (flash_in_program=false distinguishes it)
     if platform is not None:
-        # best-first from the round-4 in-window measurements
-        # (docs/bench_inwindow_r4.jsonl): fused head+CE and the flash
-        # kernels both on, scan8 amortizing the tunnel's dispatch toll;
-        # then the same without fused CE (not-yet-TPU-proven lever must
-        # not sink the whole ladder), then flash off.
-        ladder = (({'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}, 'fused_flash_scan8'),
+        # best-first from the round-5 in-window measurements
+        # (docs/bench_inwindow_r5.jsonl): the head rung is the measured
+        # optimum — fused CE + flash 512/512 + fused single-tile
+        # backward (all code defaults) + the qkv last-axis split (safe
+        # single-chip; not a default because under tensor parallelism
+        # q/k/v offsets would straddle mp shards), scan8 amortizing the
+        # tunnel's dispatch toll. Then the default-knob rung, then
+        # without fused CE, then flash off.
+        ladder = (({'PADDLE_TPU_BENCH_SCAN_STEPS': '8',
+                    'PADDLE_TPU_QKV_SPLIT': 'last'},
+                   'fused_flash_scan8_qkvlast'),
+                  ({'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}, 'fused_flash_scan8'),
                   (None, 'fused_flash_plain'),
                   ({'PADDLE_TPU_FUSED_CE': '0',
                     'PADDLE_TPU_BENCH_SCAN_STEPS': '8'}, 'flash_scan8'),

@@ -72,6 +72,32 @@ def _synthetic_trace(tmp_path, steps=8, step_us=1000.0):
     return str(tmp_path / 'prof')
 
 
+def test_busy_time_interval_union(tmp_path):
+    # a while/scan parent op's slice covers its body ops; the busy-time
+    # union must count that wall span once, not parent + children
+    dev = 7
+    events = [
+        {'ph': 'M', 'pid': dev, 'name': 'process_name',
+         'args': {'name': '/device:TPU:0'}},
+        {'ph': 'M', 'pid': dev, 'tid': 1, 'name': 'thread_name',
+         'args': {'name': 'XLA Ops'}},
+        # parent covering [0, 1000)
+        {'ph': 'X', 'pid': dev, 'tid': 1, 'ts': 0.0, 'dur': 1000.0,
+         'name': 'while.1', 'args': {}},
+        # children nested inside the parent's span
+        {'ph': 'X', 'pid': dev, 'tid': 1, 'ts': 0.0, 'dur': 600.0,
+         'name': 'fusion.a', 'args': {}},
+        {'ph': 'X', 'pid': dev, 'tid': 1, 'ts': 600.0, 'dur': 300.0,
+         'name': 'fusion.b', 'args': {}},
+        # a disjoint op after an idle gap: [1500, 1700)
+        {'ph': 'X', 'pid': dev, 'tid': 1, 'ts': 1500.0, 'dur': 200.0,
+         'name': 'copy.z', 'args': {}},
+    ]
+    ops, _ = pa.device_ops({'traceEvents': events})
+    assert sum(e['dur'] for e in ops) == pytest.approx(2100.0)  # naive
+    assert pa.busy_us(ops) == pytest.approx(1200.0)             # union
+
+
 def test_synthetic_trace_roundtrip(tmp_path):
     pdir = _synthetic_trace(tmp_path)
     trace, path = pa.load_trace(pdir)

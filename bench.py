@@ -597,9 +597,17 @@ def _orchestrate(errors):
         # effective config are dropped so a hang can't burn two child
         # timeouts on one doomed config.
         best = _best_capture(headline_seq=512)
+        head_extra = None
         if best is not None:
             renv = _capture_replay_env(best)
             if pallas_ok or renv.get('PADDLE_TPU_FLASH_DISABLE') == '1':
+                # the fixed ladder's head may encode a NEWER optimum than
+                # the best logged capture (kernel improvements land
+                # between windows): when the configs differ, run BOTH and
+                # report the faster — one extra ~75s child at round end
+                # buys never reporting a stale number
+                if ladder and _effective_env(ladder[0][0]) !=                         _effective_env(renv):
+                    head_extra = ladder[0]
                 ladder = tuple(
                     (extra, label) for extra, label in ladder
                     if _effective_env(extra) != _effective_env(renv))
@@ -609,6 +617,13 @@ def _orchestrate(errors):
             if result is not None:
                 if label:
                     result['retry'] = label
+                if label == 'best_inwindow_replay' and head_extra                         is not None:
+                    h_res, h_err = _spawn_child(extra_env=head_extra[0])
+                    if h_res is not None and h_res.get('mfu_6n', 0) >                             result.get('mfu_6n', 0):
+                        h_res['retry'] = head_extra[1]
+                        result = h_res
+                    elif h_res is None:
+                        errors.append('head rung: %s' % h_err)
                 # context either way: a degraded result carries the
                 # round's best REAL capture as its evidence; a live TPU
                 # result carries it for comparison (the warmer may have

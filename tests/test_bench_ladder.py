@@ -126,3 +126,39 @@ def test_best_capture_missing_log(monkeypatch, tmp_path):
     monkeypatch.setenv('PADDLE_TPU_BENCH_INWINDOW_LOG',
                        str(tmp_path / 'nope.jsonl'))
     assert b._best_capture() is None
+
+
+def test_replay_plus_head_rung_reports_the_faster(tmp_path, monkeypatch,
+                                                  capsys):
+    """When the fixed ladder's head config differs from the best logged
+    capture (a newer optimum landed between windows), the driver must run
+    BOTH and report the faster — a stale replay may not preempt it."""
+    b = _bench()
+    log = tmp_path / 'inwindow.jsonl'
+    log.write_text(json.dumps({
+        'platform': 'tpu', 'mfu_6n': 0.50, 'seq': 512, 'batch': 32,
+        'scan_steps': 8, 'fused_ce': True, 'flash_in_program': True,
+        'qkv_split': 'last', 'attn_impl': 'auto', 'fused_ce_chunk': 4096,
+        'flash_block_q': 512, 'flash_block_k': 512,
+        'label': 'old_best'}) + '\n')  # legacy row: two-pass bwd pinned
+    monkeypatch.setenv('PADDLE_TPU_BENCH_INWINDOW_LOG', str(log))
+
+    spawned = []
+
+    def fake_spawn(extra_env=None, timeout=None):
+        spawned.append(dict(extra_env or {}))
+        if extra_env and extra_env.get('PADDLE_TPU_FLASH_FUSED_BWD') == '0':
+            return {'mfu_6n': 0.50, 'metric': 'm', 'value': 1.0}, None
+        return {'mfu_6n': 0.53, 'metric': 'm', 'value': 2.0}, None
+
+    monkeypatch.setattr(b, '_spawn_child', fake_spawn)
+    monkeypatch.setattr(b, '_probe_backend', lambda: ('tpu', None))
+    monkeypatch.setattr(b, '_probe_pallas', lambda: (True, None))
+    monkeypatch.setenv('PADDLE_TPU_BENCH_FAST_PROBE', '1')
+    b._orchestrate([])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    res = json.loads(out)
+    # two children ran (replay + head) and the faster one was reported
+    assert len(spawned) == 2
+    assert res['mfu_6n'] == 0.53
+    assert res['retry'] == 'fused_flash_scan8_qkvlast'

@@ -1,0 +1,117 @@
+"""Weight-only int8 quantization for the serving/decode path.
+
+Different animal from the QDQ fake-quant in quant_layers.py: here the
+weights are STORED as int8 and dequantized inside the compiled program, so
+each decode step streams half the weight bytes from HBM. Cached
+autoregressive decode is weight-streaming-bound (see
+bench_extra.bench_gpt_decode's roofline), so halving the streamed bytes
+raises the decode throughput ceiling ~2x on the quantized fraction of the
+weights. The dequant (convert + per-channel scale multiply) happens in
+VMEM and fuses into the matmul operand read under XLA.
+
+Reference counterpart: the inference engine's int8 paths — TensorRT INT8
+calibration (/root/reference/paddle/fluid/inference/tensorrt/
+trt_int8_calibrator.cc) and the MKLDNN quantizer
+(/root/reference/paddle/fluid/inference/api/mkldnn_quantizer.cc) — which
+likewise quantize a trained model for serving without retraining. The
+TPU-native form keeps activations in the compute dtype (weight-only):
+decode activations are tiny [batch, hidden] rows, so activation
+quantization buys no bandwidth and costs accuracy.
+
+Scales are per-output-channel symmetric abs-max over the [in, out] weight
+(same choice as quant_layers' channel-wise axis=1), held as buffers so
+they cross the functional_call/jit boundary with the rest of the state.
+"""
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.core import Tensor
+from ..nn import functional as F
+
+__all__ = ['WeightOnlyLinear', 'quantize_weight_only']
+
+_EPS = 1e-8
+
+
+def _quantize_int8(w):
+    """Per-output-channel symmetric int8: w[in, out] -> (q int8, scale f32)."""
+    wf = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=0) / 127.0, _EPS)
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class WeightOnlyLinear(nn.Layer):
+    """Linear whose weight lives as int8 + per-channel scale buffers.
+
+    Built FROM a trained nn.Linear (same swap-in pattern as
+    slim.QuantedLinear). Inference-only: the int8 buffer is not a
+    Parameter, so nothing here is trainable — training through it raises
+    rather than silently freezing the weight.
+    """
+
+    def __init__(self, layer):
+        super().__init__()
+        w = layer.weight._data
+        self._in_features = layer._in_features
+        self._out_features = layer._out_features
+        # compute dtype follows the source weight (bf16 on TPU serving)
+        self._compute_dtype = w.dtype
+        q, scale = _quantize_int8(w)
+        self.register_buffer('qweight', Tensor(q))
+        self.register_buffer('weight_scale', Tensor(scale))
+        self.bias = layer.bias
+        # inherit the source layer's mode: a model already in eval() must
+        # stay servable after the swap without another .eval() call
+        self.training = layer.training
+
+    def forward(self, x):
+        if self.training:
+            raise RuntimeError(
+                'WeightOnlyLinear is inference-only (int8 weights are not '
+                'trainable) — call model.eval(), or quantize after training')
+        w = (self.qweight._data.astype(self._compute_dtype) *
+             self.weight_scale._data.astype(self._compute_dtype))
+        return F.linear(x, Tensor(w), self.bias)
+
+    def extra_repr(self):
+        return 'in_features=%d, out_features=%d, int8-weight' % (
+            self._in_features, self._out_features)
+
+
+def quantize_weight_only(model, exclude=None):
+    """Swap every nn.Linear sublayer for WeightOnlyLinear, in place.
+
+    exclude: optional predicate (qualified_name, layer) -> bool; True
+    keeps that Linear in full precision (e.g. a final logits head whose
+    accuracy budget is tighter). Returns the number of layers swapped.
+
+    Embeddings stay full precision by design: a gather reads only the
+    touched rows, so there is no bandwidth to win, and the tied-head
+    matmul (GPT wte reuse) shares the same storage.
+    """
+    # snapshot the walk first: swapping children while the generator is
+    # mid-descent would make it recurse into the replacement layers
+    sites = []          # (parent, key, child) for every Linear occurrence
+    excluded = set()    # id(child): exclusion is by layer IDENTITY — if
+    #                     ANY alias of a shared Linear is excluded, every
+    #                     alias stays fp (a partial swap would silently
+    #                     break the sharing)
+    for pname, parent in list(model.named_sublayers(include_self=True)):
+        for key, child in list(parent._sub_layers.items()):
+            if type(child) is nn.Linear:
+                sites.append((parent, key, child))
+                qual = '%s.%s' % (pname, key) if pname else key
+                if exclude is not None and exclude(qual, child):
+                    excluded.add(id(child))
+    swapped = 0
+    done = {}  # id(original) -> replacement: a shared Linear stays shared
+    for parent, key, child in sites:
+        if id(child) in excluded:
+            continue
+        rep = done.get(id(child))
+        if rep is None:
+            rep = done[id(child)] = WeightOnlyLinear(child)
+            swapped += 1
+        parent._sub_layers[key] = rep
+    return swapped

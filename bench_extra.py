@@ -160,39 +160,92 @@ def bench_gpt_decode(on_tpu):
     model.eval()
     rng = np.random.RandomState(0)
     rows = []
-    param_bytes = 2.0 * model.num_params()          # bf16 weights
+
+    def stream_bytes(m):
+        # bytes of model state a decode step streams from HBM: all
+        # params + weight-carrying buffers (int8 qweights count 1 byte)
+        total = 0
+        for _, p in m.named_parameters():
+            total += int(p._data.nbytes)
+        for _, b in m.named_buffers():
+            if b is not None:
+                total += int(b._data.nbytes)
+        return float(total)
+
+    param_bytes = stream_bytes(model)
     hbm = 819e9 if on_tpu else 50e9                 # v5e HBM BW
     # decode is weight-streaming-bound, so tokens/s should scale near-
     # linearly with batch until compute catches up: measure two points
     batches = (batch, batch * 4) if on_tpu else (batch,)
-    for b in batches:
-        try:
-            prompt = paddle.to_tensor(
-                rng.randint(0, cfg.vocab_size, (b, prompt_len)).astype(
-                    np.int32))
-            out = model.generate(prompt,
-                                 max_new_tokens=new_tokens)  # compile
-            _ = out.numpy()
-            t0 = time.time()
-            out = model.generate(prompt, max_new_tokens=new_tokens)
-            _ = out.numpy()
-            dt = time.time() - t0
-        except Exception as e:
-            # a failed larger-batch point must not discard the smaller
-            # one already measured
-            rows.append({'metric': 'gpt_decode_tokens_per_sec',
-                         'batch': b, 'error': repr(e)[:300]})
-            continue
-        toks = b * new_tokens / dt
-        roofline = b * hbm / param_bytes
-        rows.append({'metric': 'gpt_decode_tokens_per_sec',
-                     'value': round(toks, 2),
-                     'unit': 'tokens/sec', 'batch': b,
-                     'tokens_per_sec_per_seq': round(toks / b, 2),
-                     'roofline_tokens_per_sec': round(roofline, 0),
-                     'roofline_frac': round(toks / roofline, 4),
-                     'prompt_len': prompt_len, 'new_tokens': new_tokens,
-                     'degraded': not on_tpu})
+    import os
+    profile_dir = os.environ.get('PADDLE_TPU_BENCH_PROFILE_DECODE')
+
+    def measure(metric, weight_bytes, extra_fields, profiled_batch=None):
+        """One metric's batch sweep; shared protocol for every variant
+        (a drifting copy of the timing loop is how the profiled-run-
+        equals-timed-run bug slipped in)."""
+        for b in batches:
+            try:
+                prompt = paddle.to_tensor(
+                    rng.randint(0, cfg.vocab_size, (b, prompt_len)).astype(
+                        np.int32))
+                out = model.generate(prompt,
+                                     max_new_tokens=new_tokens)  # compile
+                _ = out.numpy()
+                if profiled_batch == b:
+                    # on-chip trace of the already-compiled decode
+                    # program: the data that names the next decode
+                    # byte-mover. The traced run is SEPARATE from the
+                    # timed one below — profiler overhead must not leak
+                    # into the reported tokens/sec
+                    import jax
+                    jax.profiler.start_trace(profile_dir)
+                    try:
+                        _ = model.generate(
+                            prompt, max_new_tokens=new_tokens).numpy()
+                    finally:
+                        # an unmatched start_trace would leave the
+                        # profiler running for every later point
+                        jax.profiler.stop_trace()
+                t0 = time.time()
+                out = model.generate(prompt, max_new_tokens=new_tokens)
+                _ = out.numpy()
+                dt = time.time() - t0
+            except Exception as e:
+                # a failed larger-batch point must not discard the
+                # smaller one already measured
+                rows.append({'metric': metric, 'batch': b,
+                             'error': repr(e)[:300]})
+                continue
+            toks = b * new_tokens / dt
+            roofline = b * hbm / weight_bytes
+            row = {'metric': metric, 'value': round(toks, 2),
+                   'unit': 'tokens/sec', 'batch': b,
+                   'tokens_per_sec_per_seq': round(toks / b, 2),
+                   'roofline_tokens_per_sec': round(roofline, 0),
+                   'roofline_frac': round(toks / roofline, 4),
+                   'prompt_len': prompt_len, 'new_tokens': new_tokens,
+                   'degraded': not on_tpu}
+            row.update(extra_fields)
+            rows.append(row)
+
+    measure('gpt_decode_tokens_per_sec', param_bytes, {},
+            profiled_batch=batch if profile_dir else None)
+
+    # weight-only int8 serving variant (slim.weight_only): halves the
+    # streamed bytes on the transformer Linears — a DIFFERENT model
+    # (quantized weights), reported under its own metric with its own
+    # roofline. Reference analog: AnalysisPredictor int8 deployments.
+    try:
+        from paddle_tpu.slim import quantize_weight_only
+        quantize_weight_only(model)
+        q_bytes = stream_bytes(model)
+    except Exception as e:
+        rows.append({'metric': 'gpt_decode_int8w_tokens_per_sec',
+                     'error': repr(e)[:300]})
+        return rows
+    measure('gpt_decode_int8w_tokens_per_sec', q_bytes,
+            {'stream_bytes_int8': q_bytes, 'stream_bytes_bf16': param_bytes})
     return rows
 
 

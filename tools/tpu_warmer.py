@@ -264,7 +264,18 @@ def run_child(script, extra_env, timeout=1500, snap=REPO):
         proc = subprocess.run([sys.executable, os.path.join(snap, script)],
                               capture_output=True, text=True, env=env,
                               timeout=timeout, cwd=snap)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as te:
+        # salvage rows the child already printed (bench_extra emits one
+        # json line per config as it goes — a timeout on the LAST config
+        # must not discard the earlier measurements)
+        partial = te.stdout
+        if isinstance(partial, bytes):
+            partial = partial.decode('utf-8', 'replace')
+        entries = _json_lines(partial)
+        if entries:
+            log('%s timed out >%ds; salvaged %d already-printed rows'
+                % (script, timeout, len(entries)))
+            return entries, None, time.time() - t0
         return None, 'timeout>%ds' % timeout, time.time() - t0
     entries = _json_lines(proc.stdout)
     if entries:
@@ -388,8 +399,19 @@ class Warmer(object):
         self.maybe_canary(force=True)
 
     def extras(self):
-        entries, err, wall = run_child('bench_extra.py', {}, 1800,
-                                       self.snap)
+        # every window also captures an on-chip decode trace: decode sits
+        # at ~20% of its weight-streaming roofline and the step-level
+        # tok/s numbers cannot say why — the trace names the byte movers
+        dec_pdir = os.path.join(REPO, 'docs', 'tpu_profile_r5_decode')
+        # fresh dir per window: a failed capture must not let the
+        # summarizer re-read LAST window's newest trace stamped with the
+        # current rev (and the multi-MB blobs must not accumulate)
+        if os.path.isdir(dec_pdir):
+            shutil.rmtree(dec_pdir, ignore_errors=True)
+        entries, err, wall = run_child(
+            'bench_extra.py',
+            {'PADDLE_TPU_BENCH_PROFILE_DECODE': dec_pdir}, 1800,
+            self.snap)
         if entries is None:
             self.rec.record('bench_extra', None, err, wall, self.rev)
             log('bench_extra: %s' % err)
@@ -401,6 +423,28 @@ class Warmer(object):
                             dict(entry, wall_shared=True), None, wall,
                             self.rev)
             log('extra %s: %s' % (entry.get('metric'), entry.get('value')))
+        self._summarize_profile(dec_pdir, 'profile_summary_r5_decode.txt',
+                                'gpt_decode b8 (128 new tokens)')
+
+    def _summarize_profile(self, pdir, out_name, rung_label):
+        if not os.path.isdir(pdir):
+            return
+        try:
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, 'tools', 'profile_analysis.py'), pdir],
+                capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                log('profile summary (%s) failed rc=%d: %s'
+                    % (out_name, proc.returncode, (proc.stderr or '')[-300:]))
+                return
+            out_path = os.path.join(REPO, 'docs', out_name)
+            with open(out_path, 'w') as f:
+                f.write('rung: %s (rev %s)\n%s'
+                        % (rung_label, self.rev, proc.stdout))
+            log('profile summary -> %s' % out_path)
+        except Exception as e:
+            log('profile summary (%s) failed: %r' % (out_name, e))
 
     def profile_best(self):
         """Capture an on-chip profile of the best rung — the data that
@@ -422,23 +466,7 @@ class Warmer(object):
             label, 'ok -> %s' % pdir if result is not None else err, wall))
         if result is None:
             return
-        try:
-            proc = subprocess.run(
-                [sys.executable,
-                 os.path.join(REPO, 'tools', 'profile_analysis.py'), pdir],
-                capture_output=True, text=True, timeout=120)
-            if proc.returncode != 0:
-                log('profile summary failed rc=%d: %s'
-                    % (proc.returncode, (proc.stderr or '')[-300:]))
-            else:
-                out_path = os.path.join(REPO, 'docs',
-                                        'profile_summary_r5.txt')
-                with open(out_path, 'w') as f:
-                    f.write('rung: %s (rev %s)\n%s'
-                            % (label, self.rev, proc.stdout))
-                log('profile summary -> %s' % out_path)
-        except Exception as e:
-            log('profile summary failed: %r' % (e,))
+        self._summarize_profile(pdir, 'profile_summary_r5.txt', label)
 
 
 def main():

@@ -1,0 +1,64 @@
+"""C inference API (reference: paddle/fluid/inference/capi_exp/ — the
+plain-C surface over the predictor that the reference's Go API and
+third-party runtimes build on).
+
+`build_capi()` compiles libpaddle_tpu_c.so from pd_capi.cc with the
+host CPython's embed flags (g++, content-hashed artifact cache — the same
+JIT pattern as utils.cpp_extension). C programs include pd_capi.h, link
+against the .so, call PD_Init(repo_root) once, then drive Config /
+Predictor / Run exactly like the Python surface.
+
+R / Go bindings remain waived (no R or Go toolchain in the image); this
+C ABI is the layer both would wrap.
+"""
+import hashlib
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+__all__ = ['build_capi', 'header_path']
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def header_path():
+    return os.path.join(_DIR, 'pd_capi.h')
+
+
+def _embed_flags():
+    inc = sysconfig.get_path('include')
+    libdir = sysconfig.get_config_var('LIBDIR') or ''
+    ver = sysconfig.get_config_var('LDVERSION') or \
+        sysconfig.get_config_var('VERSION')
+    cflags = ['-I', inc]
+    ldflags = ['-L', libdir, '-lpython%s' % ver, '-ldl', '-lm']
+    return cflags, ldflags
+
+
+def build_capi(build_directory=None, verbose=False):
+    """Compile (or reuse) libpaddle_tpu_c.so; returns its path."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), 'paddle_tpu_capi')
+    os.makedirs(build_dir, exist_ok=True)
+    src = os.path.join(_DIR, 'pd_capi.cc')
+    cflags, ldflags = _embed_flags()
+    key = hashlib.sha256()
+    for path in (src, header_path()):
+        with open(path, 'rb') as f:
+            key.update(f.read())
+    # flags are part of the identity: an interpreter upgrade changes
+    # -lpythonX.Y and must not reuse a .so linked against the old one
+    key.update(' '.join(cflags + ldflags).encode())
+    out = os.path.join(build_dir,
+                       'libpaddle_tpu_c_%s.so' % key.hexdigest()[:12])
+    if os.path.exists(out):
+        return out
+    cmd = (['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-I', _DIR]
+           + cflags + ['-o', out, src] + ldflags)
+    if verbose:
+        print('compiling:', ' '.join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError('capi build failed:\n%s' % proc.stderr[-2000:])
+    return out

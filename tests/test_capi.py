@@ -1,0 +1,159 @@
+"""C inference API end-to-end (reference pattern: the capi_exp tests —
+paddle/fluid/inference/tests/api/ exercising the C surface against a
+saved model).
+
+Builds libpaddle_tpu_c.so (CPython-embedding shared lib), compiles a
+real C client with gcc, runs it in a subprocess against a jit.save'd
+model, and compares the printed outputs with the in-process Python
+predictor bit-for-bit (same platform, same executable path).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_C = r'''
+#include <stdio.h>
+#include <stdlib.h>
+#include "pd_capi.h"
+
+int main(int argc, char** argv) {
+  /* argv: repo_root model_dir [--no-init] */
+  if (argc < 3) { fprintf(stderr, "usage: client repo model\n"); return 2; }
+  if (argc > 3) {
+    /* pre-init calls must fail with an error, not crash the process */
+    PD_Config* c0 = PD_ConfigCreate();
+    PD_ConfigSetModel(c0, argv[2]);
+    PD_Predictor* p0 = PD_PredictorCreate(c0);
+    PD_ConfigDestroy(c0);
+    if (p0 != NULL) { fprintf(stderr, "pre-init create succeeded?\n"); return 10; }
+    fprintf(stderr, "pre-init: %s\n", PD_GetLastError());
+    return 0;
+  }
+  if (PD_Init(argv[1]) != 0) {
+    fprintf(stderr, "init: %s\n", PD_GetLastError());
+    return 3;
+  }
+  PD_Config* cfg = PD_ConfigCreate();
+  PD_ConfigSetModel(cfg, argv[2]);
+  PD_ConfigSetDevice(cfg, "cpu");
+  PD_Predictor* pred = PD_PredictorCreate(cfg);
+  PD_ConfigDestroy(cfg);
+  if (!pred) { fprintf(stderr, "create: %s\n", PD_GetLastError()); return 4; }
+
+  int n_in = PD_PredictorGetInputNum(pred);
+  char name[128];
+  if (n_in < 1 || PD_PredictorGetInputName(pred, 0, name, 128) < 0) {
+    fprintf(stderr, "inputs: %s\n", PD_GetLastError());
+    return 5;
+  }
+  float data[2 * 8];
+  for (int i = 0; i < 16; ++i) data[i] = 0.125f * (float)(i - 8);
+  int64_t shape[2] = {2, 8};
+  if (PD_PredictorSetInputFloat(pred, name, data, shape, 2) != 0 ||
+      PD_PredictorRun(pred) != 0) {
+    fprintf(stderr, "run: %s\n", PD_GetLastError());
+    return 6;
+  }
+  if (PD_PredictorGetOutputNum(pred) < 1) { return 7; }
+  int64_t oshape[8];
+  int rank = PD_PredictorGetOutputShape(pred, 0, oshape, 8);
+  if (rank < 0) { fprintf(stderr, "shape: %s\n", PD_GetLastError()); return 8; }
+  printf("rank %d\n", rank);
+  for (int i = 0; i < rank; ++i) printf("dim %lld\n", (long long)oshape[i]);
+  float out[256];
+  int64_t n = PD_PredictorGetOutputFloat(pred, 0, out, 256);
+  if (n < 0 || n > 256) { fprintf(stderr, "out: %s\n", PD_GetLastError()); return 9; }
+  for (int64_t i = 0; i < n; ++i) printf("%.8e\n", out[i]);
+  PD_PredictorDestroy(pred);
+  /* error surface: an invalid call after destroy must fail, not crash */
+  return 0;
+}
+'''
+
+
+@pytest.fixture(scope='module')
+def capi_lib():
+    from paddle_tpu.capi import build_capi
+    try:
+        return build_capi()
+    except RuntimeError as e:
+        pytest.skip('capi build unavailable: %s' % e)
+
+
+@pytest.fixture(scope='module')
+def saved_model(tmp_path_factory):
+    paddle.seed(1234)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path_factory.mktemp('capi') / 'mlp')
+    from paddle_tpu.static import InputSpec
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([2, 8], name='features')])
+    x = (0.125 * (np.arange(16, dtype=np.float32) - 8)).reshape(2, 8)
+    ref = model(paddle.to_tensor(x)).numpy()
+    return path, ref
+
+
+def _build_client(lib, tmpdir):
+    from paddle_tpu.capi import header_path
+    src = os.path.join(tmpdir, 'client.c')
+    with open(src, 'w') as f:
+        f.write(CLIENT_C)
+    exe = os.path.join(tmpdir, 'client')
+    cmd = ['gcc', '-O1', '-o', exe, src,
+           '-I', os.path.dirname(header_path()), lib,
+           '-Wl,-rpath,' + os.path.dirname(lib)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    return exe
+
+
+def test_c_client_matches_python_predictor(capi_lib, saved_model, tmp_path):
+    model_path, ref = saved_model
+    exe = _build_client(capi_lib, str(tmp_path))
+    env = dict(os.environ)
+    # the embedded interpreter must resolve the venv's packages AND the
+    # repo; the C side only prepends the repo root
+    env['PYTHONPATH'] = os.pathsep.join(
+        [p for p in sys.path if p and os.path.isdir(p)])
+    env.pop('XLA_FLAGS', None)  # no virtual-device mesh inside the client
+    proc = subprocess.run([exe, REPO, model_path], capture_output=True,
+                          text=True, env=env, timeout=600)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    lines = proc.stdout.strip().splitlines()
+    rank = int(lines[0].split()[1])
+    dims = [int(l.split()[1]) for l in lines[1:1 + rank]]
+    vals = np.array([float(l) for l in lines[1 + rank:]], np.float32)
+    assert dims == list(ref.shape)
+    np.testing.assert_allclose(vals.reshape(ref.shape), ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_c_client_pre_init_fails_cleanly(capi_lib, tmp_path):
+    exe = _build_client(capi_lib, str(tmp_path))
+    proc = subprocess.run([exe, REPO, 'ignored', '--no-init'],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.returncode, proc.stderr)
+    assert 'PD_Init has not been called' in proc.stderr
+
+
+def test_c_client_reports_bad_model_path(capi_lib, tmp_path):
+    exe = _build_client(capi_lib, str(tmp_path))
+    env = dict(os.environ)
+    env['PYTHONPATH'] = os.pathsep.join(
+        [p for p in sys.path if p and os.path.isdir(p)])
+    env.pop('XLA_FLAGS', None)
+    proc = subprocess.run([exe, REPO, str(tmp_path / 'nope')],
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+    # create must fail cleanly through PD_GetLastError, not crash
+    assert proc.returncode == 4, (proc.returncode, proc.stderr)
+    assert 'create:' in proc.stderr

@@ -54,11 +54,21 @@ def build_capi(build_directory=None, verbose=False):
                        'libpaddle_tpu_c_%s.so' % key.hexdigest()[:12])
     if os.path.exists(out):
         return out
+    # compile to a private temp path and rename into place: two builders
+    # racing on a cache miss each write their own file, and a concurrent
+    # dlopen can never map a half-written library (rename is atomic on
+    # the same filesystem)
+    tmp = '%s.tmp.%d' % (out, os.getpid())
     cmd = (['g++', '-O2', '-shared', '-fPIC', '-std=c++17', '-I', _DIR]
-           + cflags + ['-o', out, src] + ldflags)
+           + cflags + ['-o', tmp, src] + ldflags)
     if verbose:
         print('compiling:', ' '.join(cmd))
     proc = subprocess.run(cmd, capture_output=True, text=True)
     if proc.returncode != 0:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
         raise RuntimeError('capi build failed:\n%s' % proc.stderr[-2000:])
+    os.rename(tmp, out)
     return out

@@ -51,6 +51,13 @@ class Gil {
 
 // Call a bridge function; returns new reference or nullptr (error set).
 PyObject* bridge_call(const char* fn, PyObject* args) {
+  if (args == nullptr) {
+    // a failed Py_BuildValue at the call site: surface the REAL Python
+    // error instead of calling the bridge with zero args and reporting
+    // the resulting misleading TypeError
+    capture_py_error(fn);
+    return nullptr;
+  }
   if (g_bridge == nullptr) {
     g_err = "PD_Init has not been called";
     Py_XDECREF(args);
@@ -214,13 +221,26 @@ int PD_PredictorSetInputFloat(PD_Predictor* predictor, const char* name,
   if (!pd_ready("PD_PredictorSetInputFloat")) return -1;
   Gil gil;
   PyObject* dims = PyTuple_New(ndim);
-  for (int i = 0; i < ndim; ++i) {
-    PyTuple_SET_ITEM(dims, i, PyLong_FromLongLong(shape[i]));
+  if (dims == nullptr) {
+    capture_py_error("PD_PredictorSetInputFloat");
+    return -1;
   }
-  PyObject* out = bridge_call(
-      "set_input_f32",
-      Py_BuildValue("(lsKN)", predictor->handle, name,
-                    (unsigned long long)(uintptr_t)data, dims));
+  for (int i = 0; i < ndim; ++i) {
+    PyObject* d = PyLong_FromLongLong(shape[i]);
+    if (d == nullptr) {
+      capture_py_error("PD_PredictorSetInputFloat");
+      Py_DECREF(dims);
+      return -1;
+    }
+    PyTuple_SET_ITEM(dims, i, d);
+  }
+  // "O" (not "N"): we keep our reference and drop it ourselves, so a
+  // Py_BuildValue failure cannot leak the dims tuple
+  PyObject* args =
+      Py_BuildValue("(lsKO)", predictor->handle, name,
+                    (unsigned long long)(uintptr_t)data, dims);
+  Py_DECREF(dims);
+  PyObject* out = bridge_call("set_input_f32", args);
   if (out == nullptr) return -1;
   Py_DECREF(out);
   return 0;

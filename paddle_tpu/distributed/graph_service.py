@@ -9,21 +9,27 @@ the reference so GNN training code ports directly: load_edge_file,
 random_sample_neighboors, random_sample_nodes, pull_graph_list,
 get_node_feat, add_graph_node, remove_graph_node (remove = tombstone).
 """
-import pickle
-import socket
 import socketserver
-import struct
 import threading
 
 import numpy as np
 
 from ..native.graph_store import GraphStore
 from .ps.embedding_service import _send_msg, _recv_msg
+from .resilience import Deadline, ResilientChannel, RetryPolicy
 
 __all__ = ['GraphPyService', 'GraphPyServer', 'GraphPyClient']
 
 
 class _GraphHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # registry lets chaos.kill_server sever established connections,
+        # not just the listener — a killed pod drops both
+        self.server.live_connections.add(self.request)
+
+    def finish(self):
+        self.server.live_connections.discard(self.request)
+
     def handle(self):
         store_map = self.server.stores
         while True:
@@ -80,14 +86,20 @@ class _GraphHandler(socketserver.BaseRequestHandler):
                 _send_msg(self.request, {'error': repr(e)})
 
 
+class _GraphTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    # rebinding the port right after a kill must not wait out TIME_WAIT:
+    # restart-on-the-same-endpoint is the recovery path under test
+    allow_reuse_address = True
+
+
 class GraphPyServer:
     """One graph shard server (graph_brpc_server parity)."""
 
     def __init__(self, rank=0, host='127.0.0.1', port=0, edge_types=('default',)):
-        self._srv = socketserver.ThreadingTCPServer((host, port),
-                                                    _GraphHandler)
-        self._srv.daemon_threads = True
+        self._srv = _GraphTCPServer((host, port), _GraphHandler)
         self._srv.stores = {et: GraphStore() for et in edge_types}
+        self._srv.live_connections = set()
         self.port = self._srv.server_address[1]
         self.rank = rank
 
@@ -105,21 +117,33 @@ class GraphPyServer:
 
 class GraphPyClient:
     """Key-sharded client (graph_brpc_client parity): node id % n_servers
-    selects the shard; batch ops split/merge per shard."""
+    selects the shard; batch ops split/merge per shard.
 
-    def __init__(self, endpoints):
-        self._socks = []
-        self._locks = []
-        for ep in endpoints:
-            host, port = ep.rsplit(':', 1)
-            self._socks.append(socket.create_connection((host, int(port))))
-            self._locks.append(threading.Lock())
+    Transport is a ResilientChannel per shard: socket timeouts, reconnect
+    + retry for idempotent ops, circuit breaker per endpoint. Mutations
+    that are NOT safe to blind-resend (add_edges — a resend after an
+    applied-but-unacked write would duplicate edges) run single-attempt;
+    everything else retries across reconnects. `op_deadline` (seconds)
+    bounds each public operation across all its shards and retries.
+    """
+
+    def __init__(self, endpoints, retry_policy=None, call_timeout=None,
+                 op_deadline=None):
+        self._channels = [
+            ResilientChannel(ep, retry_policy=retry_policy,
+                             **({'call_timeout': call_timeout}
+                                if call_timeout is not None else {}))
+            for ep in endpoints]
         self._n = len(endpoints)
+        self._op_deadline = op_deadline
 
-    def _call(self, server_idx, msg):
-        with self._locks[server_idx]:
-            _send_msg(self._socks[server_idx], msg)
-            out = _recv_msg(self._socks[server_idx])
+    def _deadline(self):
+        return None if self._op_deadline is None \
+            else Deadline(self._op_deadline)
+
+    def _call(self, server_idx, msg, idempotent=True, deadline=None):
+        out = self._channels[server_idx].call(msg, idempotent=idempotent,
+                                              deadline=deadline)
         if isinstance(out, dict) and 'error' in out:
             raise RuntimeError(out['error'])
         return out
@@ -129,36 +153,44 @@ class GraphPyClient:
         return ids, ids % self._n
 
     def add_graph_node(self, etype, ids, weight_list=None):
+        # idempotent: adding an existing node is a no-op on the store
         ids, shard = self._shard(ids)
+        dl = self._deadline()
         for s in range(self._n):
             sub = ids[shard == s]
             if len(sub):
                 self._call(s, {'op': 'add_nodes', 'etype': etype,
-                               'ids': sub.tolist()})
+                               'ids': sub.tolist()}, deadline=dl)
 
     def remove_graph_node(self, etype, ids):
+        # idempotent: remove is a tombstone, a resend re-tombstones
         ids, shard = self._shard(ids)
+        dl = self._deadline()
         removed = 0
         for s in range(self._n):
             sub = ids[shard == s]
             if len(sub):
                 removed += self._call(s, {'op': 'remove_nodes',
                                           'etype': etype,
-                                          'ids': sub.tolist()})
+                                          'ids': sub.tolist()},
+                                      deadline=dl)
         return removed
 
     def add_edges(self, etype, src, dst, weight=None):
         src, shard = self._shard(src)
         dst = np.asarray(dst, np.int64)
         w = np.asarray(weight, np.float32) if weight is not None else None
+        dl = self._deadline()
         for s in range(self._n):
             m = shard == s
             if m.any():
+                # NOT idempotent: the store appends, so a blind resend
+                # after an applied-but-unacked write duplicates edges
                 self._call(s, {'op': 'add_edges', 'etype': etype,
                                'src': src[m].tolist(),
                                'dst': dst[m].tolist(),
                                'weight': w[m].tolist() if w is not None
-                               else None})
+                               else None}, idempotent=False, deadline=dl)
 
     def load_edge_file(self, etype, path, reversed=False):
         """Each server loads the rows whose src hashes to it; for the local
@@ -175,13 +207,15 @@ class GraphPyClient:
     def random_sample_neighboors(self, etype, ids, sample_size):
         # (sic) reference spells it "neighboors"
         ids, shard = self._shard(ids)
+        dl = self._deadline()
         out = np.full((len(ids), sample_size), -1, np.int64)
         for s in range(self._n):
             m = shard == s
             if m.any():
                 res = self._call(s, {'op': 'sample_neighbors', 'etype': etype,
                                      'ids': ids[m].tolist(),
-                                     'sample_size': sample_size})
+                                     'sample_size': sample_size},
+                                 deadline=dl)
                 out[m] = res
         return out
 
@@ -190,57 +224,62 @@ class GraphPyClient:
     def random_sample_nodes(self, etype, server_idx, k):
         return self._call(server_idx % self._n,
                           {'op': 'random_sample_nodes', 'etype': etype,
-                           'k': k})
+                           'k': k}, deadline=self._deadline())
 
     def pull_graph_list(self, etype, server_idx, shard, cursor, cap):
         return self._call(server_idx % self._n,
                           {'op': 'pull_graph_list', 'etype': etype,
-                           'shard': shard, 'cursor': cursor, 'cap': cap})
+                           'shard': shard, 'cursor': cursor, 'cap': cap},
+                          deadline=self._deadline())
 
     def get_node_feat(self, etype, ids, dim):
         ids, shard = self._shard(ids)
+        dl = self._deadline()
         out = np.zeros((len(ids), dim), np.float32)
         for s in range(self._n):
             m = shard == s
             if m.any():
                 out[m] = self._call(s, {'op': 'get_node_feat', 'etype': etype,
-                                        'ids': ids[m].tolist(), 'dim': dim})
+                                        'ids': ids[m].tolist(), 'dim': dim},
+                                    deadline=dl)
         return out
 
     def set_node_feat(self, etype, ids, feats):
+        # idempotent: a resend re-writes the same feature values
         ids, shard = self._shard(ids)
         feats = np.asarray(feats, np.float32)
+        dl = self._deadline()
         for s in range(self._n):
             m = shard == s
             if m.any():
                 self._call(s, {'op': 'set_node_feat', 'etype': etype,
                                'ids': ids[m].tolist(),
-                               'feats': feats[m].tolist()})
+                               'feats': feats[m].tolist()}, deadline=dl)
 
     def get_degree(self, etype, ids):
         ids, shard = self._shard(ids)
+        dl = self._deadline()
         out = np.zeros(len(ids), np.int64)
         for s in range(self._n):
             m = shard == s
             if m.any():
                 out[m] = self._call(s, {'op': 'degree', 'etype': etype,
-                                        'ids': ids[m].tolist()})
+                                        'ids': ids[m].tolist()},
+                                    deadline=dl)
         return out
 
     def stop_server(self):
         for s in range(self._n):
             try:
-                self._call(s, {'op': 'stop'})
+                # single attempt: a dead server IS the desired end state
+                self._call(s, {'op': 'stop'}, idempotent=False)
             except Exception:
                 pass
         self.close()
 
     def close(self):
-        for sock in self._socks:
-            try:
-                sock.close()
-            except OSError:
-                pass
+        for ch in self._channels:
+            ch.close()
 
 
 class GraphPyService:

@@ -14,12 +14,13 @@ multi-host — brpc+protobuf's role, without the dependency. Server-side optimiz
 appliers mirror table/depends/sparse.h (sgd/adagrad/adam).
 """
 import os
-import socket
 import socketserver
 import struct
 import threading
 
 import numpy as np
+
+from ..resilience import Deadline, ResilientChannel, call_once
 
 __all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient',
            'CountFilterEntry', 'ProbabilityEntry']
@@ -212,6 +213,14 @@ def _recv_msg(sock):
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        # registry lets chaos.kill_server sever established connections,
+        # not just the listener — a killed pod drops both
+        self.server.live_connections.add(self.request)
+
+    def finish(self):
+        self.server.live_connections.discard(self.request)
+
     def handle(self):
         server = self.server.embedding_server
         while True:
@@ -271,15 +280,22 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
 
 
+class _PsTCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    # restart-on-the-same-endpoint is the recovery path: rebinding right
+    # after a kill must not wait out TIME_WAIT
+    allow_reuse_address = True
+
+
 class EmbeddingServer:
     """One PS shard process (BrpcPsServer parity, socket transport)."""
 
     def __init__(self, host='127.0.0.1', port=0):
         self._tables = {}
-        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
-                                                    bind_and_activate=True)
-        self._srv.daemon_threads = True
+        self._srv = _PsTCPServer((host, port), _Handler,
+                                 bind_and_activate=True)
         self._srv.embedding_server = self
+        self._srv.live_connections = set()
         self.port = self._srv.server_address[1]
         self.endpoint = '%s:%d' % (host, self.port)
         self._thread = None
@@ -330,53 +346,62 @@ class EmbeddingServer:
 
 class EmbeddingClient:
     """Key-sharded client over N servers (BrpcPsClient parity): shard by
-    id % nshards, batch per-shard, parallel requests."""
+    id % nshards, batch per-shard, parallel requests.
 
-    def __init__(self, endpoints=None, servers=None):
+    Remote transport is a ResilientChannel per shard (socket timeouts,
+    reconnect + retry for idempotent ops, per-endpoint circuit breaker).
+    Reads (pull/pull_dense/tensor-get) and overwrites (set_dense) retry
+    transparently; grad applications (push/push_delta/push_dense) are NOT
+    idempotent — the server may have applied an unacked op, and resending
+    would double-apply — so they run single-attempt and surface a
+    RetryableError for the communicator's own error path. `op_deadline`
+    (seconds) bounds each public op across all shards and retries.
+    """
+
+    def __init__(self, endpoints=None, servers=None, retry_policy=None,
+                 call_timeout=None, op_deadline=None):
         self._local = servers  # in-proc mode: list of EmbeddingServer
-        self._socks = None
+        self._channels = None
         self._endpoints = endpoints
+        self._op_deadline = op_deadline
         if endpoints and not servers:
-            self._socks = []
-            for ep in endpoints:
-                host, port = ep.rsplit(':', 1)
-                s = socket.create_connection((host, int(port)))
-                self._socks.append(s)
+            kw = {} if call_timeout is None else \
+                {'call_timeout': call_timeout}
+            self._channels = [ResilientChannel(ep,
+                                               retry_policy=retry_policy,
+                                               **kw)
+                              for ep in endpoints]
         self._n = len(servers or endpoints)
-        # one lock per server connection: a slow op against one shard
-        # must not serialize traffic to the others
-        self._locks = [threading.Lock() for _ in range(self._n)]
 
     def _shard(self, ids):
         ids = np.asarray(ids, np.int64)
         shard_idx = ids % self._n
         return ids, shard_idx
 
-    def _call(self, s, msg):
+    def _deadline(self):
+        return None if self._op_deadline is None \
+            else Deadline(self._op_deadline)
+
+    def _call(self, s, msg, idempotent=True, deadline=None):
         """Remote call to server s with error propagation."""
-        with self._locks[s]:
-            _send_msg(self._socks[s], msg)
-            out = _recv_msg(self._socks[s])
+        out = self._channels[s].call(msg, idempotent=idempotent,
+                                     deadline=deadline)
         if isinstance(out, dict) and 'error' in out:
             raise RuntimeError(out['error'])
         return out
 
-    def _call_fresh(self, s, msg):
+    def _call_fresh(self, s, msg, timeout=None):
         """Blocking RPC (e.g. barrier) over a NEW ephemeral connection so
-        the persistent per-server socket stays free for fast ops."""
-        host, port = self._endpoints[s].rsplit(':', 1)
-        sock = socket.create_connection((host, int(port)))
-        try:
-            _send_msg(sock, msg)
-            out = _recv_msg(sock)
-        finally:
-            sock.close()
+        the persistent per-server channel stays free for fast ops."""
+        kw = {} if timeout is None else {'timeout': timeout}
+        out = call_once(self._endpoints[s], msg, **kw)
         if isinstance(out, dict) and 'error' in out:
             raise RuntimeError(out['error'])
         return out
 
     def pull(self, table_id, ids):
         ids, shard_idx = self._shard(ids)
+        dl = self._deadline()
         out = np.empty((len(ids), self._dim(table_id)), np.float32)
         for s in range(self._n):
             mask = shard_idx == s
@@ -387,13 +412,14 @@ class EmbeddingClient:
                 rows = self._local[s].table(table_id).pull(sub.tolist())
             else:
                 rows = self._call(s, {'op': 'pull', 'table': table_id,
-                                      'ids': sub.tolist()})
+                                      'ids': sub.tolist()}, deadline=dl)
             out[mask] = rows
         return out
 
     def push(self, table_id, ids, grads):
         ids, shard_idx = self._shard(ids)
         grads = np.asarray(grads, np.float32)
+        dl = self._deadline()
         for s in range(self._n):
             mask = shard_idx == s
             if not mask.any():
@@ -402,9 +428,11 @@ class EmbeddingClient:
                 self._local[s].table(table_id).push(ids[mask].tolist(),
                                                     grads[mask])
             else:
+                # grad application is not idempotent: no blind resend
                 self._call(s, {'op': 'push', 'table': table_id,
                                'ids': ids[mask].tolist(),
-                               'grads': grads[mask]})
+                               'grads': grads[mask]}, idempotent=False,
+                           deadline=dl)
 
     def _dim(self, table_id):
         if self._local is not None:
@@ -417,6 +445,7 @@ class EmbeddingClient:
         """Geo-SGD path: add parameter deltas on the server."""
         ids, shard_idx = self._shard(ids)
         deltas = np.asarray(deltas, np.float32)
+        dl = self._deadline()
         for s in range(self._n):
             mask = shard_idx == s
             if not mask.any():
@@ -427,7 +456,8 @@ class EmbeddingClient:
             else:
                 self._call(s, {'op': 'push_delta', 'table': table_id,
                                'ids': ids[mask].tolist(),
-                               'deltas': deltas[mask]})
+                               'deltas': deltas[mask]}, idempotent=False,
+                           deadline=dl)
 
     # -- dense / barrier / tensor tables (placed by table_id % n) -----------
     def _owner(self, table_id):
@@ -437,21 +467,26 @@ class EmbeddingClient:
         s = self._owner(table_id)
         if self._local is not None:
             return self._local[s].table(table_id).pull()
-        return self._call(s, {'op': 'pull_dense', 'table': table_id})
+        return self._call(s, {'op': 'pull_dense', 'table': table_id},
+                          deadline=self._deadline())
 
     def push_dense(self, table_id, grad):
         s = self._owner(table_id)
         if self._local is not None:
             return self._local[s].table(table_id).push(grad)
+        # grad application is not idempotent: no blind resend
         self._call(s, {'op': 'push_dense', 'table': table_id,
-                       'grad': np.asarray(grad, np.float32)})
+                       'grad': np.asarray(grad, np.float32)},
+                   idempotent=False, deadline=self._deadline())
 
     def set_dense(self, table_id, value):
         s = self._owner(table_id)
         if self._local is not None:
             return self._local[s].table(table_id).set(value)
+        # overwrite semantics: a resend re-writes the same value
         self._call(s, {'op': 'set_dense', 'table': table_id,
-                       'value': np.asarray(value, np.float32)})
+                       'value': np.asarray(value, np.float32)},
+                   deadline=self._deadline())
 
     def barrier(self, table_id, worker_id=None, timeout=60.0):
         s = self._owner(table_id)
@@ -459,21 +494,29 @@ class EmbeddingClient:
             return self._local[s].table(table_id).barrier(worker_id,
                                                           timeout)
         # ephemeral connection: a blocking barrier must not pin the shared
-        # per-server socket (other threads' pulls/pushes keep flowing)
+        # per-server channel (other threads' pulls/pushes keep flowing).
+        # Transport timeout = barrier timeout + slack, so a wedged server
+        # surfaces as a socket timeout instead of a hang.
         self._call_fresh(s, {'op': 'barrier', 'table': table_id,
-                             'worker_id': worker_id, 'timeout': timeout})
+                             'worker_id': worker_id, 'timeout': timeout},
+                         timeout=timeout + 10.0)
 
     def tensor(self, table_id, method, *args):
         s = self._owner(table_id)
         if self._local is not None:
             return getattr(self._local[s].table(table_id), method)(*args)
+        # set/get re-send safely; increment would double-count
         return self._call(s, {'op': 'tensor', 'table': table_id,
-                              'method': method, 'args': args})
+                              'method': method, 'args': args},
+                          idempotent=(method != 'increment'),
+                          deadline=self._deadline())
 
     def save(self, table_id, path):
+        dl = self._deadline()
         for s in range(self._n):
             p = os.path.join(path, 'shard_%d' % s)
             if self._local is not None:
                 self._local[s].table(table_id).save(p)
             else:
-                self._call(s, {'op': 'save', 'table': table_id, 'path': p})
+                self._call(s, {'op': 'save', 'table': table_id, 'path': p},
+                           deadline=dl)

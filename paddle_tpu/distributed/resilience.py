@@ -1,0 +1,358 @@
+"""Shared RPC resilience layer (reference: the brpc PS/graph services —
+brpc_ps_client.cc retry/timeout knobs, graph_brpc_client reconnect — made
+a first-class subsystem instead of per-callsite copy-paste).
+
+Three pieces compose:
+
+- ``RetryPolicy``: exponential backoff with jitter, a max-attempt cap,
+  and retryable-exception classification (connection resets / timeouts
+  retry; protocol and application errors never do).
+- ``Deadline``: an absolute wall-clock budget shared across every
+  attempt of a call (and across a multi-shard fan-out) — retries must
+  tighten, never extend, the caller's wait.
+- ``ResilientChannel``: one endpoint's framed-message connection with
+  socket timeouts, transparent reconnect-and-retry for idempotent ops,
+  and a half-open circuit breaker so a dead shard fails fast instead of
+  burning a full backoff ladder per call.
+
+Fault injection for tests rides through ``_fire()``: the hooks list is
+empty (zero cost) until paddle_tpu.testing.chaos installs injectors.
+"""
+import errno
+import random
+import socket
+import struct
+import threading
+import time
+
+__all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
+           'RpcError', 'RetryableError', 'DeadlineExceeded',
+           'CircuitOpenError', 'DEFAULT_CALL_TIMEOUT',
+           'DEFAULT_CONNECT_TIMEOUT']
+
+DEFAULT_CALL_TIMEOUT = 30.0      # per-attempt send+recv budget (seconds)
+DEFAULT_CONNECT_TIMEOUT = 5.0
+
+
+# -- fault-injection hook points (see paddle_tpu/testing/chaos.py) ----------
+# Each hook is `fn(point, endpoint)` where point is one of 'connect',
+# 'send', 'recv'. Hooks may sleep (delay faults) or raise (drop faults).
+_FAULT_HOOKS = []
+
+
+def _fire(point, endpoint):
+    for hook in list(_FAULT_HOOKS):
+        hook(point, endpoint)
+
+
+# -- error taxonomy ---------------------------------------------------------
+class RpcError(Exception):
+    """Base for transport-level RPC failures (application-level errors —
+    the server's {'error': ...} replies — stay plain RuntimeError)."""
+
+
+class RetryableError(RpcError):
+    """Transport failure that a fresh connection may fix; raised once the
+    retry budget (attempts or deadline) is exhausted."""
+
+    def __init__(self, msg, endpoint=None, attempts=0):
+        super().__init__(msg)
+        self.endpoint = endpoint
+        self.attempts = attempts
+
+
+class DeadlineExceeded(RetryableError):
+    """The caller's deadline lapsed before any attempt succeeded."""
+
+
+class CircuitOpenError(RetryableError):
+    """Fast-fail: the endpoint's breaker is open (recent failures, the
+    reset window has not elapsed). Callers should back off or re-shard."""
+
+
+# transient socket errnos worth a reconnect (vs e.g. EACCES/EBADF bugs)
+_RETRYABLE_ERRNOS = frozenset({
+    errno.ECONNREFUSED, errno.ECONNRESET, errno.ECONNABORTED,
+    errno.EPIPE, errno.ETIMEDOUT, errno.EHOSTUNREACH, errno.ENETUNREACH,
+    errno.ENETRESET, errno.EAGAIN,
+})
+
+
+class RetryPolicy:
+    """Exponential backoff + full jitter, capped attempts, and the
+    retryable/terminal classification used by ResilientChannel."""
+
+    def __init__(self, max_attempts=4, base_delay=0.05, max_delay=2.0,
+                 multiplier=2.0, jitter=0.5, retryable_exceptions=None):
+        if max_attempts < 1:
+            raise ValueError('max_attempts must be >= 1')
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self._extra_retryable = tuple(retryable_exceptions or ())
+
+    def is_retryable(self, exc):
+        if isinstance(exc, self._extra_retryable):
+            return True
+        if isinstance(exc, (socket.timeout, TimeoutError, ConnectionError,
+                            BrokenPipeError, EOFError)):
+            return True
+        if isinstance(exc, OSError):
+            return exc.errno in _RETRYABLE_ERRNOS or exc.errno is None
+        return False
+
+    def backoff(self, attempt):
+        """Delay before retry number `attempt` (1-based), jittered."""
+        d = min(self.base_delay * (self.multiplier ** (attempt - 1)),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * random.random()
+        return d
+
+
+class Deadline:
+    """Absolute time budget. All attempts of a call (and all shards of a
+    fan-out) share one Deadline so the total wait stays bounded."""
+
+    def __init__(self, seconds):
+        self._t_end = time.monotonic() + float(seconds)
+
+    @classmethod
+    def after(cls, seconds):
+        return cls(seconds)
+
+    def remaining(self):
+        return self._t_end - time.monotonic()
+
+    def expired(self):
+        return self.remaining() <= 0.0
+
+    def clamp(self, timeout):
+        """Per-attempt socket timeout: never longer than what's left."""
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise DeadlineExceeded('deadline expired')
+        return rem if timeout is None else min(timeout, rem)
+
+
+class CircuitBreaker:
+    """Half-open circuit breaker for one endpoint.
+
+    closed -> (failure_threshold consecutive failures) -> open;
+    open -> (reset_timeout elapsed) -> half-open: ONE probe call goes
+    through; its success closes the breaker, its failure re-opens.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half_open'
+
+    def __init__(self, failure_threshold=5, reset_timeout=5.0):
+        self.failure_threshold = int(failure_threshold)
+        self.reset_timeout = float(reset_timeout)
+        self._failures = 0
+        self._opened_at = None
+        self._probing = False
+        self._lock = threading.Lock()
+
+    @property
+    def state(self):
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self):
+        if self._opened_at is None:
+            return self.CLOSED
+        if time.monotonic() - self._opened_at >= self.reset_timeout:
+            return self.HALF_OPEN
+        return self.OPEN
+
+    def allow(self):
+        """True if a call may proceed (claims the half-open probe slot)."""
+        with self._lock:
+            st = self._state_locked()
+            if st == self.CLOSED:
+                return True
+            if st == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self):
+        with self._lock:
+            self._failures = 0
+            self._opened_at = None
+            self._probing = False
+
+    def record_failure(self):
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._failures >= self.failure_threshold:
+                # (re)open and restart the reset window
+                self._opened_at = time.monotonic()
+
+
+# -- framed messages over the PS wire codec ---------------------------------
+# Same frame as ps/embedding_service (8-byte big-endian length + wire
+# bytes); lives here so the channel owns its transport end-to-end and the
+# ps module can keep its server-side helpers without an import cycle.
+
+def _send_frame(sock, obj):
+    from .ps import wire
+    payload = wire.encode(obj)
+    sock.sendall(struct.pack('>Q', len(payload)) + payload)
+
+
+def _recv_frame(sock):
+    hdr = b''
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError('peer closed')
+        hdr += chunk
+    n = struct.unpack('>Q', hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError('peer closed')
+        buf.extend(chunk)
+    from .ps import wire
+    return wire.decode(bytes(buf))
+
+
+class ResilientChannel:
+    """One endpoint's connection with timeouts, reconnect-and-retry for
+    idempotent ops, and a circuit breaker.
+
+    Connection is lazy: construction never blocks on a dead server, the
+    first call (or the first call after a failure) reconnects. One
+    in-flight call at a time per channel (the frame protocol has no
+    request ids); the internal lock serializes callers.
+    """
+
+    def __init__(self, endpoint, retry_policy=None,
+                 call_timeout=DEFAULT_CALL_TIMEOUT,
+                 connect_timeout=DEFAULT_CONNECT_TIMEOUT,
+                 breaker=None):
+        host, port = endpoint.rsplit(':', 1)
+        self.endpoint = endpoint
+        self._addr = (host, int(port))
+        self.policy = retry_policy or RetryPolicy()
+        self.call_timeout = call_timeout
+        self.connect_timeout = connect_timeout
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._sock = None
+        self._lock = threading.Lock()
+
+    # -- connection management ----------------------------------------------
+    def _connect(self, deadline=None):
+        _fire('connect', self.endpoint)
+        timeout = self.connect_timeout
+        if deadline is not None:
+            timeout = deadline.clamp(timeout)
+        sock = socket.create_connection(self._addr, timeout=timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _drop_connection(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._lock:
+            self._drop_connection()
+
+    @property
+    def connected(self):
+        return self._sock is not None
+
+    # -- the call path -------------------------------------------------------
+    def _attempt(self, msg, timeout, deadline):
+        if self._sock is None:
+            self._sock = self._connect(deadline)
+        sock = self._sock
+        per_try = timeout if deadline is None else deadline.clamp(timeout)
+        sock.settimeout(per_try)
+        _fire('send', self.endpoint)
+        _send_frame(sock, msg)
+        _fire('recv', self.endpoint)
+        return _recv_frame(sock)
+
+    def call(self, msg, idempotent=True, timeout=None, deadline=None):
+        """Send one request, return the decoded reply.
+
+        idempotent=False disables the retry loop: after a transport
+        failure the server may or may not have applied the op, so a
+        blind resend could double-apply (grad pushes). The connection is
+        still timed out and reconnected for the NEXT call.
+        """
+        if timeout is None:
+            timeout = self.call_timeout
+        attempts = self.policy.max_attempts if idempotent else 1
+        last_exc = None
+        with self._lock:
+            for attempt in range(1, attempts + 1):
+                if deadline is not None and deadline.expired():
+                    raise DeadlineExceeded(
+                        'deadline expired before attempt %d to %s'
+                        % (attempt, self.endpoint),
+                        endpoint=self.endpoint, attempts=attempt - 1) \
+                        from last_exc
+                if not self.breaker.allow():
+                    raise CircuitOpenError(
+                        'circuit open for %s (%d consecutive failures)'
+                        % (self.endpoint, self.breaker._failures),
+                        endpoint=self.endpoint, attempts=attempt - 1) \
+                        from last_exc
+                try:
+                    out = self._attempt(msg, timeout, deadline)
+                    self.breaker.record_success()
+                    return out
+                except DeadlineExceeded:
+                    self._drop_connection()
+                    raise
+                except Exception as e:
+                    self._drop_connection()
+                    if not self.policy.is_retryable(e):
+                        raise
+                    self.breaker.record_failure()
+                    last_exc = e
+                    if attempt < attempts:
+                        delay = self.policy.backoff(attempt)
+                        if deadline is not None:
+                            rem = deadline.remaining()
+                            if rem <= 0:
+                                break
+                            delay = min(delay, rem)
+                        time.sleep(delay)
+        if deadline is not None and deadline.expired():
+            raise DeadlineExceeded(
+                'deadline expired after %d attempts to %s: %r'
+                % (attempts, self.endpoint, last_exc),
+                endpoint=self.endpoint, attempts=attempts) from last_exc
+        raise RetryableError(
+            '%d attempts to %s failed: %r'
+            % (attempts, self.endpoint, last_exc),
+            endpoint=self.endpoint, attempts=attempts) from last_exc
+
+
+def call_once(endpoint, msg, timeout=DEFAULT_CALL_TIMEOUT,
+              connect_timeout=DEFAULT_CONNECT_TIMEOUT):
+    """One-shot request over a fresh ephemeral connection (blocking ops
+    like barriers that must not pin a shared channel). No retries — the
+    caller owns retry semantics for these — but fully timed out."""
+    ch = ResilientChannel(endpoint,
+                          retry_policy=RetryPolicy(max_attempts=1),
+                          call_timeout=timeout,
+                          connect_timeout=connect_timeout,
+                          breaker=CircuitBreaker(failure_threshold=1 << 30))
+    try:
+        return ch.call(msg, idempotent=False)
+    finally:
+        ch.close()

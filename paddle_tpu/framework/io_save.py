@@ -3,17 +3,34 @@
 Format: pickled nested structure with numpy leaves (reference-compatible
 shape); Tensors serialize as numpy arrays and load back as Tensors.
 Large-scale sharded checkpointing lives in distributed/checkpoint.py (orbax).
+
+Durability: every save is atomic (write-to-temp + fsync + rename, so a
+writer preempted mid-save never tears the previous snapshot) and carries
+a CRC32 manifest sidecar (`<path>.manifest`) that load verifies before
+unpickling — a truncated or bit-flipped file surfaces as
+CheckpointCorruptError instead of a confusing UnpicklingError (or, worse,
+silently wrong tensors). Files without a manifest load as before (legacy
+snapshots, foreign files).
 """
+import json
 import os
 import pickle
+import zlib
 
 import numpy as np
 
 from .core import Tensor, Parameter
 
-__all__ = ['save', 'load']
+__all__ = ['save', 'load', 'CheckpointCorruptError', 'manifest_path',
+           'verify_checkpoint']
 
 _PROTOCOL = 4
+_MANIFEST_FORMAT = 1
+
+
+class CheckpointCorruptError(IOError):
+    """The file's bytes do not match its manifest (truncated / torn /
+    bit-flipped snapshot)."""
 
 
 def _to_saveable(obj):
@@ -53,6 +70,22 @@ def _from_saveable(obj, return_numpy=False):
     return obj
 
 
+def manifest_path(path):
+    return path + '.manifest'
+
+
+def _write_atomic(path, data):
+    """Write bytes to a same-directory temp file, fsync, rename into
+    place — a concurrent reader (or a preempted writer) never observes a
+    half-written file at `path`."""
+    tmp = '%s.tmp.%d' % (path, os.getpid())
+    with open(tmp, 'wb') as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def save(obj, path, protocol=_PROTOCOL, **configs):
     """configs: encryption_key=... writes an AES-GCM (or HMAC-CTR
     fallback) PTCRYPT1 container (reference framework/io/crypto
@@ -65,8 +98,42 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
     if key is not None:
         from . import crypto
         payload = crypto.encrypt(payload, key)
-    with open(path, 'wb') as f:
-        f.write(payload)
+    manifest = json.dumps({'format': _MANIFEST_FORMAT,
+                           'size': len(payload),
+                           'crc32': zlib.crc32(payload) & 0xFFFFFFFF})
+    # data first, then manifest: a crash between the two renames leaves a
+    # stale manifest whose mismatch reads as "corrupt" — restore then
+    # falls back to an older snapshot, which is the conservative outcome
+    _write_atomic(path, payload)
+    _write_atomic(manifest_path(path), manifest.encode())
+
+
+def _check_manifest(path, payload):
+    """Raise CheckpointCorruptError if `path` has a manifest that does
+    not vouch for `payload`. Missing/unreadable manifest = legacy file,
+    accepted as-is."""
+    try:
+        with open(manifest_path(path)) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return
+    if m.get('size') != len(payload) or \
+            m.get('crc32') != (zlib.crc32(payload) & 0xFFFFFFFF):
+        raise CheckpointCorruptError(
+            '%s does not match its manifest (size %d vs %s) — truncated '
+            'or torn snapshot' % (path, len(payload), m.get('size')))
+
+
+def verify_checkpoint(path):
+    """True iff `path` exists and its bytes match its manifest (or it has
+    no manifest to check against)."""
+    try:
+        with open(path, 'rb') as f:
+            payload = f.read()
+        _check_manifest(path, payload)
+        return True
+    except (OSError, CheckpointCorruptError):
+        return False
 
 
 def load(path, **configs):
@@ -74,6 +141,7 @@ def load(path, **configs):
     key = configs.get('encryption_key')
     with open(path, 'rb') as f:
         payload = f.read()
+    _check_manifest(path, payload)
     from . import crypto
     if payload.startswith(crypto._MAGIC):
         if key is None:

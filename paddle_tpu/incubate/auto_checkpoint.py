@@ -38,6 +38,7 @@ class TrainEpochRange:
         self.extra_state = extra_state if extra_state is not None else {}
         self.keep_last = int(keep_last)
         self.restored_epoch = -1
+        self.skipped_corrupt = []   # epochs whose snapshot failed verify
         self._restore()
 
     # -- snapshot plumbing ---------------------------------------------------
@@ -57,17 +58,27 @@ class TrainEpochRange:
         return os.path.join(self.dir, 'epoch_%d.ckpt' % epoch)
 
     def _restore(self):
-        epochs = self._epochs_on_disk()
-        if not epochs:
+        """Resume from the NEWEST VALID snapshot: a truncated/torn latest
+        checkpoint (writer preempted mid-save) is detected via its CRC32
+        manifest and skipped, falling back to the previous epoch — losing
+        one save interval instead of the whole job."""
+        for epoch in reversed(self._epochs_on_disk()):
+            path = self._path(epoch)
+            if not io_save.verify_checkpoint(path):
+                self.skipped_corrupt.append(epoch)
+                continue
+            try:
+                payload = io_save.load(path)
+            except Exception:
+                self.skipped_corrupt.append(epoch)
+                continue
+            if self.model is not None and 'model' in payload:
+                self.model.set_state_dict(payload['model'])
+            if self.optimizer is not None and 'optimizer' in payload:
+                self.optimizer.set_state_dict(payload['optimizer'])
+            self.extra_state.update(payload.get('extra', {}))
+            self.restored_epoch = epoch
             return
-        epoch = epochs[-1]
-        payload = io_save.load(self._path(epoch))
-        if self.model is not None and 'model' in payload:
-            self.model.set_state_dict(payload['model'])
-        if self.optimizer is not None and 'optimizer' in payload:
-            self.optimizer.set_state_dict(payload['optimizer'])
-        self.extra_state.update(payload.get('extra', {}))
-        self.restored_epoch = epoch
 
     def save(self, epoch):
         payload = {'epoch': epoch, 'extra': dict(self.extra_state)}
@@ -75,12 +86,16 @@ class TrainEpochRange:
             payload['model'] = self.model.state_dict()
         if self.optimizer is not None:
             payload['optimizer'] = self.optimizer.state_dict()
+        # io_save writes atomically (temp + rename) with a manifest, so a
+        # preemption mid-save can never tear an existing snapshot
         io_save.save(payload, self._path(epoch))
         for old in self._epochs_on_disk()[:-self.keep_last]:
-            try:
-                os.remove(self._path(old))
-            except OSError:
-                pass
+            for p in (self._path(old),
+                      io_save.manifest_path(self._path(old))):
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
 
     # -- the epoch loop ------------------------------------------------------
     def __iter__(self):

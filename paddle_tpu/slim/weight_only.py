@@ -90,6 +90,17 @@ def quantize_weight_only(model, exclude=None):
     touched rows, so there is no bandwidth to win, and the tied-head
     matmul (GPT wte reuse) shares the same storage.
     """
+    if type(model) is nn.Linear:
+        # the root layer cannot be swapped in place — the caller's own
+        # reference IS the Linear, and rebinding it is outside our reach.
+        # Returning 0 here used to look like "nothing to quantize";
+        # refuse loudly instead (unless the exclude predicate keeps the
+        # root fp on purpose, which really is a no-op).
+        if exclude is not None and exclude('', model):
+            return 0
+        raise ValueError(
+            'quantize_weight_only cannot swap a bare root nn.Linear in '
+            'place — wrap it yourself: model = WeightOnlyLinear(model)')
     # snapshot the walk first: swapping children while the generator is
     # mid-descent would make it recurse into the replacement layers
     sites = []          # (parent, key, child) for every Linear occurrence

@@ -1,0 +1,2 @@
+"""Test-support utilities (fault injection lives in testing.chaos)."""
+from . import chaos  # noqa: F401

@@ -86,4 +86,7 @@ def test_keep_last_prunes_old_snapshots(tmp_path):
         pass
     import os
     files = sorted(os.listdir(os.path.join(str(tmp_path), 'job3')))
-    assert files == ['epoch_6.ckpt', 'epoch_7.ckpt']
+    # each snapshot = data file + CRC32 manifest sidecar; pruning removes
+    # both for evicted epochs
+    assert files == ['epoch_6.ckpt', 'epoch_6.ckpt.manifest',
+                     'epoch_7.ckpt', 'epoch_7.ckpt.manifest']

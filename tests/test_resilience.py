@@ -1,0 +1,364 @@
+"""Failure-path coverage: the fault-tolerant RPC layer
+(distributed/resilience.py) driven by the chaos harness
+(testing/chaos.py), and the hardened checkpoint stack (atomic writes +
+CRC32 manifests + newest-valid fallback).
+
+The acceptance scenarios from the reference stack's failure model:
+- a killed graph/PS server mid-call surfaces a clean retryable error,
+  bounded by the deadline (no hang);
+- an idempotent op retried across a server restart returns the correct
+  result;
+- a truncated latest checkpoint is detected via its manifest and restore
+  falls back to the previous valid snapshot.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import resilience
+from paddle_tpu.distributed.resilience import (
+    CircuitBreaker, CircuitOpenError, Deadline, DeadlineExceeded,
+    ResilientChannel, RetryPolicy, RetryableError)
+from paddle_tpu.distributed.graph_service import GraphPyClient, GraphPyServer
+from paddle_tpu.distributed.ps.embedding_service import (EmbeddingClient,
+                                                         EmbeddingServer)
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.framework import io_save
+from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+from paddle_tpu.testing import chaos
+
+# fast-failing policy for tests: whole retry ladder < ~0.5 s
+FAST = dict(retry_policy=RetryPolicy(max_attempts=4, base_delay=0.02,
+                                     max_delay=0.1),
+            call_timeout=2.0)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_faults():
+    yield
+    assert chaos.active_faults() == 0, 'a chaos injector leaked'
+
+
+# -- unit: policy / deadline / breaker --------------------------------------
+
+def test_retry_policy_backoff_and_classification():
+    p = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.4,
+                    multiplier=2.0, jitter=0.0)
+    assert [p.backoff(a) for a in (1, 2, 3, 4)] == [0.1, 0.2, 0.4, 0.4]
+    jittered = RetryPolicy(base_delay=0.1, jitter=0.5).backoff(1)
+    assert 0.1 <= jittered <= 0.15 + 1e-9
+    assert p.is_retryable(ConnectionResetError())
+    assert p.is_retryable(TimeoutError())
+    assert p.is_retryable(ConnectionRefusedError())
+    assert not p.is_retryable(ValueError('app bug'))
+    assert not p.is_retryable(RuntimeError('server-side error reply'))
+
+
+def test_deadline_clamps_and_expires():
+    dl = Deadline.after(0.2)
+    assert 0.0 < dl.remaining() <= 0.2
+    assert dl.clamp(10.0) <= 0.2
+    assert dl.clamp(0.05) <= 0.05
+    time.sleep(0.25)
+    assert dl.expired()
+    with pytest.raises(DeadlineExceeded):
+        dl.clamp(1.0)
+
+
+def test_circuit_breaker_half_open_cycle():
+    br = CircuitBreaker(failure_threshold=2, reset_timeout=0.15)
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+    br.record_failure()
+    assert br.allow()
+    br.record_failure()                      # hits the threshold
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    time.sleep(0.2)                          # reset window elapses
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert br.allow()                        # the single probe slot
+    assert not br.allow()                    # second caller still blocked
+    br.record_failure()                      # probe failed -> reopen
+    assert br.state == CircuitBreaker.OPEN
+    time.sleep(0.2)
+    assert br.allow()
+    br.record_success()                      # probe succeeded -> closed
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+
+def test_channel_fails_fast_when_circuit_open():
+    # nothing listens on port 1; breaker trips after 2 failed calls
+    ch = ResilientChannel('127.0.0.1:1',
+                          retry_policy=RetryPolicy(max_attempts=1,
+                                                   base_delay=0.01),
+                          breaker=CircuitBreaker(failure_threshold=2,
+                                                 reset_timeout=30.0))
+    for _ in range(2):
+        with pytest.raises(RetryableError):
+            ch.call({'op': 'stats'})
+    t0 = time.monotonic()
+    with pytest.raises(CircuitOpenError):
+        ch.call({'op': 'stats'})
+    assert time.monotonic() - t0 < 0.5      # fast-fail, no connect attempt
+
+
+# -- graph service under injected faults ------------------------------------
+
+def _graph_cluster():
+    srv = GraphPyServer()
+    srv.start_server()
+    client = GraphPyClient(['127.0.0.1:%d' % srv.port], **FAST)
+    client.add_edges('default', [0, 1, 2], [1, 2, 0])
+    return srv, client
+
+
+def test_graph_call_retries_through_dropped_connections():
+    srv, client = _graph_cluster()
+    try:
+        with chaos.drop_connections(point='send', times=2) as fault:
+            deg = client.get_degree('default', [0, 1, 2])
+        assert fault.fired == 2             # two transport failures eaten
+        assert deg.tolist() == [1, 1, 1]
+    finally:
+        client.stop_server()
+
+
+def test_graph_call_survives_connect_drops_and_delays():
+    srv, client = _graph_cluster()
+    try:
+        with chaos.drop_connections(point='connect', times=1):
+            with chaos.delay_connections(0.05, point='connect', times=1):
+                # drop the pooled conn so the call must reconnect
+                client._channels[0]._drop_connection()
+                deg = client.get_degree('default', [0])
+        assert deg.tolist() == [1]
+    finally:
+        client.stop_server()
+
+
+def test_killed_graph_server_surfaces_bounded_retryable_error():
+    srv, client = _graph_cluster()
+    chaos.kill_server(srv)                  # hard kill: listener + conns
+    deadline_s = 1.5
+    client._op_deadline = deadline_s
+    t0 = time.monotonic()
+    with pytest.raises(RetryableError):
+        client.get_degree('default', [0, 1, 2])
+    elapsed = time.monotonic() - t0
+    # no hang: bounded by the retry ladder / deadline, with slack for CI
+    assert elapsed < deadline_s + 2.0
+    client.close()
+
+
+def test_killed_graph_server_respects_tight_deadline():
+    srv, client = _graph_cluster()
+    chaos.kill_server(srv)
+    # huge attempt budget and a breaker that never trips: the DEADLINE
+    # must be what stops the retries
+    client._channels[0].policy = RetryPolicy(max_attempts=1000,
+                                             base_delay=0.01,
+                                             max_delay=0.05)
+    client._channels[0].breaker = CircuitBreaker(failure_threshold=10**9)
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        client._channels[0].call({'op': 'degree', 'etype': 'default',
+                                  'ids': [0]}, deadline=Deadline(0.4))
+    assert time.monotonic() - t0 < 2.0
+    client.close()
+
+
+def test_graph_idempotent_op_retried_across_server_restart():
+    srv, client = _graph_cluster()
+    before = client.get_degree('default', [0, 1, 2]).tolist()
+    port = srv.port
+    chaos.kill_server(srv)
+
+    def restart():
+        time.sleep(0.15)                     # an outage the retries span
+        new_srv = GraphPyServer(port=port)
+        # the replacement pod reloads the same shard data
+        new_srv._srv.stores['default'].add_edges([0, 1, 2], [1, 2, 0],
+                                                 None)
+        new_srv.start_server()
+        restarted.append(new_srv)
+
+    restarted = []
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        client._channels[0].policy = RetryPolicy(max_attempts=8,
+                                                 base_delay=0.05,
+                                                 max_delay=0.2)
+        deg = client.get_degree('default', [0, 1, 2])
+        assert deg.tolist() == before       # correct result after restart
+    finally:
+        t.join()
+        client.stop_server()
+
+
+def test_graph_add_edges_is_not_blind_resent():
+    """Mutations that append must NOT retry: a resend after an
+    applied-but-unacked write would duplicate edges."""
+    srv, client = _graph_cluster()
+    try:
+        with chaos.drop_connections(point='send', times=1) as fault:
+            with pytest.raises(RetryableError) as ei:
+                client.add_edges('default', [5], [6])
+        assert fault.fired == 1             # exactly one attempt
+        assert ei.value.attempts == 1
+        # and the graph was not corrupted by duplicates
+        assert client.get_degree('default', [5]).tolist() == [0]
+    finally:
+        client.stop_server()
+
+
+# -- PS embedding service under injected faults ------------------------------
+
+def _ps_cluster(seed=7):
+    srv = EmbeddingServer()
+    srv.create_table(0, dim=4, seed=seed)
+    srv.start()
+    client = EmbeddingClient(endpoints=[srv.endpoint], **FAST)
+    return srv, client
+
+
+def test_ps_pull_retried_across_server_restart():
+    srv, client = _ps_cluster(seed=7)
+    rows = client.pull(0, [1, 2, 3])        # materializes rows (seed 7)
+    port = srv.port
+    chaos.kill_server(srv)
+
+    def restart():
+        time.sleep(0.15)
+        new_srv = EmbeddingServer(port=port)
+        new_srv.create_table(0, dim=4, seed=7)   # same shard state
+        new_srv.start()
+        restarted.append(new_srv)
+
+    restarted = []
+    t = threading.Thread(target=restart)
+    t.start()
+    try:
+        client._channels[0].policy = RetryPolicy(max_attempts=8,
+                                                 base_delay=0.05,
+                                                 max_delay=0.2)
+        again = client.pull(0, [1, 2, 3])
+        np.testing.assert_array_equal(again, rows)
+    finally:
+        t.join()
+        for s in restarted:
+            s.stop()
+
+
+def test_ps_killed_server_bounds_the_error():
+    srv, client = _ps_cluster()
+    client.pull(0, [1])
+    chaos.kill_server(srv)
+    t0 = time.monotonic()
+    with pytest.raises(RetryableError):
+        client.pull(0, [1])
+    assert time.monotonic() - t0 < 4.0      # retry ladder, not a hang
+
+
+def test_ps_push_is_not_blind_resent():
+    srv, client = _ps_cluster()
+    try:
+        client.pull(0, [1])                 # materialize the row
+        with chaos.drop_connections(point='send', times=1) as fault:
+            with pytest.raises(RetryableError) as ei:
+                client.push(0, [1], np.ones((1, 4), np.float32))
+        assert fault.fired == 1
+        assert ei.value.attempts == 1       # single attempt, no resend
+    finally:
+        srv.stop()
+
+
+# -- checkpoint integrity: manifests, atomicity, fallback --------------------
+
+def test_io_save_writes_manifest_and_detects_truncation(tmp_path):
+    path = str(tmp_path / 'state.pdparams')
+    io_save.save({'w': np.arange(64, dtype=np.float32)}, path)
+    assert os.path.exists(io_save.manifest_path(path))
+    assert io_save.verify_checkpoint(path)
+    # no temp droppings from the atomic write
+    assert [f for f in os.listdir(str(tmp_path)) if '.tmp.' in f] == []
+
+    chaos.truncate_file(path, drop_bytes=16)
+    assert not io_save.verify_checkpoint(path)
+    with pytest.raises(io_save.CheckpointCorruptError):
+        io_save.load(path)
+
+
+def test_io_save_legacy_file_without_manifest_still_loads(tmp_path):
+    path = str(tmp_path / 'legacy.pdparams')
+    io_save.save({'x': 1}, path)
+    os.remove(io_save.manifest_path(path))  # pre-manifest era snapshot
+    assert io_save.verify_checkpoint(path)
+    assert io_save.load(path) == {'x': 1}
+
+
+def test_checkpoint_manager_falls_back_past_truncated_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=3)
+    for step in (1, 2, 3):
+        mgr.save(step, {'step': step, 'w': np.full(8, step, np.float32)})
+    chaos.truncate_file(os.path.join(str(tmp_path), 'step_3.ckpt'))
+
+    step, state = mgr.restore_latest()
+    assert step == 2                        # newest VALID snapshot
+    np.testing.assert_array_equal(state['w'], np.full(8, 2, np.float32))
+
+    # all three corrupt -> clean "nothing to restore", not an exception
+    for s in (1, 2):
+        chaos.truncate_file(os.path.join(str(tmp_path),
+                                         'step_%d.ckpt' % s))
+    assert mgr.restore_latest() == (None, None)
+
+
+def test_checkpoint_manager_prunes_manifests_too(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep_last=2)
+    for step in range(5):
+        mgr.save(step, {'step': step})
+    files = sorted(os.listdir(str(tmp_path)))
+    assert files == ['step_3.ckpt', 'step_3.ckpt.manifest',
+                     'step_4.ckpt', 'step_4.ckpt.manifest']
+
+
+def test_auto_checkpoint_restores_previous_epoch_on_truncation(tmp_path):
+    """The acceptance scenario end-to-end: epoch snapshots exist, the
+    NEWEST one is truncated (preempted writer), and the restart resumes
+    from the previous valid epoch instead of crashing or hanging."""
+    extra = {}
+    r = TrainEpochRange(3, 'jobX', checkpoint_dir=str(tmp_path),
+                        extra_state=extra)
+    for epoch in r:
+        extra['last_epoch_ran'] = epoch
+    job_dir = os.path.join(str(tmp_path), 'jobX')
+    assert sorted(f for f in os.listdir(job_dir)
+                  if f.endswith('.ckpt')) == \
+        ['epoch_0.ckpt', 'epoch_1.ckpt', 'epoch_2.ckpt']
+
+    chaos.truncate_file(os.path.join(job_dir, 'epoch_2.ckpt'))
+
+    r2 = TrainEpochRange(5, 'jobX', checkpoint_dir=str(tmp_path))
+    assert r2.restored_epoch == 1           # fell back past the torn one
+    assert r2.skipped_corrupt == [2]
+    assert r2.extra_state['last_epoch_ran'] == 1
+    # training resumes where the valid snapshot left off
+    assert [e for e in r2] == [2, 3, 4]
+
+
+def test_auto_checkpoint_all_corrupt_starts_fresh(tmp_path):
+    r = TrainEpochRange(2, 'jobY', checkpoint_dir=str(tmp_path))
+    for _ in r:
+        pass
+    job_dir = os.path.join(str(tmp_path), 'jobY')
+    for f in os.listdir(job_dir):
+        if f.endswith('.ckpt'):
+            chaos.truncate_file(os.path.join(job_dir, f), keep_bytes=3)
+    r2 = TrainEpochRange(2, 'jobY', checkpoint_dir=str(tmp_path))
+    assert r2.restored_epoch == -1          # clean cold start
+    assert sorted(r2.skipped_corrupt) == [0, 1]

@@ -93,6 +93,22 @@ def test_exclude_one_alias_keeps_shared_layer_fp():
     assert type(model.proj) is nn.Linear
 
 
+def test_bare_root_linear_raises():
+    """A root-level nn.Linear cannot be swapped in place (the caller's
+    reference IS the layer) — the old behavior silently returned 0."""
+    lin = nn.Linear(8, 8)
+    with pytest.raises(ValueError, match='WeightOnlyLinear'):
+        quantize_weight_only(lin)
+    assert type(lin) is nn.Linear  # untouched by the failed call
+
+
+def test_bare_root_linear_excluded_is_noop():
+    lin = nn.Linear(8, 8)
+    n = quantize_weight_only(lin, exclude=lambda name, layer: True)
+    assert n == 0
+    assert type(lin) is nn.Linear
+
+
 def test_quantized_mlp_forward_close():
     paddle.seed(11)
     model = nn.Sequential(nn.Linear(32, 64), nn.GELU(), nn.Linear(64, 10))

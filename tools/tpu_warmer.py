@@ -275,6 +275,11 @@ def run_child(script, extra_env, timeout=1500, snap=REPO):
         if entries:
             log('%s timed out >%ds; salvaged %d already-printed rows'
                 % (script, timeout, len(entries)))
+            # the window log must distinguish "config was cut off" from
+            # "config never ran": record the timeout as its own row
+            # alongside the salvaged measurements
+            entries.append({'metric': '%s_timeout' % script,
+                            'error': 'timeout>%ds' % timeout})
             return entries, None, time.time() - t0
         return None, 'timeout>%ds' % timeout, time.time() - t0
     entries = _json_lines(proc.stdout)
@@ -309,7 +314,12 @@ class Warmer(object):
     def bench_rung(self, label, extra, timeout=1500):
         entries, err, wall = run_child('bench.py', extra, timeout,
                                        self.snap)
-        result = entries[-1] if entries else None
+        # a timeout-salvaged list ends with a synthetic error row; the
+        # rung's RESULT is the last real measurement
+        good = [e for e in (entries or []) if 'error' not in e]
+        result = good[-1] if good else None
+        if result is None and err is None and entries:
+            err = entries[-1].get('error', 'timeout')
         idx = self.rec.record(label, result, err, wall, self.rev)
         if result is not None:
             log('%s: %.1fms/step mfu=%.4f (%.0fs)' % (
@@ -460,7 +470,8 @@ class Warmer(object):
                         PADDLE_TPU_BENCH_WARMUP='4')
         entries, err, wall = run_child('bench.py', prof_env, 1500,
                                        self.snap)
-        result = entries[-1] if entries else None
+        good = [e for e in (entries or []) if 'error' not in e]
+        result = good[-1] if good else None
         self.rec.record('profile_' + label, result, err, wall, self.rev)
         log('profile(%s): %s (%.0fs)' % (
             label, 'ok -> %s' % pdir if result is not None else err, wall))

@@ -420,9 +420,34 @@ def _best_capture(headline_seq=None):
 
 
 def _probe_backend(timeout=None):
-    """Ask a child what the default backend is; bounded by `timeout`."""
-    if timeout is None:
-        timeout = int(os.environ.get('PADDLE_TPU_BENCH_PROBE_TIMEOUT', 240))
+    """Ask a child what the default backend is, failing FAST.
+
+    With no explicit `timeout` this runs one SHORT attempt (default 30s,
+    PADDLE_TPU_BENCH_PROBE_SHORT_TIMEOUT) and, only if that attempt
+    fails, exactly one LONG retry (default 240s,
+    PADDLE_TPU_BENCH_PROBE_TIMEOUT). A healthy backend answers in
+    seconds, so the short probe decides almost every run; a hung tunnel
+    now costs 30s + 240s instead of the previous three serial 240s
+    probes. PADDLE_TPU_BENCH_FAST_PROBE=1 keeps its meaning — short
+    attempt only, no retry. An explicit `timeout` is a single bounded
+    attempt. Callers see the same (platform, err) contract either way;
+    a probe that never succeeds still yields the degraded-CPU run.
+    """
+    if timeout is not None:
+        return _probe_backend_once(timeout)
+    short = int(os.environ.get('PADDLE_TPU_BENCH_PROBE_SHORT_TIMEOUT', 30))
+    platform, err = _probe_backend_once(short)
+    if (platform is not None
+            or os.environ.get('PADDLE_TPU_BENCH_FAST_PROBE') == '1'):
+        return platform, err
+    retry = int(os.environ.get('PADDLE_TPU_BENCH_PROBE_TIMEOUT', 240))
+    platform, err2 = _probe_backend_once(retry)
+    if platform is not None:
+        return platform, None
+    return None, 'short probe: %s; long retry: %s' % (err, err2)
+
+
+def _probe_backend_once(timeout):
     try:
         proc = subprocess.run([sys.executable, '-c', _PROBE_SRC],
                               capture_output=True, text=True,
@@ -533,20 +558,12 @@ def main():
 
 
 def _orchestrate(errors):
-    # 1) bounded backend probes with staged backoff (axon TPU tunnels can
-    #    flake or hang on first contact; a later attempt often succeeds)
-    platform = None
-    if os.environ.get('PADDLE_TPU_BENCH_FAST_PROBE') == '1':
-        delays = (0,)
-    else:
-        delays = (0, 10, 30)
-    for attempt, delay in enumerate(delays):
-        if delay:
-            time.sleep(delay)
-        platform, err = _probe_backend()
-        if platform is not None:
-            break
-        errors.append('probe %d: %s' % (attempt, err))
+    # 1) bounded backend probe; the short-then-long staging lives inside
+    #    _probe_backend so a hung tunnel fails fast instead of eating
+    #    three serial full-length timeouts
+    platform, err = _probe_backend()
+    if platform is None:
+        errors.append('probe: %s' % err)
 
     # 2) measured run on the probed (real) backend; the retry disables
     #    the Pallas flash kernel so a kernel-compile failure still yields

@@ -362,6 +362,27 @@ class Predictor:
     def clone(self):
         return Predictor(self._config)
 
+    def decode_engine(self, num_slots=8, max_len=None, prefill_chunk=16,
+                      decode_block=4):
+        """Continuous-batching front door over the loaded model.
+
+        Only meaningful when the artifact is a causal LM with the slot-
+        cache decode path (GPTForCausalLM); anything else fails here
+        with a clear error instead of deep inside the first step().
+        """
+        layer = self._layer
+        if layer is None or not (hasattr(layer, 'generate')
+                                 and hasattr(layer, 'gpt')
+                                 and hasattr(layer, 'config')):
+            raise TypeError(
+                'decode_engine() needs a causal-LM artifact '
+                '(GPTForCausalLM with a KV-cache decode path); loaded '
+                'model is %s' % type(layer).__name__)
+        from ..serving import ContinuousBatchingEngine
+        return ContinuousBatchingEngine(
+            layer, num_slots=num_slots, max_len=max_len,
+            prefill_chunk=prefill_chunk, decode_block=decode_block)
+
     def clear_intermediate_tensor(self):
         self._outputs = {}
 

@@ -267,7 +267,17 @@ def save(layer, path, input_spec=None, **configs):
 
 def _strip_for_pickle(layer):
     import copy
-    layer2 = copy.deepcopy(layer)
+    # compiled-executable caches (GPTForCausalLM.generate's prefill/
+    # decode FIFO caches) are unpicklable AND undeepcopyable — map them
+    # to empty dicts in the memo so a model that already served traffic
+    # still saves with its architecture payload intact
+    memo = {}
+    for l in layer.sublayers(include_self=True):
+        for name in ('_prefill_cache', '_decode_cache'):
+            c = getattr(l, name, None)
+            if isinstance(c, dict):
+                memo[id(c)] = {}
+    layer2 = copy.deepcopy(layer, memo)
     for l in layer2.sublayers(include_self=True):
         l._forward_pre_hooks.clear()
         l._forward_post_hooks.clear()

@@ -83,6 +83,10 @@ def _supported(q, k, v):
             % (n, m)
     if n % 8 or m % 128:
         return 'seq (%d, %d) below TPU tile granularity' % (n, m)
+    if not interpret_mode():
+        # the interpreter has no VMEM; the footprint gate only guards
+        # real Mosaic compiles
+        return _vmem_reason(n, m, d, q.dtype.itemsize)
     return None
 
 
@@ -272,6 +276,68 @@ def _std_blocks(n, m):
 
 def _std_bwd_blocks(n, m):
     return _clamped(_BLOCK_Q_BWD, _BLOCK_K_BWD, n, m)
+
+
+# -- scoped-VMEM footprint gate ----------------------------------------------
+#
+# The block clamp above only guarantees DIVISIBILITY; it happily launched
+# configs whose working set Mosaic cannot hold. The in-window failure it
+# must refuse: seq 4096 on the STANDARD kernels at 512/1024 blocks died
+# compiling with "kernel-vmem-stack-oom" (docs/bench_inwindow_r5.jsonl
+# 09:32:35Z), while 2048 at the same blocks and 4096 at 256/512 both ran.
+# The discriminating cost in those captures is the sequential walk: each
+# fori_loop step's f32 score tile [block_q, block_k] lands on the scoped
+# stack, so the standard kernels' footprint grows with steps x tile while
+# the long kernels (grid-walked, one tile per cell) stay O(block). The
+# estimate below — walk steps x score-tile bytes plus the double-buffered
+# staged operand windows — reproduces every observed pass/fail with >2 MiB
+# margin against a 12 MiB budget (VMEM is ~16 MiB/core; the margin leaves
+# room for Mosaic's own buffers). Rejection routes through _supported, so
+# strict mode raises and non-strict falls back to the reference.
+
+_VMEM_BUDGET_MB_DEFAULT = 12
+
+
+def _vmem_budget_bytes():
+    return int(os.environ.get('PADDLE_TPU_FLASH_VMEM_BUDGET_MB',
+                              _VMEM_BUDGET_MB_DEFAULT)) * 1024 * 1024
+
+
+def _vmem_reason(n, m, d, itemsize):
+    """None if every dispatched pass fits the scoped-VMEM budget, else a
+    reason naming the worst pass, its estimate, and the knobs to turn."""
+    if _use_long_path(n, m):
+        bq, bk = _long_blocks(n, m)
+        tiles = (bq + 2 * bk) * d * itemsize      # q + k/v tiles per cell
+        passes = [('long fwd', 1, bq, bk, tiles + bq * d * 4),
+                  ('long dq', 1, bq, bk, tiles + bq * d * 4),
+                  ('long dk/dv', 1, bq, bk, tiles + 2 * bk * d * 4)]
+    else:
+        bq, bk = _std_blocks(n, m)
+        bqb, bkb = _std_bwd_blocks(n, m)
+        passes = [('fwd', m // bk, bq, bk, (2 * m + 2 * bq) * d * itemsize)]
+        if bqb == n and bkb == m and _fused_bwd_enabled():
+            passes.append(('fused bwd', 1, n, m, 4 * n * d * itemsize))
+        else:
+            passes.append(('dq', m // bkb, bqb, bkb,
+                           (2 * m + 2 * bqb) * d * itemsize))
+            passes.append(('dk/dv', n // bqb, bqb, bkb,
+                           (2 * n + 2 * bkb) * d * itemsize))
+    budget = _vmem_budget_bytes()
+    for name, steps, pbq, pbk, staged in passes:
+        est = steps * pbq * pbk * 4 + 2 * staged
+        if est > budget:
+            return ('blocks (%d, %d) at seq (%d, %d) cannot fit: the %s '
+                    'pass needs ~%.1f MiB scoped VMEM (%d sequential '
+                    'score tile(s) of %dx%d f32 plus staged operands) '
+                    'but the budget is %d MiB '
+                    '(PADDLE_TPU_FLASH_VMEM_BUDGET_MB); shrink the '
+                    'PADDLE_TPU_FLASH_BLOCK_* knobs or lower '
+                    'PADDLE_TPU_FLASH_LONG_SEQ to take the long-kernel '
+                    'path'
+                    % (pbq, pbk, n, m, name, est / 2 ** 20, steps, pbq,
+                       pbk, _vmem_budget_bytes() // 2 ** 20))
+    return None
 
 
 def _fwd_kernel_long(q_ref, k_ref, v_ref, o_ref, lse_ref,

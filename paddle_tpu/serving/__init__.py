@@ -1,0 +1,27 @@
+"""Continuous-batching serving for decoder-only LMs (Orca/vLLM-style).
+
+The decode matmuls of a cached autoregressive model are batch-starved
+when requests are served one at a time: `generate()` runs [1, hidden]
+GEMMs no matter how many requests are waiting. Continuous batching keeps
+a fixed pool of KV-cache *slots* and admits/retires requests per decode
+step, so the compiled step always runs at full slot occupancy with ONE
+static shape — no retrace across request churn.
+
+    engine = ContinuousBatchingEngine(model, num_slots=8)
+    req = engine.add_request([1, 2, 3], max_new_tokens=16)
+    engine.run()                 # or step() / stream(req) / serve threads
+    req.tokens                   # generated ids, identical to generate()
+
+Layering: kv_cache.py owns slot bookkeeping, scheduler.py owns the
+request queue + admission/prefill policy, engine.py owns the two jitted
+programs (chunked prefill, fixed-K decode burst) and the thread-safe
+front door, metrics.py turns step timestamps into tok/s + latency
+percentiles. See docs/serving.md.
+"""
+from .engine import ContinuousBatchingEngine
+from .kv_cache import SlotAllocator, build_slot_caches
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler
+
+__all__ = ['ContinuousBatchingEngine', 'SlotAllocator', 'build_slot_caches',
+           'ServingMetrics', 'Request', 'Scheduler']

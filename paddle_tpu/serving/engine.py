@@ -1,0 +1,332 @@
+"""Continuous-batching engine: two jitted programs + a thread-safe door.
+
+The whole engine compiles exactly TWO programs, each with one static
+shape, so request admit/retire churn can never retrace:
+
+  prefill chunk  — [1, C] prompt tokens into ONE slot's cache rows
+                   (slot sliced out, forwarded, written back; the slot
+                   index / row offset / valid count are traced scalars);
+  decode burst   — K cached decode steps for ALL slots in one dispatch
+                   (lax.scan; per-step `step_active` masking freezes
+                   finished or still-prefilling slots in-program, so the
+                   burst length never depends on occupancy).
+
+Correctness relies on the GPTSlotCache invariants (text/models/gpt.py):
+rows at/beyond a slot's length are unreachable garbage, attention writes
+at the pre-step offsets and the ENGINE advances lengths — prefill
+write-back sets `start + valid` (padding rows stay invalid), the decode
+burst adds `step_active` per step.
+
+Greedy output is token-identical to sequential generate(): the masked
+slot attention contributes exact zeros for invalid rows (scores hit
+-1e9 and underflow to 0.0 after the f32 softmax), and sampling mirrors
+generate()'s per-request PRNG stream (one split at prefill, one per
+decode step, advanced only on active steps).
+"""
+import queue as _queue
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import functional as _fm
+from ..framework.core import Tensor, no_grad_guard
+from ..text.models.gpt import GPTSlotCache
+from .kv_cache import SlotAllocator, build_slot_caches
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler
+
+__all__ = ['ContinuousBatchingEngine']
+
+
+def _pick_token(lg, key, temp, topk, sample):
+    """Next token for ONE row of logits — generate()'s pick, per slot.
+
+    All branches execute and select (jit-safe): greedy argmax vs
+    temperature/top-k categorical, chosen by the `sample` flag. topk==0
+    means full vocab (threshold -inf), same as generate().
+    """
+    lg = lg.astype(jnp.float32)
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    lt = lg / jnp.maximum(temp, 1e-6)
+    v = lt.shape[-1]
+    srt = jnp.sort(lt, axis=-1)                    # ascending
+    kth = srt[jnp.clip(v - topk, 0, v - 1)]        # the top-k'th value
+    thr = jnp.where(topk > 0, kth, -jnp.inf)
+    lt = jnp.where(lt >= thr, lt, -1e30)
+    sampled = jax.random.categorical(key, lt).astype(jnp.int32)
+    return jnp.where(sample, sampled, greedy)
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a GPTForCausalLM.
+
+    Front door (`add_request` / `step` / `run` / `stream` / `generate`)
+    is thread-safe: any number of threads may submit and drive; an RLock
+    serializes scheduler state and device dispatches while `Request.wait`
+    and stream consumption stay lock-free.
+    """
+
+    def __init__(self, model, num_slots=8, max_len=None, prefill_chunk=16,
+                 decode_block=4, donate=None):
+        model.eval()
+        self._model = model
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len or model.config.max_position_embeddings)
+        self.decode_block = int(decode_block)
+        if self.decode_block < 1:
+            raise ValueError('decode_block must be >= 1')
+        self._caches = build_slot_caches(model, self.num_slots, self.max_len)
+        self.allocator = SlotAllocator(self.num_slots)
+        self.scheduler = Scheduler(self.allocator, self.max_len,
+                                   prefill_chunk)
+        self.metrics = ServingMetrics()
+        self._params = _fm.extract_params(model)
+        self._bufs = _fm.extract_buffers(model)
+        # per-slot control state lives HOST-side as numpy: admission and
+        # retirement mutate it in place for free instead of dispatching
+        # an eager .at[].set() per field (the jitted calls accept numpy
+        # operands directly). Only the KV caches stay device-resident.
+        s = self.num_slots
+        self._last = np.zeros((s, 1), np.int32)       # token fed next step
+        self._gen = np.zeros((s,), np.int32)          # tokens generated
+        self._budgets = np.zeros((s,), np.int32)      # max_new_tokens
+        self._active = np.zeros((s,), bool)           # slot decodes?
+        self._keys = np.zeros((s, 2), np.uint32)      # per-slot PRNG
+        self._temps = np.ones((s,), np.float32)
+        self._topks = np.zeros((s,), np.int32)
+        self._sample = np.zeros((s,), bool)
+        self._requests = {}                           # slot -> Request
+        self._lock = threading.RLock()
+        # traced-body counters: each increments ONLY when jax traces the
+        # function, i.e. on (re)compilation — the zero-retrace assertion
+        # is `trace_counts stays {"prefill": 1, "decode": 1}` across an
+        # arbitrary admit/retire workload
+        self.trace_counts = {'prefill': 0, 'decode': 0}
+        if donate is None:
+            # cache buffers dominate engine memory; donating them lets
+            # XLA update in place. CPU donation is a no-op that warns.
+            donate = jax.default_backend() in ('tpu', 'gpu')
+        dn = (2,) if donate else ()
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dn)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dn)
+
+    # ---- the two compiled programs ------------------------------------
+
+    def _prefill_fn(self, params, bufs, caches, slot, ids, start, valid,
+                    key, temp, topk, sample):
+        """One [1, C] prompt chunk into slot `slot` at row `start`.
+
+        Only `valid` of the C tokens are real; padded rows write garbage
+        K/V beyond the valid length, which the write-back length
+        (`start + valid`) keeps unreachable (the next chunk or decode
+        step overwrites row start+valid before it becomes visible).
+        Returns the updated caches, the post-chunk logits' pick (only
+        meaningful on the final chunk) and the advanced PRNG key.
+        """
+        self.trace_counts['prefill'] += 1
+        small = []
+        for c in caches:
+            ks = jax.lax.dynamic_slice_in_dim(c.k._data, slot, 1, axis=0)
+            vs = jax.lax.dynamic_slice_in_dim(c.v._data, slot, 1, axis=0)
+            small.append(GPTSlotCache(Tensor(ks), Tensor(vs),
+                                      jnp.full((1,), start, jnp.int32)))
+        (lg, small2), _ = _fm.functional_call(
+            self._model, params, bufs, args=(Tensor(ids),),
+            kwargs={'caches': small}, training=False)
+        new_caches = []
+        for c, s2 in zip(caches, small2):
+            kb = jax.lax.dynamic_update_slice(
+                c.k._data, s2.k._data, (slot, 0, 0, 0))
+            vb = jax.lax.dynamic_update_slice(
+                c.v._data, s2.v._data, (slot, 0, 0, 0))
+            new_caches.append(GPTSlotCache(
+                Tensor(kb), Tensor(vb),
+                c.lengths.at[slot].set(start + valid)))
+        last = jax.lax.dynamic_index_in_dim(lg[0], valid - 1, axis=0,
+                                            keepdims=False)
+        key2, sub = jax.random.split(key)
+        tok = _pick_token(last, sub, temp, topk, sample)
+        return new_caches, tok, key2
+
+    def _decode_fn(self, params, bufs, caches, tok, gen, budgets, active,
+                   keys, temps, topks, sample):
+        """K cached decode steps for all slots in one dispatch.
+
+        `step_active` freezes slots that are unoccupied, mid-prefill, or
+        out of budget: their lengths / gen counts / keys do not advance
+        and their fed token repeats, so a frozen slot's garbage logits
+        never leak into state. The scan length is the FIXED decode_block
+        — a finishing slot idles for the burst's remainder rather than
+        shortening it (a variable length would recompile)."""
+        self.trace_counts['decode'] += 1
+
+        def body(carry, _):
+            caches, tok, gen, keys = carry
+            step_active = active & (gen < budgets)
+            (lg, new_cs), _ = _fm.functional_call(
+                self._model, params, bufs, args=(Tensor(tok),),
+                kwargs={'caches': caches}, training=False)
+            inc = step_active.astype(jnp.int32)
+            new_cs = [GPTSlotCache(c.k, c.v, c.lengths + inc)
+                      for c in new_cs]
+            ks = jax.vmap(jax.random.split)(keys)       # [S, 2, 2]
+            subs = ks[:, 1]
+            keys2 = jnp.where(step_active[:, None], ks[:, 0], keys)
+            nxt = jax.vmap(_pick_token)(lg[:, -1], subs, temps, topks,
+                                        sample)
+            tok2 = jnp.where(step_active, nxt, tok[:, 0])[:, None]
+            return (new_cs, tok2, gen + inc, keys2), (tok2[:, 0],
+                                                      step_active)
+
+        carry, (toks, actives) = jax.lax.scan(
+            body, (caches, tok, gen, keys), None, length=self.decode_block)
+        new_caches, tok2, gen2, keys2 = carry
+        return new_caches, tok2, gen2, keys2, toks, actives
+
+    # ---- front door ---------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
+                    top_k=0, do_sample=False, seed=0, stream=False):
+        """Queue a generation request; returns the Request handle."""
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      do_sample=do_sample, seed=seed)
+        if stream:
+            req._stream_q = _queue.Queue()
+        with self._lock:
+            self.scheduler.submit(req)
+            self.metrics.on_arrival(req.id)
+        return req
+
+    def step(self):
+        """One scheduler iteration: admit → prefill chunks → decode
+        burst → retire. Returns the number of requests still pending."""
+        with self._lock, no_grad_guard():
+            self._admit()
+            self._prefill_step()
+            self._decode_step()
+            self.metrics.on_step(self.allocator.in_use, self.num_slots)
+            return self.scheduler.pending
+
+    def run(self):
+        """Drive until every submitted request has finished."""
+        while True:
+            with self._lock:
+                if not self.scheduler.pending:
+                    return
+                self.step()
+
+    def generate(self, prompts, **sampling):
+        """Blocking batch door: submit all, drive to completion, return
+        generated ids per prompt (prompt not included) in order."""
+        reqs = [self.add_request(p, **sampling) for p in prompts]
+        self.run()
+        return [r.tokens for r in reqs]
+
+    def stream(self, req):
+        """Yield req's tokens as they are produced. Cooperative: if no
+        other thread is driving the engine, this one steps it."""
+        q = req._stream_q
+        if q is None:
+            raise ValueError('request was not added with stream=True')
+        while True:
+            try:
+                tok = q.get_nowait()
+            except _queue.Empty:
+                if req.done:
+                    return         # sentinel already consumed
+                self.step()
+                continue
+            if tok is None:
+                return
+            yield tok
+
+    def compiled_sizes(self):
+        """Times each program has been traced — the no-retrace metric."""
+        return dict(self.trace_counts)
+
+    @property
+    def occupancy(self):
+        return self.allocator.occupancy
+
+    # ---- scheduler glue (lock held) -----------------------------------
+
+    def _admit(self):
+        for slot, req in self.scheduler.admit():
+            self._requests[slot] = req
+            self._budgets[slot] = req.max_new_tokens
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
+            self._sample[slot] = req.do_sample
+            # generate()'s stream: key = PRNGKey(seed), split once at
+            # prefill end — created here, advanced by the final chunk
+            req._key = np.asarray(jax.random.PRNGKey(req.seed))
+            # no cache reset needed: the first prefill chunk writes from
+            # row 0 and its write-back sets lengths[slot] = the new
+            # occupant's own length, unreaching the old rows
+
+    def _prefill_step(self):
+        for req, start, ids, valid, final in self.scheduler.prefill_plan():
+            slot = req.slot
+            # mid chunks receive (and discard) the request key so only
+            # the final chunk's split advances the sampling stream
+            self._caches, tok, key2 = self._prefill_jit(
+                self._params, self._bufs, self._caches,
+                np.int32(slot),
+                np.asarray(ids, np.int32)[None, :],
+                np.int32(start), np.int32(valid), req._key,
+                np.float32(req.temperature), np.int32(req.top_k),
+                np.asarray(req.do_sample))
+            self.scheduler.mark_prefilled(req, start + valid)
+            if not final:
+                continue
+            tok = int(tok)
+            self._last[slot, 0] = tok
+            self._gen[slot] = 1
+            self._keys[slot] = np.asarray(key2)
+            self._active[slot] = True
+            self._emit(req, [tok])
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(req)
+
+    def _decode_step(self):
+        slots = self.scheduler.decode_slots()
+        if not slots:
+            return
+        (self._caches, last, gen, keys, toks,
+         actives) = self._decode_jit(
+            self._params, self._bufs, self._caches, self._last, self._gen,
+            self._budgets, self._active, self._keys, self._temps,
+            self._topks, self._sample)
+        last, gen, keys, toks, actives = jax.device_get(
+            (last, gen, keys, toks, actives))
+        # device_get can hand back read-only views; these three are
+        # mutated in place at prefill/retire
+        self._last = np.array(last)
+        self._gen = np.array(gen)
+        self._keys = np.array(keys)
+        for slot in slots:
+            req = self._requests[slot]
+            new = [int(toks[k, slot]) for k in range(toks.shape[0])
+                   if actives[k, slot]]
+            self._emit(req, new)
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(req)
+
+    def _emit(self, req, tokens):
+        if not tokens:
+            return
+        req.tokens.extend(tokens)
+        if req._stream_q is not None:
+            for t in tokens:
+                req._stream_q.put(t)
+        self.metrics.on_tokens(req.id, len(tokens))
+
+    def _retire(self, req):
+        slot = req.slot
+        self._active[slot] = False
+        del self._requests[slot]
+        self.scheduler.retire(req)

@@ -1,0 +1,82 @@
+"""Slot bookkeeping for the continuous-batching KV cache.
+
+The device side is `GPTSlotCache` (text/models/gpt.py): per layer, fixed
+[num_slots, max_len, H, Dh] buffers plus a per-slot valid-length vector.
+This module owns the HOST side: which slots are free, which request owns
+which slot, and construction of the per-layer cache pool for a model.
+
+Slot reuse needs no buffer clearing: a new occupant's chunked prefill
+writes from offset 0 and the validity mask never lets a query see rows
+at/beyond the slot's current length, so the previous occupant's rows are
+unreachable the moment lengths[slot] resets (the engine's first prefill
+chunk writes back `start + valid` = the new occupant's own length).
+"""
+import heapq
+
+__all__ = ['SlotAllocator', 'build_slot_caches']
+
+
+class SlotAllocator:
+    """Free-list over a fixed number of KV-cache slots.
+
+    Lowest-index-first allocation (a heap, not a LIFO stack) keeps slot
+    assignment deterministic for a given arrival order — parity tests
+    replay the same workload and must see the same slot layout.
+    """
+
+    def __init__(self, num_slots):
+        if num_slots < 1:
+            raise ValueError('num_slots must be >= 1, got %d' % num_slots)
+        self.num_slots = num_slots
+        self._free = list(range(num_slots))
+        heapq.heapify(self._free)
+        self._owner = {}  # slot -> opaque owner (request id)
+
+    def alloc(self, owner):
+        """Claim the lowest free slot for `owner`; None when full."""
+        if not self._free:
+            return None
+        slot = heapq.heappop(self._free)
+        self._owner[slot] = owner
+        return slot
+
+    def free(self, slot):
+        if slot not in self._owner:
+            raise ValueError('slot %d is not allocated' % slot)
+        del self._owner[slot]
+        heapq.heappush(self._free, slot)
+
+    def owner_of(self, slot):
+        return self._owner.get(slot)
+
+    @property
+    def in_use(self):
+        return len(self._owner)
+
+    @property
+    def available(self):
+        return len(self._free)
+
+    @property
+    def occupancy(self):
+        """Fraction of slots occupied, the per-step utilization metric."""
+        return len(self._owner) / float(self.num_slots)
+
+
+def build_slot_caches(model, num_slots, max_len):
+    """One GPTSlotCache per transformer layer of a GPTForCausalLM.
+
+    dtype follows the token embedding (bf16 on TPU serving), matching
+    what GPTForCausalLM.generate() does for its static cache.
+    """
+    from ..text.models.gpt import GPTSlotCache
+    config = model.config
+    if max_len > config.max_position_embeddings:
+        raise ValueError(
+            'slot capacity %d exceeds max_position_embeddings %d'
+            % (max_len, config.max_position_embeddings))
+    dtype = str(model.gpt.wte.weight.dtype).replace('paddle.', '')
+    head_dim = config.hidden_size // config.num_heads
+    return [GPTSlotCache.empty(num_slots, max_len, config.num_heads,
+                               head_dim, dtype=dtype)
+            for _ in model.gpt.h]

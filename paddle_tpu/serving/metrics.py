@@ -1,0 +1,93 @@
+"""Serving metrics: throughput, per-token latency percentiles, occupancy.
+
+Fed by the engine with wall-clock timestamps (injectable clock for
+deterministic tests). The latency distribution that matters for serving
+is PER-TOKEN (inter-token gap) plus time-to-first-token — a mean hides
+exactly the tail that continuous batching is supposed to fix, hence
+p50/p99.
+"""
+import time
+
+__all__ = ['ServingMetrics', 'percentile']
+
+
+def percentile(values, q):
+    """Nearest-rank percentile (q in [0, 100]) without numpy."""
+    if not values:
+        return None
+    xs = sorted(values)
+    if len(xs) == 1:
+        return xs[0]
+    # linear interpolation between closest ranks (numpy default method)
+    pos = (len(xs) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+class ServingMetrics:
+    def __init__(self, clock=None):
+        self._clock = clock or time.monotonic
+        self._start = None
+        self._end = None
+        self._arrival = {}        # rid -> t
+        self._first_token = {}    # rid -> t
+        self._last_token = {}     # rid -> t of the latest token
+        self._gaps = []           # inter-token gaps (incl. arrival->first)
+        self._tokens = 0
+        self._occupancy = []      # per-step occupied-slot fractions
+
+    def now(self):
+        return self._clock()
+
+    def on_arrival(self, rid, t=None):
+        t = self.now() if t is None else t
+        self._arrival[rid] = t
+        if self._start is None:
+            self._start = t
+
+    def on_tokens(self, rid, count, t=None):
+        """`count` tokens became visible for request rid at time t.
+
+        Decode runs in bursts of K steps per dispatch, so K tokens land
+        at once; the burst's gap is spread over its tokens — the honest
+        accounting, since a consumer reading the stream experiences the
+        burst wait once per K tokens.
+        """
+        if count <= 0:
+            return
+        t = self.now() if t is None else t
+        prev = self._last_token.get(rid)
+        if rid not in self._first_token:
+            self._first_token[rid] = t
+            prev = self._arrival.get(rid, t)
+        if prev is not None:
+            self._gaps.extend([(t - prev) / count] * count)
+        self._last_token[rid] = t
+        self._tokens += count
+        self._end = t
+
+    def on_step(self, occupied, num_slots):
+        self._occupancy.append(occupied / float(num_slots))
+
+    def report(self):
+        elapsed = ((self._end - self._start)
+                   if self._start is not None and self._end is not None
+                   else 0.0)
+        ttft = [self._first_token[r] - self._arrival[r]
+                for r in self._first_token if r in self._arrival]
+        return {
+            'tokens': self._tokens,
+            'elapsed_s': elapsed,
+            'tok_per_s': self._tokens / elapsed if elapsed > 0 else 0.0,
+            'latency_p50_ms': _ms(percentile(self._gaps, 50)),
+            'latency_p99_ms': _ms(percentile(self._gaps, 99)),
+            'ttft_p50_ms': _ms(percentile(ttft, 50)),
+            'occupancy_mean': (sum(self._occupancy) / len(self._occupancy)
+                               if self._occupancy else 0.0),
+        }
+
+
+def _ms(x):
+    return None if x is None else x * 1e3

@@ -1,0 +1,160 @@
+"""Request queue + admission/prefill policy for continuous batching.
+
+Policy (Orca-style iteration-level scheduling, FIFO within a step):
+
+  1. ADMIT:  while a slot is free and a request is queued, bind the
+     oldest request to the lowest free slot (deterministic layout).
+  2. PREFILL: every resident request still consuming its prompt advances
+     by exactly ONE fixed-size chunk per step — chunking bounds the
+     latency bubble a long prompt injects between decode steps, the
+     reason Sarathi/vLLM interleave prefill rather than running it to
+     completion on arrival.
+  3. DECODE: all slots whose prompt is fully consumed take one decode
+     burst together (engine-side); finished sequences retire and their
+     slots return to the free list the same step.
+
+Everything here is host-side bookkeeping with plain Python ints — the
+scheduler never touches device arrays, so it cannot cause a retrace.
+"""
+import itertools
+import threading
+from collections import deque
+
+__all__ = ['Request', 'Scheduler']
+
+_req_ids = itertools.count()
+
+# request lifecycle states
+QUEUED, PREFILL, DECODE, DONE = 'queued', 'prefill', 'decode', 'done'
+
+
+class Request:
+    """One generation request plus its accumulated output.
+
+    Sampling params mirror GPTForCausalLM.generate() exactly — same
+    greedy/temperature/top-k semantics, same per-request PRNG stream
+    seeded from `seed` — so engine output is comparable token-for-token
+    against a sequential generate() of the same prompt.
+    """
+
+    def __init__(self, prompt, max_new_tokens=32, temperature=1.0,
+                 top_k=0, do_sample=False, seed=0):
+        self.id = next(_req_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.do_sample = bool(do_sample)
+        self.seed = int(seed)
+        self.tokens = []          # generated ids (prompt NOT included)
+        self.state = QUEUED
+        self.slot = None          # bound while resident
+        self._key = None          # PRNG key, set at admission
+        self._consumed = 0        # prompt tokens already prefilled
+        self._finished = threading.Event()
+        # engine.stream() consumers read tokens from here; None until the
+        # first stream() call so non-streamed requests pay nothing
+        self._stream_q = None
+
+    @property
+    def done(self):
+        return self.state == DONE
+
+    def wait(self, timeout=None):
+        """Block until the request finishes (thread-safe front door)."""
+        return self._finished.wait(timeout)
+
+    def __repr__(self):
+        return ('Request(id=%d, state=%s, prompt_len=%d, generated=%d/%d)'
+                % (self.id, self.state, len(self.prompt), len(self.tokens),
+                   self.max_new_tokens))
+
+
+class Scheduler:
+    """Admission + chunked-prefill planner over a SlotAllocator."""
+
+    def __init__(self, allocator, max_len, prefill_chunk):
+        if prefill_chunk < 1:
+            raise ValueError('prefill_chunk must be >= 1')
+        self.allocator = allocator
+        self.max_len = int(max_len)
+        self.prefill_chunk = int(prefill_chunk)
+        self.queue = deque()
+        self.resident = {}        # slot -> Request (PREFILL or DECODE)
+
+    def submit(self, req):
+        """Validate capacity and enqueue. Raises on impossible requests —
+        a request that can never fit must fail at the front door, not
+        wedge the queue forever."""
+        n0 = len(req.prompt)
+        if n0 < 1:
+            raise ValueError('empty prompt')
+        if req.max_new_tokens < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        c = self.prefill_chunk
+        padded = ((n0 + c - 1) // c) * c
+        # two capacity constraints: the final sequence must fit, and the
+        # PADDED last prefill chunk must land inside the buffer (a
+        # clamped dynamic_update_slice would silently shift the write)
+        need = max(n0 + req.max_new_tokens - 1, padded)
+        if need > self.max_len:
+            raise ValueError(
+                'request needs %d cache rows (prompt %d + %d new tokens, '
+                'prefill padding to %d) but slots hold %d'
+                % (need, n0, req.max_new_tokens, padded, self.max_len))
+        self.queue.append(req)
+
+    def admit(self):
+        """Bind queued requests to free slots; returns [(slot, req)]."""
+        admitted = []
+        while self.queue and self.allocator.available:
+            req = self.queue.popleft()
+            slot = self.allocator.alloc(req.id)
+            req.slot = slot
+            req.state = PREFILL
+            req._consumed = 0
+            self.resident[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    def prefill_plan(self):
+        """One chunk per prefilling request: [(req, start, ids, valid,
+        final)] where ids is exactly prefill_chunk tokens (zero-padded
+        past `valid`) so the jitted chunk program has one shape."""
+        plan = []
+        c = self.prefill_chunk
+        for slot in sorted(self.resident):
+            req = self.resident[slot]
+            if req.state != PREFILL:
+                continue
+            start = req._consumed
+            valid = min(c, len(req.prompt) - start)
+            ids = req.prompt[start:start + valid] + [0] * (c - valid)
+            plan.append((req, start, ids, valid,
+                         start + valid >= len(req.prompt)))
+        return plan
+
+    def mark_prefilled(self, req, consumed):
+        req._consumed = consumed
+        if req._consumed >= len(req.prompt):
+            req.state = DECODE
+
+    def decode_slots(self):
+        return [s for s in sorted(self.resident)
+                if self.resident[s].state == DECODE]
+
+    def retire(self, req):
+        """Release a finished request's slot and wake any waiters."""
+        slot = req.slot
+        del self.resident[slot]
+        self.allocator.free(slot)
+        req.state = DONE
+        req.slot = None
+        if req._stream_q is not None:
+            req._stream_q.put(None)   # stream sentinel: end of tokens
+        req._finished.set()
+
+    @property
+    def pending(self):
+        """Requests not yet DONE anywhere in the system."""
+        return len(self.queue) + len(self.resident)

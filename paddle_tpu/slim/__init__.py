@@ -15,12 +15,13 @@ from .quant_layers import (FakeQuantAbsMax, FakeQuantMovingAverageAbsMax,
                            fake_quant_dequant_abs_max,
                            fake_quant_dequant_channel_wise,
                            fake_quant_dequant_with_scale)
-from .weight_only import WeightOnlyLinear, quantize_weight_only
+from .weight_only import (WeightOnlyLinear, quantize_weight_only,
+                          streamed_bytes)
 
 __all__ = [
     'ImperativeQuantAware', 'PostTrainingQuantization', 'ImperativePTQ',
     'cal_kl_threshold', 'QuantedLinear', 'QuantedConv2D', 'FakeQuantAbsMax',
     'FakeQuantMovingAverageAbsMax', 'fake_quant_dequant_abs_max',
     'fake_quant_dequant_channel_wise', 'fake_quant_dequant_with_scale',
-    'WeightOnlyLinear', 'quantize_weight_only',
+    'WeightOnlyLinear', 'quantize_weight_only', 'streamed_bytes',
 ]

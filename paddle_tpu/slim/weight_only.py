@@ -28,7 +28,7 @@ from .. import nn
 from ..framework.core import Tensor
 from ..nn import functional as F
 
-__all__ = ['WeightOnlyLinear', 'quantize_weight_only']
+__all__ = ['WeightOnlyLinear', 'quantize_weight_only', 'streamed_bytes']
 
 _EPS = 1e-8
 
@@ -77,6 +77,23 @@ class WeightOnlyLinear(nn.Layer):
     def extra_repr(self):
         return 'in_features=%d, out_features=%d, int8-weight' % (
             self._in_features, self._out_features)
+
+
+def streamed_bytes(model):
+    """Bytes of model state one decode step streams from HBM: all params
+    plus weight-carrying buffers (int8 qweights count 1 byte/element,
+    their scales count too). This is the denominator of the decode
+    roofline `steps/s <= HBM_BW / streamed_bytes` used by bench_extra's
+    decode and serving rungs — defined here so the quantized and
+    full-precision models are measured by one rule.
+    """
+    total = 0
+    for _, p in model.named_parameters():
+        total += int(p._data.nbytes)
+    for _, b in model.named_buffers():
+        if b is not None:
+            total += int(b._data.nbytes)
+    return float(total)
 
 
 def quantize_weight_only(model, exclude=None):

@@ -1,0 +1,139 @@
+"""Perf-regression gate tests (tools/check_bench_regression.py).
+
+The gate's contract, proven with a deliberate-regression fixture: a new
+capture of the SAME effective config that is >10% worse than the stored
+best must fail the check, and a capture at (or near) the stored best
+must pass. Also exercised against the repo's real in-window logs:
+self-comparison is by construction regression-free.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', 'tools'))
+
+import bench
+import check_bench_regression as gate
+
+_REPO = os.path.join(os.path.dirname(__file__), '..')
+
+
+def _row(value, metric='train_tokens_per_sec', **over):
+    row = {'metric': metric, 'value': value, 'unit': 'tokens/sec',
+           'platform': 'tpu', 'label': over.pop('label', 'fixture'),
+           'batch': 8, 'seq': 512, 'scan_steps': 2, 'fused_ce': True,
+           'attn_impl': 'flash', 'qkv_split': False}
+    row.update(over)
+    return row
+
+
+def test_fails_on_deliberate_regression():
+    best = [_row(1000.0, label='stored_best')]
+    regressed = [_row(850.0, label='regressed')]       # -15% > 10% bar
+    findings = gate.check(regressed, best)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f['direction'] == 'down'
+    assert f['ratio'] == pytest.approx(0.85)
+    assert f['stored_best'] == 1000.0 and f['new_best'] == 850.0
+
+
+def test_passes_on_stored_best_and_within_threshold():
+    best = [_row(1000.0)]
+    assert gate.check(best, best) == []                # identical capture
+    assert gate.check([_row(920.0)], best) == []       # -8% inside bar
+    assert gate.check([_row(1100.0)], best) == []      # improvement
+
+
+def test_effective_config_matching_not_literal():
+    """A legacy row that omits knob fields and a new row spelling out the
+    same defaults are ONE config: the key goes through bench's
+    _capture_replay_env + _effective_env canonicalization."""
+    legacy = {'metric': 'train_tokens_per_sec', 'value': 1000.0,
+              'unit': 'tokens/sec', 'platform': 'tpu', 'batch': 8,
+              'seq': 512}
+    same = dict(legacy, value=800.0)
+    assert gate.config_key(legacy) == gate.config_key(same)
+    assert len(gate.check([same], [legacy])) == 1      # -20% caught
+    # a DIFFERENT config (other seq) never compares against this best
+    other = dict(legacy, value=100.0, seq=1024)
+    assert gate.config_key(other) != gate.config_key(legacy)
+    assert gate.check([other], [legacy]) == []
+
+
+def test_untrusted_rows_are_ignored():
+    best = [_row(1000.0)]
+    for bad in (_row(10.0, degraded=True),
+                _row(10.0, suspect=True),
+                _row(10.0, platform='cpu'),
+                _row(10.0, error='oom'),
+                _row('nan')):
+        assert not gate.eligible(bad)
+        assert gate.check([bad], best) == []
+    # and an untrusted stored row can't masquerade as the best
+    assert gate.check([_row(500.0)],
+                      [_row(10000.0, suspect=True), _row(520.0)]) == []
+
+
+def test_latency_metrics_regress_upward():
+    best = [_row(12.0, metric='decode_step_latency', unit='ms')]
+    assert not gate.higher_is_better(best[0])
+    assert gate.check([_row(14.0, metric='decode_step_latency',
+                            unit='ms')], best)         # +17% slower
+    assert gate.check([_row(11.0, metric='decode_step_latency',
+                            unit='ms')], best) == []   # faster is fine
+
+
+def test_aux_workload_fields_split_configs():
+    """Serving-rung rows at different slot counts are different configs
+    even though their knob env is identical."""
+    b8 = _row(300.0, metric='serving_tokens_per_sec', num_slots=8)
+    b32 = _row(900.0, metric='serving_tokens_per_sec', num_slots=32)
+    new8 = _row(280.0, metric='serving_tokens_per_sec', num_slots=8)
+    assert gate.config_key(b8) != gate.config_key(b32)
+    assert gate.check([new8], [b8, b32]) == []         # -7%: ok vs its own
+
+
+def test_cli_exit_codes(tmp_path):
+    best_p = tmp_path / 'best.jsonl'
+    new_ok = tmp_path / 'ok.jsonl'
+    new_bad = tmp_path / 'bad.jsonl'
+    best_p.write_text(json.dumps(_row(1000.0)) + '\n')
+    new_ok.write_text(json.dumps(_row(990.0)) + '\n')
+    new_bad.write_text(json.dumps(_row(500.0)) + '\n')
+    script = os.path.join(_REPO, 'tools', 'check_bench_regression.py')
+
+    def run(new):
+        return subprocess.run(
+            [sys.executable, script, '--new', str(new),
+             '--baseline', str(best_p)],
+            capture_output=True, text=True, cwd=_REPO)
+
+    ok = run(new_ok)
+    assert ok.returncode == 0, ok.stderr
+    assert json.loads(ok.stdout.strip().splitlines()[-1])['ok'] is True
+    bad = run(new_bad)
+    assert bad.returncode == 1, bad.stderr
+    finding = json.loads(bad.stdout.strip().splitlines()[0])
+    assert finding['regression'] and finding['ratio'] == pytest.approx(0.5)
+    empty = tmp_path / 'empty.jsonl'
+    empty.write_text('')
+    assert run(empty).returncode == 2                  # nothing to check
+
+
+def test_repo_stored_best_passes_gate():
+    """In-suite rung: the stored in-window logs, replayed as a 'new'
+    capture against themselves, must pass — if this fails the stored
+    best itself is internally inconsistent."""
+    paths = [p for p in bench._inwindow_log_paths() if os.path.exists(p)]
+    if not paths:
+        pytest.skip('no stored in-window capture logs in repo')
+    rows = []
+    for p in paths:
+        rows.extend(gate._load_jsonl(p))
+    assert any(gate.eligible(r) for r in rows)
+    assert gate.check(rows, rows) == []

@@ -162,3 +162,70 @@ def test_replay_plus_head_rung_reports_the_faster(tmp_path, monkeypatch,
     assert len(spawned) == 2
     assert res['mfu_6n'] == 0.53
     assert res['retry'] == 'fused_flash_scan8_qkvlast'
+
+
+def test_probe_fail_fast_short_then_one_long_retry(monkeypatch):
+    """A hung backend costs one SHORT probe plus exactly ONE long retry
+    (not three serial full-length timeouts), and a healthy backend is
+    decided by the short probe alone."""
+    b = _bench()
+    calls = []
+
+    def fake_once(timeout):
+        calls.append(timeout)
+        return None, 'backend probe hung (>%ds)' % timeout
+
+    monkeypatch.setattr(b, '_probe_backend_once', fake_once)
+    monkeypatch.delenv('PADDLE_TPU_BENCH_FAST_PROBE', raising=False)
+    platform, err = b._probe_backend()
+    assert platform is None
+    assert calls == [30, 240]            # short first, one long retry
+    assert 'short probe' in err and 'long retry' in err
+
+    # healthy backend: the short probe decides, no retry
+    calls.clear()
+    monkeypatch.setattr(b, '_probe_backend_once',
+                        lambda t: (calls.append(t), ('tpu', None))[1])
+    assert b._probe_backend() == ('tpu', None)
+    assert calls == [30]
+
+    # the retry rescues a slow-but-alive tunnel, reporting success clean
+    calls.clear()
+
+    def flaky_once(timeout):
+        calls.append(timeout)
+        if timeout == 30:
+            return None, 'backend probe hung (>30s)'
+        return 'tpu', None
+
+    monkeypatch.setattr(b, '_probe_backend_once', flaky_once)
+    assert b._probe_backend() == ('tpu', None)
+    assert calls == [30, 240]
+
+
+def test_probe_fast_mode_and_explicit_timeout(monkeypatch):
+    """FAST_PROBE=1 keeps its semantics (single short attempt, no long
+    retry — CI must not stall 240s) and an explicit timeout is a single
+    bounded attempt at exactly that bound."""
+    b = _bench()
+    calls = []
+
+    def fake_once(timeout):
+        calls.append(timeout)
+        return None, 'down'
+
+    monkeypatch.setattr(b, '_probe_backend_once', fake_once)
+    monkeypatch.setenv('PADDLE_TPU_BENCH_FAST_PROBE', '1')
+    assert b._probe_backend() == (None, 'down')
+    assert calls == [30]
+
+    calls.clear()
+    monkeypatch.delenv('PADDLE_TPU_BENCH_FAST_PROBE', raising=False)
+    monkeypatch.setenv('PADDLE_TPU_BENCH_PROBE_SHORT_TIMEOUT', '5')
+    monkeypatch.setenv('PADDLE_TPU_BENCH_PROBE_TIMEOUT', '60')
+    b._probe_backend()
+    assert calls == [5, 60]              # both knobs respected
+
+    calls.clear()
+    assert b._probe_backend(timeout=7) == (None, 'down')
+    assert calls == [7]                  # explicit bound: one attempt

@@ -1,0 +1,94 @@
+"""Scoped-VMEM footprint gate for the flash-attention block clamp.
+
+The r5 in-window failure this hardens against: the STANDARD kernels at
+seq 4096 with 512/1024 blocks died compiling with
+"kernel-vmem-stack-oom" (docs/bench_inwindow_r5.jsonl 09:32:35Z) — the
+divisibility clamp launched a config Mosaic could not hold. The gate
+must refuse exactly that config with a clear error, while keeping every
+configuration the captures show running on hardware: 2048 at the same
+blocks, 4096 at 256/512, the seq-512 fused-backward headline, and the
+long-kernel rungs.
+"""
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import flash_attention as fa
+
+
+def _force_std(monkeypatch, bq, bk):
+    """Emulate the capture's env: long path off, fwd+bwd blocks pinned.
+    (bench children re-import with the env set; in-process tests pin the
+    import-latched module constants instead.)"""
+    monkeypatch.setattr(fa, '_LONG_SEQ', 10 ** 9)
+    monkeypatch.setattr(fa, '_DEFAULT_BLOCK_Q', bq)
+    monkeypatch.setattr(fa, '_DEFAULT_BLOCK_K', bk)
+    monkeypatch.setattr(fa, '_BLOCK_Q_BWD', bq)
+    monkeypatch.setattr(fa, '_BLOCK_K_BWD', bk)
+    monkeypatch.delenv('PADDLE_TPU_FLASH_INTERPRET', raising=False)
+    monkeypatch.delenv('PADDLE_TPU_FLASH_VMEM_BUDGET_MB', raising=False)
+
+
+def _mk(n, dtype=jnp.bfloat16):
+    return jnp.zeros((1, 1, n, 64), dtype)
+
+
+def test_rejects_the_r5_vmem_oom_config(monkeypatch):
+    _force_std(monkeypatch, 512, 1024)
+    q = _mk(4096)
+    reason = fa._supported(q, q, q)
+    assert reason is not None
+    assert 'VMEM' in reason and 'dk/dv' in reason
+    assert 'PADDLE_TPU_FLASH_VMEM_BUDGET_MB' in reason
+    # strict mode (the bench-honesty contract): refuse loudly instead of
+    # handing Mosaic a config it cannot compile
+    monkeypatch.setenv('PADDLE_TPU_FLASH_STRICT', '1')
+    with pytest.raises(RuntimeError, match='scoped VMEM'):
+        fa.flash_attention_bhnd(q, q, q)
+
+
+def test_accepts_every_config_that_ran_on_hardware(monkeypatch):
+    # std 2048 @ 512/1024 (longseq2048_flash_bq512_bk1024: 148 ms)
+    _force_std(monkeypatch, 512, 1024)
+    q = _mk(2048)
+    assert fa._supported(q, q, q) is None
+    # std 4096 @ 256/512 (fused_flash_seq4096_b4_scan2)
+    _force_std(monkeypatch, 256, 512)
+    q = _mk(4096)
+    assert fa._supported(q, q, q) is None
+    # the seq-512 fused-backward headline config
+    _force_std(monkeypatch, 512, 512)
+    q = _mk(512)
+    assert fa._supported(q, q, q) is None
+    # stock knobs route 4096 to the LONG kernels, which stage O(block)
+    # and ran at 197.8 ms (longseq4096_longkern_bq512_bk1024)
+    monkeypatch.setattr(fa, '_LONG_SEQ', 4096)
+    q = _mk(4096)
+    assert fa._supported(q, q, q) is None
+    # and the 8k long rung at the wide 512/2048 KV block
+    monkeypatch.setattr(fa, '_BLOCK_K_LONG', 2048)
+    q = _mk(8192)
+    assert fa._supported(q, q, q) is None
+
+
+def test_budget_knob_moves_the_gate(monkeypatch):
+    _force_std(monkeypatch, 512, 1024)
+    q = _mk(4096)
+    assert fa._supported(q, q, q) is not None
+    # a v6-sized budget admits the config the v5e budget refuses
+    monkeypatch.setenv('PADDLE_TPU_FLASH_VMEM_BUDGET_MB', '64')
+    assert fa._supported(q, q, q) is None
+    # a starved budget rejects even the headline config
+    monkeypatch.setenv('PADDLE_TPU_FLASH_VMEM_BUDGET_MB', '1')
+    _force_std(monkeypatch, 512, 512)
+    monkeypatch.setenv('PADDLE_TPU_FLASH_VMEM_BUDGET_MB', '1')
+    q = _mk(512)
+    assert fa._supported(q, q, q) is not None
+
+
+def test_interpreter_mode_skips_the_gate(monkeypatch):
+    """The CPU interpreter has no VMEM: the correctness tests must keep
+    running shapes the hardware budget would refuse."""
+    _force_std(monkeypatch, 512, 1024)
+    monkeypatch.setenv('PADDLE_TPU_FLASH_INTERPRET', '1')
+    q = _mk(4096)
+    assert fa._supported(q, q, q) is None

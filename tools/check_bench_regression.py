@@ -1,0 +1,163 @@
+"""Perf-regression gate over bench capture logs.
+
+Compares a NEW capture log (JSONL rows as written by bench.py children,
+tools/tpu_warmer.py, or bench_extra.py) against the stored best and
+FAILS (exit 1) when any same-config metric regresses more than the
+threshold (default 10%). Reference counterpart:
+tools/check_op_benchmark_result.py, which gates op microbenchmark PRs
+the same way — compare same-case logs, alarm past a ratio.
+
+"Same config" means: same metric AND same effective replay environment.
+Rows are canonicalized through bench._capture_replay_env +
+bench._effective_env, so a legacy row with unstated knobs and a new row
+spelling out today's defaults still land in the same bucket (the whole
+point of those helpers), plus the auxiliary workload fields
+(num_slots/new_tokens/... for the serving and decode rungs).
+
+Only trustworthy rows participate: real-TPU, non-degraded, non-suspect,
+no error field — the same eligibility rule as bench._best_capture.
+
+Usage:
+    python tools/check_bench_regression.py --new NEW.jsonl \
+        [--baseline BEST.jsonl ...] [--threshold 0.10]
+
+With no --baseline, the repo's in-window logs (bench._inwindow_log_paths)
+are the stored best. Exit codes: 0 ok, 1 regression, 2 nothing to check.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# auxiliary config fields that distinguish otherwise same-env rows
+# (bench_extra rungs vary these, not the knob env)
+_AUX_CONFIG = ('num_slots', 'new_tokens', 'prompt_len', 'image_size',
+               'trace', 'model', 'scan_steps')
+
+__all__ = ['eligible', 'config_key', 'higher_is_better', 'check', 'main']
+
+
+def eligible(row):
+    """bench._best_capture's trust rule: real-TPU, clean, measured."""
+    return (row.get('platform', 'tpu') == 'tpu'
+            and not row.get('degraded')
+            and not row.get('suspect')
+            and 'error' not in row
+            and isinstance(row.get('value'), (int, float))
+            and row.get('metric'))
+
+
+def config_key(row):
+    """Canonical same-config identity for a capture row."""
+    import bench
+    env = bench._effective_env(bench._capture_replay_env(row))
+    aux = tuple((k, row[k]) for k in _AUX_CONFIG if k in row)
+    return (row['metric'],) + aux + tuple(sorted(env.items()))
+
+
+def higher_is_better(row):
+    """Throughput-style metrics regress DOWN, latency-style regress UP."""
+    text = '%s %s' % (row.get('metric', ''), row.get('unit', ''))
+    return not ('ms' in text.split() or 'latency' in text
+                or text.endswith('_ms'))
+
+
+def check(new_rows, baseline_rows, threshold=0.10):
+    """Pure gate: list of regression findings (empty == pass).
+
+    For every config present in BOTH logs, the best new value must not
+    be worse than the stored best by more than `threshold`. Configs only
+    one side knows are skipped — a new rung has no best yet, and a
+    retired rung must not block forever.
+    """
+    def best_by_config(rows):
+        best = {}
+        for row in rows:
+            if not eligible(row):
+                continue
+            key = config_key(row)
+            cur = best.get(key)
+            if cur is None:
+                best[key] = row
+            elif higher_is_better(row) == (row['value'] > cur['value']):
+                best[key] = row
+        return best
+
+    stored = best_by_config(baseline_rows)
+    fresh = best_by_config(new_rows)
+    findings = []
+    for key, old in sorted(stored.items()):
+        new = fresh.get(key)
+        if new is None:
+            continue
+        hib = higher_is_better(old)
+        ratio = (new['value'] / old['value']) if old['value'] else 1.0
+        regressed = (ratio < 1.0 - threshold) if hib \
+            else (ratio > 1.0 + threshold)
+        if regressed:
+            findings.append({
+                'metric': old['metric'],
+                'stored_best': old['value'],
+                'new_best': new['value'],
+                'ratio': round(ratio, 4),
+                'threshold': threshold,
+                'direction': 'down' if hib else 'up',
+                'stored_label': old.get('label'),
+                'new_label': new.get('label'),
+            })
+    return findings
+
+
+def _load_jsonl(path):
+    rows = []
+    with open(path, errors='replace') as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--new', required=True, help='new capture JSONL')
+    ap.add_argument('--baseline', action='append', default=[],
+                    help='stored-best JSONL (repeatable; default: the '
+                         'repo in-window logs)')
+    ap.add_argument('--threshold', type=float, default=0.10,
+                    help='allowed fractional regression (default 0.10)')
+    args = ap.parse_args(argv)
+
+    baselines = args.baseline
+    if not baselines:
+        import bench
+        baselines = [p for p in bench._inwindow_log_paths()
+                     if os.path.exists(p)]
+    new_rows = _load_jsonl(args.new)
+    base_rows = [r for p in baselines for r in _load_jsonl(p)]
+    if not new_rows or not base_rows:
+        print(json.dumps({'checked': 0,
+                          'note': 'nothing to compare (new=%d baseline=%d '
+                                  'eligible rows pre-filter)'
+                                  % (len(new_rows), len(base_rows))}))
+        return 2
+    findings = check(new_rows, base_rows, threshold=args.threshold)
+    for f in findings:
+        print(json.dumps(dict(f, regression=True)))
+    if not findings:
+        print(json.dumps({'regressions': 0, 'threshold': args.threshold,
+                          'ok': True}))
+        return 0
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

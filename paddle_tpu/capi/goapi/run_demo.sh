@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Build and run the Go inference demo against a saved model — the
+# CI-runnable path for the goapi shim (tests/test_goapi.py drives the
+# same steps under pytest and compares outputs numerically).
+#
+# Usage: run_demo.sh [model_dir]
+#   With no model_dir, a small MLP is jit.save'd to a temp dir first
+#   (the same recipe as tests/test_capi.py's saved_model fixture).
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+CAPI_DIR="$(dirname "$HERE")"
+REPO="$(cd "$CAPI_DIR/../.." && pwd)"
+PY="${PYTHON:-python}"
+
+command -v go >/dev/null || { echo "go toolchain not found" >&2; exit 2; }
+
+LIB="$($PY -c 'from paddle_tpu.capi import build_capi; print(build_capi())')"
+LIBDIR="$(dirname "$LIB")"
+
+MODEL="${1:-}"
+if [ -z "$MODEL" ]; then
+  MODEL="$(mktemp -d)/mlp"
+  $PY - "$MODEL" <<'EOF'
+import sys
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+paddle.seed(1234)
+model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+model.eval()
+paddle.jit.save(model, sys.argv[1],
+                input_spec=[InputSpec([2, 8], name='features')])
+EOF
+fi
+
+cd "$HERE"
+export CGO_ENABLED=1
+export CGO_CFLAGS="-I$CAPI_DIR"
+export CGO_LDFLAGS="-L$LIBDIR -lpaddle_tpu_c -Wl,-rpath,$LIBDIR"
+go build -o "${GOAPI_DEMO_BIN:-./demo_client}" ./cmd/demo
+unset XLA_FLAGS
+exec "${GOAPI_DEMO_BIN:-./demo_client}" "$REPO" "$MODEL"

@@ -1,0 +1,39 @@
+"""SPMD sharding audit & planning (reference: the multi-devices graph
+pass layer, framework/ir/multi_devices_graph_pass/).
+
+The reference framework decides collective/sharding placement with
+static graph passes; this rebuild delegates partitioning to GSPMD and
+therefore needs the inverse tooling: *observe* what GSPMD actually did
+and *constrain* it where propagation guesses wrong. Three parts:
+
+  parser   — turns XLA's spmd_partitioner warning stream and optimized
+             HLO text into structured events (no compilation needed, so
+             the detector itself is fixture-testable).
+  audit    — compiles a callable/TrainStep under an fd-level stderr
+             capture (XLA's C++ logs bypass sys.stderr) and emits a
+             ShardingAuditReport with a pass/fail gate.
+  planner  — builds the with_sharding_constraint specs the pipeline
+             engines place on their carry / microbatch-slice /
+             collective boundaries so producer and consumer shardings
+             reach GSPMD already compatible.
+
+CI surface: `assert_no_involuntary_resharding(fn, mesh=..., args=...)`
+from any test, and the MULTICHIP dryrun embeds one report per config
+(tools/check_sharding_regression.py diffs those against the stored
+capture).
+"""
+from .parser import (ShardingEvent, parse_spmd_warnings,
+                     parse_hlo_collectives, INVOLUNTARY_KIND)
+from .audit import (ShardingAuditReport, capture_compiler_stderr,
+                    audit_callable, audit_train_step, audit_from_text,
+                    assert_no_involuntary_resharding)
+from .planner import PipelinePlan, plan_pipeline, plan_for_state
+
+__all__ = [
+    'ShardingEvent', 'parse_spmd_warnings', 'parse_hlo_collectives',
+    'INVOLUNTARY_KIND',
+    'ShardingAuditReport', 'capture_compiler_stderr', 'audit_callable',
+    'audit_train_step', 'audit_from_text',
+    'assert_no_involuntary_resharding',
+    'PipelinePlan', 'plan_pipeline', 'plan_for_state',
+]

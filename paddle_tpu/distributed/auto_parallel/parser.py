@@ -1,0 +1,199 @@
+"""Parsers for XLA's SPMD partitioner diagnostics and optimized HLO.
+
+Two generations of the involuntary-rematerialization warning exist in
+the wild and both must parse (the stored MULTICHIP captures carry one,
+the locally-installed jaxlib emits the other):
+
+  newer XLA (spmd_partitioner.cc:652, W-level):
+    [SPMD] Involuntary full rematerialization. The compiler cannot go
+    from sharding {A} to {B} efficiently for HLO operation %op = ...,
+    metadata={op_name="..." stack_frame_id=N}. As the last resort,
+    SPMD will replicate the tensor and then partition it ...
+
+  older XLA (spmd_partitioner.cc:613, E-level):
+    [spmd] Involuntary full rematerialization. The compiler was not
+    able to go from sharding {A} to {B} without doing a full
+    rematerialization of the tensor for HLO operation: %op = ...,
+    metadata={op_name="..." source_file="..." source_line=N}. You
+    probably want to enrich the sharding annotations ...
+
+Capture tails may also cut the first warning mid-line (a bounded tail
+is stored, not the whole stderr), so a fragment that still shows the
+target sharding and the HLO operation is recovered as an event rather
+than dropped — losing the first event would make a 3-warning capture
+diff clean against a 2-warning run.
+"""
+import re
+
+__all__ = ['ShardingEvent', 'parse_spmd_warnings', 'parse_hlo_collectives',
+           'INVOLUNTARY_KIND']
+
+INVOLUNTARY_KIND = 'involuntary-full-rematerialization'
+
+# bytes per element for HLO primitive type names
+_DTYPE_BYTES = {
+    'pred': 1, 's4': 1, 'u4': 1, 's8': 1, 'u8': 1,
+    'f8e4m3fn': 1, 'f8e5m2': 1, 'f8e4m3b11fnuz': 1,
+    's16': 2, 'u16': 2, 'f16': 2, 'bf16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8, 'c64': 8, 'c128': 16,
+}
+
+_FULL_RE = re.compile(
+    r'Involuntary full rematerialization\.\s+The compiler '
+    r'(?:cannot|was not able to) go from sharding \{(?P<src>[^{}]+)\} '
+    r'to \{(?P<dst>[^{}]+)\}'
+    r'(?:\s+efficiently\s+for|\s+without doing a full rematerialization '
+    r'of the tensor for)'
+    r'\s+HLO operation:?\s+%(?P<op>[\w.\-]+)\s+=\s+'
+    r'(?P<dtype>[a-z]\w*)\[(?P<dims>[\d,]*)\]')
+
+# a tail-truncated warning: the leading "...go from sharding {A} to {" is
+# gone but "<dst tiling>} efficiently for HLO operation %op = ..." remains
+_FRAG_RE = re.compile(
+    r'(?P<dst>devices=[^{}]+)\}\s+(?:efficiently\s+)?for HLO '
+    r'operation:?\s+%(?P<op>[\w.\-]+)\s+=\s+'
+    r'(?P<dtype>[a-z]\w*)\[(?P<dims>[\d,]*)\]')
+
+_OPCODE_RE = re.compile(r'\](?:\{[\d,]*\})?\s+(?P<opcode>[\w\-]+)\(')
+_OP_NAME_RE = re.compile(r'op_name="(?P<v>[^"]*)"')
+_STACK_RE = re.compile(r'stack_frame_id=(?P<v>\d+)')
+_SRC_FILE_RE = re.compile(r'source_file="(?P<v>[^"]*)"')
+_SRC_LINE_RE = re.compile(r'source_line=(?P<v>\d+)')
+_OP_SHARD_RE = re.compile(r'sharding=\{(?P<v>[^{}]*)\}')
+
+# one optimized-HLO collective definition, e.g.
+#   %all-reduce.1 = f32[512,64]{1,0} all-reduce(f32[512,64]{1,0} %x), ...
+_COLLECTIVE_RE = re.compile(
+    r'=\s+\(?\s*(?P<dtype>[a-z]\w*)\[(?P<dims>[\d,]*)\]\S*\s+'
+    r'(?P<kind>all-reduce|all-gather|reduce-scatter|collective-permute|'
+    r'all-to-all)(?:-start)?\(')
+
+
+def _shape_bytes(dtype, dims):
+    shape = [int(d) for d in dims.split(',') if d] if dims else []
+    n = 1
+    for d in shape:
+        n *= d
+    return shape, n * _DTYPE_BYTES.get(dtype, 4)
+
+
+class ShardingEvent:
+    """One partitioner fallback: a tensor GSPMD could only move between
+    the producer and consumer shardings by replicating it."""
+
+    def __init__(self, kind, op, dtype, shape, nbytes, src_sharding,
+                 dst_sharding, opcode=None, op_sharding=None, op_name=None,
+                 stack_frame_id=None, source_file=None, source_line=None,
+                 raw=''):
+        self.kind = kind
+        self.op = op                      # HLO value name, e.g. squeeze.63
+        self.opcode = opcode              # HLO opcode, e.g. copy
+        self.dtype = dtype
+        self.shape = shape
+        self.bytes = nbytes               # estimated resharded bytes
+        self.src_sharding = src_sharding  # producer tiling (None if cut)
+        self.dst_sharding = dst_sharding  # target tiling
+        self.op_sharding = op_sharding    # the op's own annotation
+        self.op_name = op_name            # jax op_name metadata
+        self.stack_frame_id = stack_frame_id
+        self.source_file = source_file
+        self.source_line = source_line
+        self.raw = raw
+
+    def key(self):
+        """Identity for diffing a run against a stored capture. Excludes
+        the HLO value number (squeeze.63 vs squeeze.65 across compiler
+        versions is the same event) and the raw text."""
+        return (self.kind, self.opcode or '', self.dtype,
+                tuple(self.shape), self.op_name or '',
+                self.src_sharding or '', self.dst_sharding or '')
+
+    def to_dict(self):
+        return {
+            'kind': self.kind, 'op': self.op, 'opcode': self.opcode,
+            'dtype': self.dtype, 'shape': self.shape, 'bytes': self.bytes,
+            'src_sharding': self.src_sharding,
+            'dst_sharding': self.dst_sharding,
+            'op_sharding': self.op_sharding, 'op_name': self.op_name,
+            'stack_frame_id': self.stack_frame_id,
+            'source_file': self.source_file,
+            'source_line': self.source_line,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d.get('kind', INVOLUNTARY_KIND), d.get('op'),
+                   d.get('dtype'), list(d.get('shape') or []),
+                   int(d.get('bytes') or 0), d.get('src_sharding'),
+                   d.get('dst_sharding'), opcode=d.get('opcode'),
+                   op_sharding=d.get('op_sharding'),
+                   op_name=d.get('op_name'),
+                   stack_frame_id=d.get('stack_frame_id'),
+                   source_file=d.get('source_file'),
+                   source_line=d.get('source_line'))
+
+    def __repr__(self):
+        where = self.op_name or self.source_file or '?'
+        return ('<ShardingEvent %s %s[%s] {%s} -> {%s} ~%d B at %s>'
+                % (self.opcode or self.op, self.dtype,
+                   ','.join(map(str, self.shape)),
+                   self.src_sharding, self.dst_sharding, self.bytes, where))
+
+
+def _event_from_line(line):
+    m = _FULL_RE.search(line)
+    src = None
+    if m is None:
+        # only attempt fragment recovery on lines that still look like a
+        # partitioner fallback (tail cut the prefix off)
+        if ('HLO operation' not in line
+                or ('rematerialization' not in line
+                    and 'last resort' not in line)):
+            return None
+        m = _FRAG_RE.search(line)
+        if m is None:
+            return None
+    else:
+        src = m.group('src').strip()
+    shape, nbytes = _shape_bytes(m.group('dtype'), m.group('dims'))
+    opm = _OPCODE_RE.match(line, m.end('dims'))
+
+    def _opt(rx, cast=str):
+        g = rx.search(line)
+        return cast(g.group('v')) if g else None
+
+    return ShardingEvent(
+        INVOLUNTARY_KIND, m.group('op'), m.group('dtype'), shape, nbytes,
+        src, m.group('dst').strip(),
+        opcode=opm.group('opcode') if opm else None,
+        op_sharding=_opt(_OP_SHARD_RE),
+        op_name=_opt(_OP_NAME_RE),
+        stack_frame_id=_opt(_STACK_RE, int),
+        source_file=_opt(_SRC_FILE_RE),
+        source_line=_opt(_SRC_LINE_RE, int),
+        raw=line.strip())
+
+
+def parse_spmd_warnings(text):
+    """Extract involuntary-reshard events from compiler stderr (or a
+    stored capture tail). Returns a list of ShardingEvent."""
+    events = []
+    for line in (text or '').splitlines():
+        ev = _event_from_line(line)
+        if ev is not None:
+            events.append(ev)
+    return events
+
+
+def parse_hlo_collectives(hlo_text):
+    """Count collectives (and their payload bytes) in optimized HLO
+    text — the coarse 'what does one step move over ICI' summary that
+    sits next to the warning events in the audit report."""
+    stats = {}
+    for m in _COLLECTIVE_RE.finditer(hlo_text or ''):
+        _, nbytes = _shape_bytes(m.group('dtype'), m.group('dims'))
+        s = stats.setdefault(m.group('kind'), {'count': 0, 'bytes': 0})
+        s['count'] += 1
+        s['bytes'] += nbytes
+    return stats

@@ -1,0 +1,162 @@
+"""Sharding constraint planner for the pipeline engines.
+
+Root cause of the MULTICHIP r05 config-5 warnings (pp2 x sharding4):
+the GPipe/1F1B bodies run Manual over 'pp' with every other axis left
+Auto, and GSPMD must GUESS shardings for the values flowing through the
+while-body. Two guesses go wrong:
+
+  * the microbatch split reshapes the [B, ...] batch — sharded 4-way
+    over ('dp','sharding') — into [n_micro, mb, ...], and the
+    partitioner may split that 4-way tiling across BOTH new dims
+    ({devices=[2,2]}-style, transposed orders). Everything downstream
+    in the loop then inherits mixed 2x2 tilings.
+  * the stacked per-stage params [pp, per, ...] enter the loop with
+    ZeRO's 'sharding' tiling on a weight dim; inside the body the
+    per-layer dynamic-slice+squeeze meets consumers that prefer the
+    (contaminated) transposed tilings, and tiled->tiled transitions
+    with transposed device orders are exactly what the partitioner can
+    only do by replicate-then-repartition ("Involuntary full
+    rematerialization", spmd_partitioner.cc:652) — once per microbatch
+    tick.
+
+The plan makes both boundaries explicit so there is nothing to guess:
+the microbatch index is pinned as a TIME axis (replicated) with each
+row carrying the WHOLE batch tiling, and the stacked params are pinned
+pp-sharded on dim 0. Every other dim is left UNCONSTRAINED — pinning
+them would itself force transitions (e.g. forcing a ZeRO-tiled weight
+dim to replicated is exactly a transposed tiled->tiled move and
+reintroduces the warning); the point is to remove the partitioner's
+bad choices at the two contaminating boundaries, not to override its
+good ones. Constraints are placed OUTSIDE the shard_map boundary,
+which every supported jax generation handles identically.
+"""
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ['PipelinePlan', 'plan_pipeline', 'plan_for_state']
+
+# axes that shard the global batch dim (strategy.py batch_axes order)
+_BATCH_AXES = ('dp', 'sharding')
+
+# per-dim "keep whatever you infer" marker (predates every jax line we
+# support, but probe anyway so the planner degrades to shorter specs —
+# unmentioned trailing dims mean REPLICATED, which is still correct,
+# just stronger than necessary)
+_U = getattr(P, 'UNCONSTRAINED', None)
+
+
+def _pad(entries, rank):
+    """Extend a spec to `rank` dims with UNCONSTRAINED placeholders."""
+    if _U is None or rank <= len(entries):
+        return P(*entries)
+    return P(*(tuple(entries) + (_U,) * (rank - len(entries))))
+
+
+def _axis_sizes(mesh):
+    return dict(zip(mesh.axis_names, np.shape(mesh.devices)))
+
+
+def _constrain(arr, spec, mesh):
+    if spec is None:
+        return arr
+    try:
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+    except (ValueError, TypeError):
+        # unknown jax dialect for this placement: leave the value
+        # unconstrained rather than break the schedule — the audit gate
+        # reports whether the plan actually took effect
+        return arr
+
+
+class PipelinePlan:
+    """Constraint specs for one pipelined region on one mesh."""
+
+    def __init__(self, mesh, axis, batch_axes):
+        self.mesh = mesh
+        self.axis = axis
+        self.batch_axes = tuple(batch_axes)
+        sizes = _axis_sizes(mesh)
+        self.batch_div = 1
+        for a in self.batch_axes:
+            self.batch_div *= sizes[a]
+
+    # ---- specs (pure; unit-testable without compiling) ----
+
+    def batch_spec(self, shape):
+        """[B, ...] activations outside the region: rows carry the full
+        batch tiling; other dims keep whatever GSPMD inferred."""
+        if len(shape) < 1 or shape[0] % self.batch_div:
+            return None
+        return _pad((self.batch_axes,), len(shape))
+
+    def micro_spec(self, shape):
+        """[n_micro, mb, ...] microbatch stream: the microbatch index is
+        a TIME axis (replicated), each row keeps the full batch tiling.
+        This pins the reshape so the partitioner cannot split the batch
+        tiling across the two new dims."""
+        if len(shape) < 2 or shape[1] % self.batch_div:
+            return None
+        return _pad((None, self.batch_axes), len(shape))
+
+    def stacked_spec(self, shape):
+        """Stacked per-stage params [pp, per, ...]: pp-sharded on dim 0;
+        the weight dims stay UNCONSTRAINED so an incoming ZeRO tiling is
+        kept IN PLACE (forcing it anywhere else is itself an inefficient
+        transition)."""
+        sizes = _axis_sizes(self.mesh)
+        if len(shape) < 1 or shape[0] != sizes.get(self.axis):
+            return None
+        return _pad((self.axis,), len(shape))
+
+    def describe(self):
+        """Boundary -> spec map (docs/auto_parallel.md renders this)."""
+        u = '*' if _U is not None else 'None'
+        ba = '(%s)' % ','.join(self.batch_axes)
+        return {
+            'microbatch-slice [n_micro, mb, ...]':
+                'P(None, %s, %s...)' % (ba, u),
+            'stacked stage params [pp, per, ...]':
+                "P('%s', %s...)" % (self.axis, u),
+            'pipeline output [n_micro, mb, ...]':
+                'P(None, %s, %s...)' % (ba, u),
+            'merged output [B, ...]': 'P(%s, %s...)' % (ba, u),
+        }
+
+    # ---- application helpers (used from the engines, inside jit) ----
+
+    def constrain_micro(self, arr):
+        return _constrain(arr, self.micro_spec(arr.shape), self.mesh)
+
+    def constrain_stacked(self, stacked):
+        return {n: _constrain(a, self.stacked_spec(a.shape), self.mesh)
+                for n, a in stacked.items()}
+
+    def constrain_batch(self, arr):
+        return _constrain(arr, self.batch_spec(arr.shape), self.mesh)
+
+
+def plan_pipeline(mesh, axis='pp', batch_axes=None):
+    """Build the constraint plan for a pipelined region on `mesh`.
+
+    Returns None when there is nothing to plan: no such axis, or no
+    other nontrivial axis (a pure-pp mesh leaves GSPMD nothing to
+    guess, and the unconstrained program is already clean)."""
+    sizes = _axis_sizes(mesh)
+    if axis not in sizes:
+        return None
+    if all(n == 1 for a, n in sizes.items() if a != axis):
+        return None
+    if batch_axes is None:
+        batch_axes = [a for a in _BATCH_AXES
+                      if sizes.get(a, 1) > 1]
+    batch_axes = tuple(a for a in batch_axes if a in sizes)
+    return PipelinePlan(mesh, axis, batch_axes)
+
+
+def plan_for_state(pp_state):
+    """Plan for a pipeline state dict (make_pp_state output)."""
+    if pp_state is None:
+        return None
+    return plan_pipeline(pp_state['mesh'], pp_state['axis'])

@@ -36,6 +36,8 @@ from jax.sharding import PartitionSpec as P
 from ..framework import functional as func_mod
 from ..framework import random as rng_mod
 from ..framework.core import Tensor
+from .shard_map_compat import shard_map
+from .auto_parallel import planner as ap_planner
 
 __all__ = ['PipelineEngine', 'make_pp_state', 'pp_scope', 'pipeline_state',
            'pipeline_blocks', 'pipeline_stage_fns']
@@ -237,15 +239,27 @@ def pipeline_blocks(blocks, x, state):
         return _gpipe_loop(stage_apply, micro, n_stages, n_micro, axis,
                            dtype_like, wire, base_key=key_in)
 
-    fn = jax.shard_map(pp_body, mesh=st['mesh'],
-                       in_specs=({n: P(axis) for n in stacked}, P(), P()),
-                       out_specs=P(), axis_names={axis}, check_vma=False)
+    fn = shard_map(pp_body, mesh=st['mesh'],
+                   in_specs=({n: P(axis) for n in stacked}, P(), P()),
+                   out_specs=P(), axis_names={axis}, check_vma=False)
     # the replicated micro operand crosses the boundary in the wire dtype:
     # its transpose is a psum over pp (f32 on CPU, see _cpu_mesh; the
     # stacked params are pp-sharded so their transpose needs no psum)
     micro = _split_micro(x_arr, n_micro).astype(wire)
+    # pin the Auto-axis shardings at the region boundary (auto_parallel
+    # planner): the micro reshape and the stacked stage params are where
+    # GSPMD otherwise guesses and falls back to involuntary replication
+    # inside the while body (MULTICHIP r05 cfg5 warnings)
+    plan = ap_planner.plan_for_state(st)
+    if plan is not None:
+        stacked = plan.constrain_stacked(stacked)
+        micro = plan.constrain_micro(micro)
     out = fn(stacked, micro, base_key)
+    if plan is not None:
+        out = plan.constrain_micro(out)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
+    if plan is not None:
+        out = plan.constrain_batch(out)
     return Tensor(out, stop_gradient=False)
 
 
@@ -313,11 +327,19 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
             if restore is not None:
                 restore()
 
-    fn = jax.shard_map(pp_body, mesh=st['mesh'],
-                       in_specs=({n: P() for n in params}, P(), P()),
-                       out_specs=P(), axis_names={axis}, check_vma=False)
-    out = fn(boundary, _split_micro(x_arr, n_micro).astype(wire), base_key)
+    fn = shard_map(pp_body, mesh=st['mesh'],
+                   in_specs=({n: P() for n in params}, P(), P()),
+                   out_specs=P(), axis_names={axis}, check_vma=False)
+    micro = _split_micro(x_arr, n_micro).astype(wire)
+    plan = ap_planner.plan_for_state(st)
+    if plan is not None:  # see pipeline_blocks: pin the micro boundary
+        micro = plan.constrain_micro(micro)
+    out = fn(boundary, micro, base_key)
+    if plan is not None:
+        out = plan.constrain_micro(out)
     out = out.reshape(x_arr.shape[:1] + out.shape[2:]).astype(dtype_like)
+    if plan is not None:
+        out = plan.constrain_batch(out)
     return Tensor(out, stop_gradient=False)
 
 

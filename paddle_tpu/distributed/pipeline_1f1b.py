@@ -39,6 +39,8 @@ from ..framework import functional as func_mod
 from ..framework import random as rng_mod
 from ..framework.core import Tensor
 from .pipeline import _cpu_mesh
+from .shard_map_compat import shard_map
+from .auto_parallel import planner as ap_planner
 
 __all__ = ['one_f_one_b_loss', 'supports_1f1b']
 
@@ -114,6 +116,14 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
     mb = b // n_micro
     micro_ids = inputs.reshape((n_micro, mb) + inputs.shape[1:])
     micro_lbl = labels.reshape((n_micro, mb) + labels.shape[1:])
+    # auto_parallel planner: pin the Auto-axis shardings at the region
+    # boundaries (microbatch stream + stacked stage params) so GSPMD has
+    # nothing to guess inside the while body — see planner.py for the
+    # root cause of the MULTICHIP r05 cfg5 involuntary-reshard warnings
+    plan = ap_planner.plan_pipeline(mesh, axis)
+    if plan is not None:
+        micro_ids = plan.constrain_micro(micro_ids)
+        micro_lbl = plan.constrain_micro(micro_lbl)
 
     # probe shapes eagerly (abstract eval only) to size the rotating bufs;
     # the key scope keeps any dropout draw inside the probe from leaking
@@ -161,6 +171,8 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
 
     def _run(p, key_in):
         stacked = stacked_of(p)
+        if plan is not None:
+            stacked = plan.constrain_stacked(stacked)
         outer = {n: p[n] for n in outer_names}
         pdtypes = {n: a.dtype for n, a in outer.items()}
         if cpu:
@@ -300,11 +312,17 @@ def one_f_one_b_loss(model, params, inputs, labels, state, loss_fn=None):
                     {n: P() for n in outer_in}, P(), P(), P())
         out_specs = (P(), {n: P() for n in outer_in},
                      {n: P(axis) for n in stacked})
-        fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                           out_specs=out_specs, axis_names={axis},
-                           check_vma=False)
+        fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, axis_names={axis},
+                       check_vma=False)
         loss, g_outer, g_blocks = fn(stacked, outer_in, micro_ids,
                                      micro_lbl, key_in)
+        if plan is not None:
+            # grads leave pp-sharded like the stacked params entered;
+            # the optimizer's ZeRO slice of a replicated-over-auto grad
+            # is a plain dynamic-slice (efficient), unlike a guessed
+            # tiled->tiled transition
+            g_blocks = plan.constrain_stacked(g_blocks)
         grads = {}
         for n, a in g_outer.items():
             grads[n] = a.astype(params[n].dtype)

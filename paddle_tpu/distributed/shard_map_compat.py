@@ -1,0 +1,54 @@
+"""One shard_map entry point across JAX generations.
+
+The pipeline engines run their schedules Manual over the 'pp' axis only,
+with every other mesh axis left Auto for GSPMD (pipeline.py docstring).
+Newer JAX spells that `jax.shard_map(..., axis_names={'pp'},
+check_vma=False)`; the 0.4.x line spells the same partitioning
+`jax.experimental.shard_map.shard_map(..., auto=<other axes>,
+check_rep=False)`. This shim speaks whichever dialect the installed JAX
+understands so the schedules (and the sharding auditor that compiles
+them) work on both.
+"""
+import jax
+from jax import lax
+
+__all__ = ['shard_map', 'axis_size']
+
+
+def axis_size(axis_name):
+    """lax.axis_size where available; psum-of-1 (which constant-folds to
+    the static axis extent) on jax lines that predate it."""
+    fn = getattr(lax, 'axis_size', None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    """Partial-manual shard_map: Manual over `axis_names`, Auto elsewhere.
+
+    axis_names: iterable of mesh axis names the body handles manually
+    (None = all of them). check_vma: the replication-checking flag
+    (check_rep on older JAX).
+    """
+    modern = getattr(jax, 'shard_map', None)
+    if modern is not None:
+        kwargs = {}
+        if axis_names is not None:
+            kwargs['axis_names'] = set(axis_names)
+        return modern(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check_vma, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+    auto = frozenset()
+    if axis_names is not None:
+        # only axes with real extent need Auto treatment — keeping size-1
+        # axes out of `auto` lets single-real-axis meshes run full-manual,
+        # which this jax line supports everywhere (its partial-auto path
+        # lowers axis_index to partition-id, unsupported under SPMD)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        auto = frozenset(a for a in mesh.axis_names
+                         if a not in frozenset(axis_names)
+                         and sizes.get(a, 1) > 1)
+    return legacy(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=bool(check_vma), auto=auto)

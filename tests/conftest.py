@@ -18,6 +18,12 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: scale/perf datapoints excluded from the tier-1 '
+        "run (-m 'not slow')")
+
+
 @pytest.fixture
 def seed():
     import paddle_tpu as paddle

@@ -1,0 +1,135 @@
+"""Sharding-regression gate over MULTICHIP dryrun captures.
+
+Compares a NEW multichip capture (the {n_devices, rc, ok, tail} JSON
+the driver stores as MULTICHIP_rNN.json) against a stored baseline
+capture and FAILS (exit 1) when the new run's sharding audit shows
+involuntary-reshard events the baseline did not have — the same spirit
+as tools/check_bench_regression.py, but the metric is "GSPMD last-
+resort replications" instead of throughput.
+
+Events are read from BOTH encodings a capture tail can carry:
+
+  * `sharding_audit(N)[tag]: {json}` lines — what __graft_entry__'s
+    dryrun prints per config since the auto_parallel subsystem landed
+    (events keyed per config label);
+  * raw `spmd_partitioner` warning lines — what pre-audit captures
+    (e.g. MULTICHIP_r05.json) contain, parsed by the same
+    auto_parallel parser the test suite pins against fixtures. Raw
+    events are unlabeled and shared across configs.
+
+An event "is in the baseline" if its identity key (opcode, dtype,
+shape, op_name, source/target shardings — HLO value numbering
+excluded) appears under the same config label or among the baseline's
+raw events. Baseline events missing from the new run are fine (that is
+the fix landing); new ones fail with a diff.
+
+Usage:
+    python tools/check_sharding_regression.py --new MULTICHIP_r06.json \
+        [--baseline MULTICHIP_r05.json]
+
+With no --baseline, the newest MULTICHIP_r*.json in the repo root
+other than --new is used. Exit codes: 0 ok, 1 new involuntary-reshard
+events, 2 nothing to compare.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from paddle_tpu.distributed.auto_parallel import (  # noqa: E402
+    ShardingAuditReport, parse_spmd_warnings)
+
+__all__ = ['extract_events', 'check', 'main']
+
+_AUDIT_LINE = re.compile(r'sharding_audit\(\d+\)\[(?P<tag>[^\]]*)\]:\s*'
+                         r'(?P<json>\{.*\})\s*$')
+_RAW_LABEL = '_raw'
+
+
+def extract_events(tail):
+    """{label: [ShardingEvent]} from a capture tail (both encodings)."""
+    out = {}
+    for line in (tail or '').splitlines():
+        m = _AUDIT_LINE.search(line)
+        if not m:
+            continue
+        try:
+            rep = ShardingAuditReport.from_dict(json.loads(m.group('json')))
+        except ValueError:
+            continue
+        out.setdefault(m.group('tag'), []).extend(rep.events)
+    raw = parse_spmd_warnings(tail)
+    if raw:
+        out.setdefault(_RAW_LABEL, []).extend(raw)
+    return out
+
+
+def check(new_tail, baseline_tail):
+    """Pure gate: list of regression findings (empty == pass)."""
+    new_by_label = extract_events(new_tail)
+    base_by_label = extract_events(baseline_tail)
+    base_raw = {e.key() for e in base_by_label.get(_RAW_LABEL, ())}
+    findings = []
+    for label, events in sorted(new_by_label.items()):
+        known = {e.key() for e in base_by_label.get(label, ())} | base_raw
+        if label == _RAW_LABEL:
+            # raw lines are unlabeled: compare against everything stored
+            known = {e.key() for evs in base_by_label.values()
+                     for e in evs}
+        for e in events:
+            if e.key() in known:
+                continue
+            findings.append({
+                'config': label,
+                'event': e.to_dict(),
+                'note': 'involuntary reshard not present in baseline',
+            })
+    return findings
+
+
+def _load_tail(path):
+    with open(path, errors='replace') as f:
+        cap = json.load(f)
+    return cap.get('tail', '')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--new', required=True, help='new MULTICHIP capture')
+    ap.add_argument('--baseline', default=None,
+                    help='stored capture (default: newest MULTICHIP_r*.json '
+                         'in the repo root other than --new)')
+    args = ap.parse_args(argv)
+
+    baseline = args.baseline
+    if baseline is None:
+        cands = sorted(glob.glob(os.path.join(_REPO_ROOT,
+                                              'MULTICHIP_r*.json')))
+        cands = [p for p in cands
+                 if os.path.abspath(p) != os.path.abspath(args.new)]
+        baseline = cands[-1] if cands else None
+    if baseline is None or not os.path.exists(baseline):
+        print(json.dumps({'checked': 0, 'note': 'no baseline capture'}))
+        return 2
+    new_tail = _load_tail(args.new)
+    base_tail = _load_tail(baseline)
+    n_new = sum(len(v) for v in extract_events(new_tail).values())
+    findings = check(new_tail, base_tail)
+    for f in findings:
+        print(json.dumps(dict(f, regression=True)))
+    if not findings:
+        print(json.dumps({'regressions': 0, 'events_seen': n_new,
+                          'baseline': os.path.basename(baseline),
+                          'ok': True}))
+        return 0
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

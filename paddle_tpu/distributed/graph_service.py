@@ -14,11 +14,18 @@ import threading
 
 import numpy as np
 
+from ..monitor import default_registry as _monitor_registry
 from ..native.graph_store import GraphStore
 from .ps.embedding_service import _send_msg, _recv_msg
 from .resilience import Deadline, ResilientChannel, RetryPolicy
 
 __all__ = ['GraphPyService', 'GraphPyServer', 'GraphPyClient']
+
+_M_GRAPH_CALLS = _monitor_registry().counter(
+    'graph_client_calls_total', 'graph-service client RPCs by op', ('op',))
+_M_GRAPH_ERRORS = _monitor_registry().counter(
+    'graph_client_call_errors_total',
+    'graph-service client RPCs that raised', ('op',))
 
 
 class _GraphHandler(socketserver.BaseRequestHandler):
@@ -142,9 +149,17 @@ class GraphPyClient:
             else Deadline(self._op_deadline)
 
     def _call(self, server_idx, msg, idempotent=True, deadline=None):
-        out = self._channels[server_idx].call(msg, idempotent=idempotent,
-                                              deadline=deadline)
+        op = str(msg.get('op', '?'))
+        _M_GRAPH_CALLS.labels(op).inc()
+        try:
+            out = self._channels[server_idx].call(msg,
+                                                  idempotent=idempotent,
+                                                  deadline=deadline)
+        except Exception:
+            _M_GRAPH_ERRORS.labels(op).inc()
+            raise
         if isinstance(out, dict) and 'error' in out:
+            _M_GRAPH_ERRORS.labels(op).inc()
             raise RuntimeError(out['error'])
         return out
 

@@ -20,10 +20,20 @@ import threading
 
 import numpy as np
 
+from ...monitor import default_registry as _monitor_registry
 from ..resilience import Deadline, ResilientChannel, call_once
 
 __all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient',
            'CountFilterEntry', 'ProbabilityEntry']
+
+# per-op RPC counters (label set is the closed op vocabulary — bounded
+# cardinality; see docs/observability.md)
+_M_PS_CALLS = _monitor_registry().counter(
+    'ps_client_calls_total', 'embedding-service client RPCs by op',
+    ('op',))
+_M_PS_ERRORS = _monitor_registry().counter(
+    'ps_client_call_errors_total',
+    'embedding-service client RPCs that raised', ('op',))
 
 
 class _SparseOptimizer:
@@ -384,18 +394,32 @@ class EmbeddingClient:
 
     def _call(self, s, msg, idempotent=True, deadline=None):
         """Remote call to server s with error propagation."""
-        out = self._channels[s].call(msg, idempotent=idempotent,
-                                     deadline=deadline)
+        op = str(msg.get('op', '?'))
+        _M_PS_CALLS.labels(op).inc()
+        try:
+            out = self._channels[s].call(msg, idempotent=idempotent,
+                                         deadline=deadline)
+        except Exception:
+            _M_PS_ERRORS.labels(op).inc()
+            raise
         if isinstance(out, dict) and 'error' in out:
+            _M_PS_ERRORS.labels(op).inc()
             raise RuntimeError(out['error'])
         return out
 
     def _call_fresh(self, s, msg, timeout=None):
         """Blocking RPC (e.g. barrier) over a NEW ephemeral connection so
         the persistent per-server channel stays free for fast ops."""
+        op = str(msg.get('op', '?'))
+        _M_PS_CALLS.labels(op).inc()
         kw = {} if timeout is None else {'timeout': timeout}
-        out = call_once(self._endpoints[s], msg, **kw)
+        try:
+            out = call_once(self._endpoints[s], msg, **kw)
+        except Exception:
+            _M_PS_ERRORS.labels(op).inc()
+            raise
         if isinstance(out, dict) and 'error' in out:
+            _M_PS_ERRORS.labels(op).inc()
             raise RuntimeError(out['error'])
         return out
 

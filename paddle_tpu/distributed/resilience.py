@@ -25,6 +25,8 @@ import struct
 import threading
 import time
 
+from ..monitor import default_registry as _monitor_registry
+
 __all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
            'RpcError', 'RetryableError', 'DeadlineExceeded',
            'CircuitOpenError', 'DEFAULT_CALL_TIMEOUT',
@@ -32,6 +34,36 @@ __all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
 
 DEFAULT_CALL_TIMEOUT = 30.0      # per-attempt send+recv budget (seconds)
 DEFAULT_CONNECT_TIMEOUT = 5.0
+
+
+# -- observability (paddle_tpu/monitor) -------------------------------------
+# Families bind once at import; channels/breakers cache their labeled
+# children at construction, so the per-call cost is one enabled-flag
+# check per event (and nothing at all for events that don't happen).
+_REG = _monitor_registry()
+_M_ATTEMPTS = _REG.counter(
+    'rpc_attempts_total', 'RPC attempts begun (first tries + retries)',
+    ('endpoint',))
+_M_FAILURES = _REG.counter(
+    'rpc_attempt_failures_total',
+    'retryable transport failures (each feeds the circuit breaker)',
+    ('endpoint',))
+_M_BACKOFF = _REG.counter(
+    'rpc_backoff_seconds_total', 'seconds slept between retries',
+    ('endpoint',))
+_M_DEADLINE = _REG.counter(
+    'rpc_deadline_expired_total', 'calls that died on their deadline',
+    ('endpoint',))
+_M_CIRCUIT_REJECT = _REG.counter(
+    'rpc_circuit_open_total', 'calls fast-failed by an open breaker',
+    ('endpoint',))
+_M_TRANSITIONS = _REG.counter(
+    'rpc_breaker_transitions_total', 'circuit-breaker state transitions',
+    ('endpoint', 'to'))
+_M_BREAKER_STATE = _REG.gauge(
+    'rpc_breaker_state', 'current breaker state: 0 closed, 1 open, '
+    '2 half-open', ('endpoint',))
+_STATE_CODES = {'closed': 0, 'open': 1, 'half_open': 2}
 
 
 # -- fault-injection hook points (see paddle_tpu/testing/chaos.py) ----------
@@ -147,13 +179,30 @@ class CircuitBreaker:
 
     CLOSED, OPEN, HALF_OPEN = 'closed', 'open', 'half_open'
 
-    def __init__(self, failure_threshold=5, reset_timeout=5.0):
+    def __init__(self, failure_threshold=5, reset_timeout=5.0, name=None):
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout = float(reset_timeout)
         self._failures = 0
         self._opened_at = None
         self._probing = False
         self._lock = threading.Lock()
+        self._m_state = None
+        self.name = None
+        if name is not None:
+            self.bind_name(name)
+
+    def bind_name(self, name):
+        """Label this breaker's metrics with `name` (its endpoint).
+        Unnamed breakers stay un-instrumented — standalone unit-test
+        breakers don't pollute the endpoint label space."""
+        self.name = name
+        self._m_state = _M_BREAKER_STATE.labels(name)
+        self._m_state.set(_STATE_CODES[self.CLOSED])
+
+    def _note_transition(self, to_state):
+        if self._m_state is not None:
+            _M_TRANSITIONS.labels(self.name, to_state).inc()
+            self._m_state.set(_STATE_CODES[to_state])
 
     @property
     def state(self):
@@ -175,22 +224,30 @@ class CircuitBreaker:
                 return True
             if st == self.HALF_OPEN and not self._probing:
                 self._probing = True
+                # the observable open -> half_open moment: a probe claim
+                self._note_transition(self.HALF_OPEN)
                 return True
             return False
 
     def record_success(self):
         with self._lock:
+            was = self._state_locked()
             self._failures = 0
             self._opened_at = None
             self._probing = False
+            if was != self.CLOSED:
+                self._note_transition(self.CLOSED)
 
     def record_failure(self):
         with self._lock:
+            was = self._state_locked()
             self._failures += 1
             self._probing = False
             if self._failures >= self.failure_threshold:
                 # (re)open and restart the reset window
                 self._opened_at = time.monotonic()
+                if was != self.OPEN:
+                    self._note_transition(self.OPEN)
 
 
 # -- framed messages over the PS wire codec ---------------------------------
@@ -242,7 +299,16 @@ class ResilientChannel:
         self.policy = retry_policy or RetryPolicy()
         self.call_timeout = call_timeout
         self.connect_timeout = connect_timeout
-        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker(name=endpoint)
+        if self.breaker.name is None:
+            self.breaker.bind_name(endpoint)
+        # labeled children cached once: per-event cost is a flag check
+        self._m_attempts = _M_ATTEMPTS.labels(endpoint)
+        self._m_failures = _M_FAILURES.labels(endpoint)
+        self._m_backoff = _M_BACKOFF.labels(endpoint)
+        self._m_deadline = _M_DEADLINE.labels(endpoint)
+        self._m_circuit = _M_CIRCUIT_REJECT.labels(endpoint)
         self._sock = None
         self._lock = threading.Lock()
 
@@ -299,29 +365,34 @@ class ResilientChannel:
         with self._lock:
             for attempt in range(1, attempts + 1):
                 if deadline is not None and deadline.expired():
+                    self._m_deadline.inc()
                     raise DeadlineExceeded(
                         'deadline expired before attempt %d to %s'
                         % (attempt, self.endpoint),
                         endpoint=self.endpoint, attempts=attempt - 1) \
                         from last_exc
                 if not self.breaker.allow():
+                    self._m_circuit.inc()
                     raise CircuitOpenError(
                         'circuit open for %s (%d consecutive failures)'
                         % (self.endpoint, self.breaker._failures),
                         endpoint=self.endpoint, attempts=attempt - 1) \
                         from last_exc
                 try:
+                    self._m_attempts.inc()
                     out = self._attempt(msg, timeout, deadline)
                     self.breaker.record_success()
                     return out
                 except DeadlineExceeded:
                     self._drop_connection()
+                    self._m_deadline.inc()
                     raise
                 except Exception as e:
                     self._drop_connection()
                     if not self.policy.is_retryable(e):
                         raise
                     self.breaker.record_failure()
+                    self._m_failures.inc()
                     last_exc = e
                     if attempt < attempts:
                         delay = self.policy.backoff(attempt)
@@ -330,8 +401,10 @@ class ResilientChannel:
                             if rem <= 0:
                                 break
                             delay = min(delay, rem)
+                        self._m_backoff.inc(delay)
                         time.sleep(delay)
         if deadline is not None and deadline.expired():
+            self._m_deadline.inc()
             raise DeadlineExceeded(
                 'deadline expired after %d attempts to %s: %r'
                 % (attempts, self.endpoint, last_exc),

@@ -6,7 +6,7 @@ import numpy as np
 
 __all__ = ['Callback', 'ProgBarLogger', 'ModelCheckpoint', 'LRScheduler',
            'EarlyStopping', 'VisualDL', 'ReduceLROnPlateau',
-           'config_callbacks']
+           'TelemetryCallback', 'config_callbacks']
 
 
 class CallbackList:
@@ -245,6 +245,70 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
               'verbose': verbose, 'metrics': metrics or []}
     cbk_list.set_params(params)
     return cbk_list
+
+
+class TelemetryCallback(Callback):
+    """Feed Model.fit progress into the monitor registry
+    (paddle_tpu/monitor) so a training run is scrapeable while it runs:
+
+        model.fit(..., callbacks=[TelemetryCallback()])
+        # elsewhere: monitor.MetricsServer().start() and curl /metrics
+
+    Step wall time (histogram), steps/examples counters, examples/s and
+    loss gauges, current epoch; optionally one RuntimeSampler capture
+    every `sample_every` steps (RSS / live arrays / cache sizes move
+    slowly — per-step sampling would cost more than it tells).
+    """
+
+    def __init__(self, registry=None, sample_every=50, clock=None):
+        super().__init__()
+        from ..monitor import RuntimeSampler, default_registry
+        from ..monitor.registry import exponential_buckets
+        r = registry if registry is not None else default_registry()
+        self.registry = r
+        self.sample_every = int(sample_every)
+        self._clock = clock or time.monotonic
+        self._t0 = None
+        self._seen = 0
+        self._sampler = RuntimeSampler(registry=r) if sample_every else None
+        self._m_steps = r.counter('train_steps_total', 'train steps run')
+        self._m_examples = r.counter('train_examples_total',
+                                     'examples consumed')
+        self._m_step_time = r.histogram(
+            'train_step_duration_seconds', 'train step wall time',
+            buckets=exponential_buckets(0.001, 2.0, 16))
+        self._m_eps = r.gauge('train_examples_per_second',
+                              'examples/s of the last step')
+        self._m_loss = r.gauge('train_loss', 'loss of the last step')
+        self._m_epoch = r.gauge('train_epoch', 'current epoch index')
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._m_epoch.set(epoch)
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t0 = self._clock()
+
+    def on_train_batch_end(self, step, logs=None):
+        dt = (self._clock() - self._t0) if self._t0 is not None else None
+        self._t0 = None
+        if dt is not None:
+            self._m_step_time.observe(dt)
+        self._m_steps.inc()
+        batch = self.params.get('batch_size')
+        if batch:
+            self._m_examples.inc(batch)
+            if dt:
+                self._m_eps.set(batch / dt)
+        loss = _monitor_value(logs, 'loss')
+        if loss is not None:
+            self._m_loss.set(loss)
+        self._seen += 1
+        if self._sampler is not None and self._seen % self.sample_every == 0:
+            self._sampler.sample_once()
+
+    def on_train_end(self, logs=None):
+        if self._sampler is not None:
+            self._sampler.sample_once()
 
 
 class ReduceLROnPlateau(Callback):

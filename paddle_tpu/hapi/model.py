@@ -137,7 +137,7 @@ class Model:
         cbks = config_callbacks(callbacks, model=self, epochs=epochs,
                                 steps=steps, log_freq=log_freq,
                                 save_freq=save_freq, save_dir=save_dir,
-                                verbose=verbose,
+                                verbose=verbose, batch_size=batch_size,
                                 metrics=[m.name() for m in self._metrics])
         cbks.on_train_begin()
         self.stop_training = False

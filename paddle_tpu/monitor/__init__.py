@@ -1,0 +1,31 @@
+"""Unified observability (reference: paddle/fluid/platform/monitor.h
+StatRegistry/STAT_ADD grown into a scrapeable subsystem).
+
+Four layers, each usable alone:
+
+- ``registry``  — thread-safe Counter/Gauge/Histogram families with
+  labels, get-or-create semantics, and a near-zero-cost disabled path;
+- ``export``    — Prometheus text exposition + JSON snapshots;
+- ``server``    — MetricsServer: stdlib http.server on /metrics,
+  /healthz (and /metrics.json) for curl / Prometheus scrapes;
+- ``runtime``   — RuntimeSampler: host RSS, live jax array bytes,
+  device count, tracing-cache sizes on a background thread.
+
+Built-in instrumentation (resilient RPC, the serving engine, PS/graph
+clients, hapi TelemetryCallback, the dryrun telemetry line) feeds
+``default_registry()``; point a MetricsServer at it and scrape. See
+docs/observability.md for naming/cardinality conventions and the
+metric inventory.
+"""
+from .registry import (Counter, Gauge, Histogram, MetricRegistry,
+                       default_registry, exponential_buckets,
+                       set_default_registry)
+from .export import schema_of, to_dict, to_json, to_prometheus
+from .server import MetricsServer
+from .runtime import RuntimeSampler
+from . import telemetry
+
+__all__ = ['MetricRegistry', 'Counter', 'Gauge', 'Histogram',
+           'exponential_buckets', 'default_registry',
+           'set_default_registry', 'to_prometheus', 'to_dict', 'to_json',
+           'schema_of', 'MetricsServer', 'RuntimeSampler', 'telemetry']

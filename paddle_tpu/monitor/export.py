@@ -1,0 +1,114 @@
+"""Exporters: Prometheus text exposition (format 0.0.4) and JSON
+snapshots of a MetricRegistry.
+
+The text format is what `curl :port/metrics` and every Prometheus scraper
+consume; the JSON snapshot is the machine-diffable form the dryrun
+telemetry line and tools/check_metrics_snapshot.py work from (schema =
+metric names + label keys, the part a silent de-instrumentation breaks).
+"""
+import json
+import math
+
+__all__ = ['to_prometheus', 'to_dict', 'to_json', 'schema_of']
+
+
+def _esc_help(s):
+    return s.replace('\\', '\\\\').replace('\n', '\\n')
+
+
+def _esc_label(s):
+    return (s.replace('\\', '\\\\').replace('\n', '\\n')
+            .replace('"', '\\"'))
+
+
+def _fmt_value(v):
+    if isinstance(v, float) and math.isinf(v):
+        return '+Inf' if v > 0 else '-Inf'
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return repr(int(v))
+    return repr(float(v))
+
+
+def _labels_text(names, values, extra=()):
+    pairs = ['%s="%s"' % (n, _esc_label(v))
+             for n, v in zip(names, values)]
+    pairs.extend('%s="%s"' % (n, _esc_label(str(v))) for n, v in extra)
+    return '{%s}' % ','.join(pairs) if pairs else ''
+
+
+def to_prometheus(registry):
+    """The registry as Prometheus text exposition (one scrape body)."""
+    out = []
+    for fam in registry.collect():
+        out.append('# HELP %s %s' % (fam.name, _esc_help(fam.help)))
+        out.append('# TYPE %s %s' % (fam.name, fam.kind))
+        for values, child in fam.samples():
+            if fam.kind == 'histogram':
+                snap = child.snapshot()
+                acc = 0
+                for bound, n in zip(fam.buckets, snap['buckets']):
+                    acc += n
+                    out.append('%s_bucket%s %s' % (
+                        fam.name,
+                        _labels_text(fam.labelnames, values,
+                                     [('le', _fmt_value(float(bound)))]),
+                        acc))
+                acc += snap['buckets'][-1]
+                out.append('%s_bucket%s %s' % (
+                    fam.name,
+                    _labels_text(fam.labelnames, values,
+                                 [('le', '+Inf')]), acc))
+                lbl = _labels_text(fam.labelnames, values)
+                out.append('%s_sum%s %s' % (fam.name, lbl,
+                                            _fmt_value(snap['sum'])))
+                out.append('%s_count%s %d' % (fam.name, lbl, snap['count']))
+            else:
+                out.append('%s%s %s' % (
+                    fam.name, _labels_text(fam.labelnames, values),
+                    _fmt_value(child.value())))
+    return '\n'.join(out) + '\n'
+
+
+def to_dict(registry, buckets=True):
+    """JSON-able snapshot: {name: {type, labels, samples: [...]}}.
+
+    Each sample is {'labels': {...}} plus either {'value': v} (counter /
+    gauge) or {'count': n, 'sum': s[, 'buckets': {...}]} (histogram).
+    `buckets=False` trims per-bucket counts — what the one-line dryrun
+    telemetry snapshot wants.
+    """
+    out = {}
+    for fam in registry.collect():
+        samples = []
+        for values, child in fam.samples():
+            s = {'labels': dict(zip(fam.labelnames, values))}
+            if fam.kind == 'histogram':
+                snap = child.snapshot()
+                s['count'] = snap['count']
+                s['sum'] = snap['sum']
+                if buckets:
+                    s['buckets'] = {
+                        _fmt_value(float(b)): n
+                        for b, n in zip(fam.buckets, snap['buckets'])}
+                    s['buckets']['+Inf'] = snap['buckets'][-1]
+            else:
+                s['value'] = child.value()
+            samples.append(s)
+        out[fam.name] = {'type': fam.kind,
+                         'labels': list(fam.labelnames),
+                         'samples': samples}
+    return out
+
+
+def to_json(registry, **kw):
+    return json.dumps(to_dict(registry, **kw), sort_keys=True,
+                      separators=(',', ':'))
+
+
+def schema_of(snapshot):
+    """{metric name: {'type': kind, 'labels': sorted label keys}} from a
+    to_dict() snapshot — the identity the regression gate diffs; values
+    and label VALUES are deliberately excluded."""
+    return {name: {'type': fam['type'],
+                   'labels': sorted(fam['labels'])}
+            for name, fam in snapshot.items()}

@@ -1,0 +1,113 @@
+"""Scrape endpoint: a stdlib http.server serving /metrics and /healthz.
+
+Prometheus-compatible without the prometheus_client dependency (the
+image bakes nothing in): text exposition 0.0.4 on /metrics, a tiny JSON
+liveness body on /healthz, 404 elsewhere. Ephemeral-port by default so
+tests and multi-engine processes never collide; `.port`/`.url` report
+the bound address.
+"""
+import http.server
+import json
+import threading
+import time
+
+from . import export
+from .registry import default_registry
+
+__all__ = ['MetricsServer']
+
+CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    # one scrape per connection is fine; keep-alive complicates shutdown
+    protocol_version = 'HTTP/1.0'
+
+    def do_GET(self):
+        path = self.path.split('?', 1)[0]
+        if path == '/metrics':
+            body = export.to_prometheus(self.server.registry).encode()
+            self._reply(200, CONTENT_TYPE, body)
+        elif path in ('/healthz', '/health'):
+            body = json.dumps({
+                'status': 'ok',
+                'uptime_s': round(time.monotonic() - self.server.started,
+                                  3)}).encode()
+            self._reply(200, 'application/json', body)
+        elif path == '/metrics.json':
+            body = export.to_json(self.server.registry).encode()
+            self._reply(200, 'application/json', body)
+        else:
+            self._reply(404, 'text/plain; charset=utf-8', b'not found\n')
+
+    def _reply(self, code, ctype, body):
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):
+        pass  # scrapes every few seconds must not spam stderr
+
+
+class _HTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MetricsServer:
+    """Background scrape server over one registry.
+
+        srv = MetricsServer()            # default registry, ephemeral port
+        srv.start()
+        ... curl http://127.0.0.1:<srv.port>/metrics ...
+        srv.stop()
+
+    Also a context manager. Serving runs on a daemon thread, so a process
+    exit never hangs on an open scrape socket.
+    """
+
+    def __init__(self, registry=None, host='127.0.0.1', port=0):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self._host = host
+        self._port = int(port)
+        self._srv = None
+        self._thread = None
+
+    def start(self):
+        if self._srv is not None:
+            return self
+        self._srv = _HTTPServer((self._host, self._port), _Handler)
+        self._srv.registry = self.registry
+        self._srv.started = time.monotonic()
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name='metrics-server', daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._srv is None:
+            return
+        self._srv.shutdown()
+        self._srv.server_close()
+        self._srv = None
+        self._thread = None
+
+    @property
+    def port(self):
+        if self._srv is None:
+            raise RuntimeError('server not started')
+        return self._srv.server_address[1]
+
+    @property
+    def url(self):
+        return 'http://%s:%d' % (self._host, self.port)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,71 @@
+"""Dryrun telemetry snapshot lines (the sharding_audit pattern applied
+to metrics): one `telemetry_snapshot(N)[tag]: {json}` line per driver
+config, parsed back by tools/check_metrics_snapshot.py and diffed
+against a committed schema baseline so an instrumented metric cannot
+silently disappear.
+"""
+import json
+import re
+
+from . import export
+from .registry import MetricRegistry
+from .runtime import RuntimeSampler
+
+__all__ = ['record_dryrun_step', 'snapshot_line', 'parse_snapshot_lines',
+           'LINE_RE']
+
+LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
+                     r'\[(?P<tag>[^\]]*)\]:\s*(?P<json>\{.*\})\s*$')
+
+
+def record_dryrun_step(registry, step_seconds, loss, batch=None):
+    """The per-config training gauges the dryrun embeds. Kept in one
+    place so the driver and the schema-baseline test register the exact
+    same families."""
+    registry.gauge('train_step_seconds',
+                   'wall time of the measured train step').set(step_seconds)
+    registry.gauge('train_loss', 'loss of the measured step').set(loss)
+    registry.counter('train_steps_total', 'train steps run').inc()
+    if batch:
+        registry.counter('train_examples_total',
+                         'examples consumed').inc(batch)
+        if step_seconds > 0:
+            registry.gauge('train_examples_per_second',
+                           'examples/s of the measured step').set(
+                               batch / step_seconds)
+
+
+def dryrun_registry(step_seconds, loss, batch=None):
+    """Fresh per-config registry holding the full dryrun telemetry
+    schema: training gauges + one runtime sample."""
+    reg = MetricRegistry()
+    record_dryrun_step(reg, step_seconds, loss, batch=batch)
+    RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
+    return reg
+
+
+def snapshot_line(registry, n_devices, tag):
+    """One parseable line embedding the registry snapshot (no per-bucket
+    detail — schema + scalar values only, keeps the line short).
+
+    `tag` follows the sharding_audit convention: the driver's config
+    label INCLUDING its brackets (e.g. '[dp/mp/sharding fused-ce]')."""
+    snap = export.to_dict(registry, buckets=False)
+    return 'telemetry_snapshot(%d)%s: %s' % (
+        n_devices, tag, json.dumps(snap, sort_keys=True,
+                                   separators=(',', ':')))
+
+
+def parse_snapshot_lines(text):
+    """{tag: snapshot dict} from captured driver output (tolerates
+    interleaved non-telemetry lines; later duplicates of a tag win)."""
+    out = {}
+    for line in (text or '').splitlines():
+        m = LINE_RE.search(line)
+        if not m:
+            continue
+        try:
+            out[m.group('tag')] = json.loads(m.group('json'))
+        except ValueError:
+            continue
+    return out

@@ -105,6 +105,14 @@ class ContinuousBatchingEngine:
         # is `trace_counts stays {"prefill": 1, "decode": 1}` across an
         # arbitrary admit/retire workload
         self.trace_counts = {'prefill': 0, 'decode': 0}
+        # scrape-visible retrace canary: flat at 1/1 == the zero-retrace
+        # contract holds in production, not just under the test
+        trace_gauge = self.metrics.registry.gauge(
+            'serving_trace_count',
+            'times each serving program has been traced '
+            '(flat == zero retrace)', ('program',))
+        self._m_trace = {k: trace_gauge.labels(k)
+                         for k in self.trace_counts}
         if donate is None:
             # cache buffers dominate engine memory; donating them lets
             # XLA update in place. CPU donation is a no-op that warns.
@@ -209,6 +217,9 @@ class ContinuousBatchingEngine:
             self._prefill_step()
             self._decode_step()
             self.metrics.on_step(self.allocator.in_use, self.num_slots)
+            self.metrics.on_queue_depth(len(self.scheduler.queue))
+            for prog, child in self._m_trace.items():
+                child.set(self.trace_counts[prog])
             return self.scheduler.pending
 
     def run(self):
@@ -256,6 +267,7 @@ class ContinuousBatchingEngine:
 
     def _admit(self):
         for slot, req in self.scheduler.admit():
+            self.metrics.on_admitted(req.id)
             self._requests[slot] = req
             self._budgets[slot] = req.max_new_tokens
             self._temps[slot] = req.temperature
@@ -330,3 +342,4 @@ class ContinuousBatchingEngine:
         self._active[slot] = False
         del self._requests[slot]
         self.scheduler.retire(req)
+        self.metrics.on_retired(req.id)

@@ -5,20 +5,33 @@ deterministic tests). The latency distribution that matters for serving
 is PER-TOKEN (inter-token gap) plus time-to-first-token — a mean hides
 exactly the tail that continuous batching is supposed to fix, hence
 p50/p99.
+
+Two outputs from the same events:
+
+- ``report()`` — the in-process dict the benches and tests consume
+  (unchanged public API);
+- the shared monitor registry (paddle_tpu/monitor) — labeled counters /
+  gauges / histograms any MetricsServer scrape sees, so a serving
+  process is observable from outside without touching the engine.
+  Latency targets for dashboards live in docs/observability.md.
 """
 import time
+
+from ..monitor import exponential_buckets
+from ..monitor.registry import default_registry
 
 __all__ = ['ServingMetrics', 'percentile']
 
 
 def percentile(values, q):
-    """Nearest-rank percentile (q in [0, 100]) without numpy."""
+    """Linear-interpolation percentile (q in [0, 100]) without numpy —
+    interpolates between the two closest ranks, matching numpy's default
+    ('linear') method, NOT nearest-rank."""
     if not values:
         return None
     xs = sorted(values)
     if len(xs) == 1:
         return xs[0]
-    # linear interpolation between closest ranks (numpy default method)
     pos = (len(xs) - 1) * (q / 100.0)
     lo = int(pos)
     hi = min(lo + 1, len(xs) - 1)
@@ -26,9 +39,17 @@ def percentile(values, q):
     return xs[lo] * (1.0 - frac) + xs[hi] * frac
 
 
+# inter-token gaps live around 1-100 ms on hardware, seconds on CPU CI;
+# TTFT adds prefill, so its ladder starts higher and stretches further
+_GAP_BUCKETS = exponential_buckets(0.0005, 2.0, 16)     # 0.5 ms .. ~16 s
+_TTFT_BUCKETS = exponential_buckets(0.002, 2.0, 16)     # 2 ms .. ~65 s
+
+
 class ServingMetrics:
-    def __init__(self, clock=None):
+    def __init__(self, clock=None, registry=None):
         self._clock = clock or time.monotonic
+        self.registry = registry if registry is not None \
+            else default_registry()
         self._start = None
         self._end = None
         self._arrival = {}        # rid -> t
@@ -37,6 +58,25 @@ class ServingMetrics:
         self._gaps = []           # inter-token gaps (incl. arrival->first)
         self._tokens = 0
         self._occupancy = []      # per-step occupied-slot fractions
+        r = self.registry
+        self._m_requests = r.counter('serving_requests_total',
+                                     'requests submitted to the engine')
+        self._m_admitted = r.counter('serving_requests_admitted_total',
+                                     'requests bound to a KV slot')
+        self._m_retired = r.counter('serving_requests_retired_total',
+                                    'requests finished and released')
+        self._m_tokens = r.counter('serving_tokens_total',
+                                   'tokens emitted to consumers')
+        self._m_ttft = r.histogram('serving_ttft_seconds',
+                                   'arrival to first visible token',
+                                   buckets=_TTFT_BUCKETS)
+        self._m_gap = r.histogram('serving_inter_token_seconds',
+                                  'per-token gap (burst spread over its '
+                                  'tokens)', buckets=_GAP_BUCKETS)
+        self._m_queue = r.gauge('serving_queue_depth',
+                                'requests waiting for a slot')
+        self._m_occupancy = r.gauge('serving_occupancy',
+                                    'occupied-slot fraction, last step')
 
     def now(self):
         return self._clock()
@@ -46,6 +86,16 @@ class ServingMetrics:
         self._arrival[rid] = t
         if self._start is None:
             self._start = t
+        self._m_requests.inc()
+
+    def on_admitted(self, rid, t=None):
+        self._m_admitted.inc()
+
+    def on_retired(self, rid, t=None):
+        self._m_retired.inc()
+
+    def on_queue_depth(self, depth):
+        self._m_queue.set(depth)
 
     def on_tokens(self, rid, count, t=None):
         """`count` tokens became visible for request rid at time t.
@@ -62,14 +112,22 @@ class ServingMetrics:
         if rid not in self._first_token:
             self._first_token[rid] = t
             prev = self._arrival.get(rid, t)
+            if rid in self._arrival:
+                self._m_ttft.observe(t - self._arrival[rid])
         if prev is not None:
-            self._gaps.extend([(t - prev) / count] * count)
+            gap = (t - prev) / count
+            self._gaps.extend([gap] * count)
+            for _ in range(count):
+                self._m_gap.observe(gap)
         self._last_token[rid] = t
         self._tokens += count
+        self._m_tokens.inc(count)
         self._end = t
 
     def on_step(self, occupied, num_slots):
-        self._occupancy.append(occupied / float(num_slots))
+        frac = occupied / float(num_slots)
+        self._occupancy.append(frac)
+        self._m_occupancy.set(frac)
 
     def report(self):
         elapsed = ((self._end - self._start)

@@ -1,0 +1,391 @@
+"""Observability subsystem tests (paddle_tpu/monitor/).
+
+The load-bearing assertions:
+  1. counters are EXACT under heavy thread contention (the chaos
+     harness uses them as a correctness oracle, so ~N is a fail);
+  2. the /metrics body is valid Prometheus text exposition, verified by
+     an independent parser in this file, not by string-matching what the
+     exporter happens to emit;
+  3. the disabled-registry fast path adds no measurable overhead to
+     ResilientChannel.call (generous bound — this guards the design,
+     not a microbenchmark number);
+  4. the dryrun telemetry snapshot round-trips through
+     tools/check_metrics_snapshot.py against the committed baseline.
+"""
+import json
+import math
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import (MetricRegistry, MetricsServer,
+                                RuntimeSampler, exponential_buckets,
+                                schema_of, to_dict, to_prometheus)
+
+REPO = __file__.rsplit('/tests/', 1)[0]
+
+
+# -- registry semantics ------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    r = MetricRegistry()
+    c = r.counter('ops_total', 'ops', ('kind',))
+    c.labels('read').inc()
+    c.labels('read').inc(2.5)
+    c.labels(kind='write').inc()
+    assert c.labels('read').value() == 3.5
+    assert c.labels('write').value() == 1.0
+    with pytest.raises(ValueError):
+        c.labels('read').inc(-1)          # counters only go up
+
+    g = r.gauge('depth')                  # unlabeled: family IS the child
+    g.set(4)
+    g.dec()
+    assert g.value() == 3.0
+
+    h = r.histogram('lat', 'x', buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    count, total = h.value()
+    assert count == 3 and total == pytest.approx(5.55)
+
+
+def test_registry_get_or_create_and_conflicts():
+    r = MetricRegistry()
+    a = r.counter('x_total', 'x', ('k',))
+    assert r.counter('x_total', 'x', ('k',)) is a     # same family back
+    with pytest.raises(ValueError):
+        r.gauge('x_total')                            # type conflict
+    with pytest.raises(ValueError):
+        r.counter('x_total', 'x', ('other',))         # labelname conflict
+    with pytest.raises(ValueError):
+        r.counter('bad name!')                        # invalid chars
+    with pytest.raises(ValueError):
+        a.labels('v1', 'v2')                          # label arity
+
+
+def test_disabled_registry_freezes_all_updates():
+    r = MetricRegistry(enabled=False)
+    c = r.counter('n_total')
+    h = r.histogram('h', buckets=(1.0,))
+    g = r.gauge('g')
+    c.inc(); g.set(9); h.observe(0.5)
+    assert c.value() == 0.0
+    assert g.value() == 0.0
+    assert h.value() == (0, 0.0)
+    r.enable()
+    c.inc()
+    assert c.value() == 1.0
+
+
+def test_exponential_buckets():
+    assert exponential_buckets(0.001, 2, 4) == (0.001, 0.002, 0.004, 0.008)
+    with pytest.raises(ValueError):
+        exponential_buckets(0, 2, 4)
+    with pytest.raises(ValueError):
+        exponential_buckets(0.1, 1.0, 4)
+
+
+def test_counter_exact_totals_under_thread_contention():
+    """8 threads x 10k labeled increments: totals must be EXACT — the
+    chaos oracle in test_resilience.py depends on it."""
+    r = MetricRegistry()
+    fam = r.counter('stress_total', 'x', ('worker_mod',))
+    n_threads, n_incs = 8, 10_000
+    start = threading.Barrier(n_threads)
+
+    def worker(w):
+        child = fam.labels(str(w % 2))    # contended: 2 children, 8 threads
+        start.wait()
+        for _ in range(n_incs):
+            child.inc()
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fam.labels('0').value() == n_threads // 2 * n_incs
+    assert fam.labels('1').value() == n_threads // 2 * n_incs
+
+
+# -- Prometheus text exposition, validated by an independent parser ----------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[^}]*)\})? '
+    r'(?P<value>[0-9.eE+-]+|\+Inf|-Inf|NaN)$')
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"$')
+
+
+def _parse_exposition(text):
+    """Minimal strict parser: returns {name: type} and
+    [(name, {label: value}, float)] samples; raises on malformed lines."""
+    types = {}
+    samples = []
+    for line in text.strip().splitlines():
+        if line.startswith('# HELP '):
+            continue
+        if line.startswith('# TYPE '):
+            _, _, name, kind = line.split(' ', 3)
+            assert kind in ('counter', 'gauge', 'histogram'), line
+            types[name] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, 'malformed sample line: %r' % line
+        labels = {}
+        if m.group('labels'):
+            for pair in m.group('labels').split(','):
+                assert _LABEL_RE.match(pair), 'bad label pair: %r' % pair
+                k, v = pair.split('=', 1)
+                labels[k] = v.strip('"')
+        v = m.group('value')
+        val = math.inf if v == '+Inf' else \
+            -math.inf if v == '-Inf' else float(v)
+        samples.append((m.group('name'), labels, val))
+    return types, samples
+
+
+def test_prometheus_exposition_is_valid_and_consistent():
+    r = MetricRegistry()
+    c = r.counter('req_total', 'requests\nwith newline', ('ep', 'op'))
+    c.labels('h:1', 'get').inc(3)
+    r.gauge('temp', 'has "quotes" \\ backslash').set(-1.5)
+    h = r.histogram('lat_seconds', 'latency', ('ep',),
+                    buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.7, 20.0):
+        h.labels('h:1').observe(v)
+
+    text = to_prometheus(r)
+    types, samples = _parse_exposition(text)
+    assert types == {'req_total': 'counter', 'temp': 'gauge',
+                     'lat_seconds': 'histogram'}
+    by = {(n, tuple(sorted(l.items()))): v for n, l, v in samples}
+    assert by[('req_total', (('ep', 'h:1'), ('op', 'get')))] == 3
+    assert by[('temp', ())] == -1.5
+    # histogram: cumulative buckets, +Inf == count, sum matches
+    buckets = [(l['le'], v) for n, l, v in samples
+               if n == 'lat_seconds_bucket']
+    assert [v for _, v in buckets] == sorted(v for _, v in buckets)
+    assert buckets[-1] == ('+Inf', 4)
+    assert by[('lat_seconds_count', (('ep', 'h:1'),))] == 4
+    assert by[('lat_seconds_sum', (('ep', 'h:1'),))] == \
+        pytest.approx(21.25)
+    # le values in ascending numeric order
+    les = [float(le) for le, _ in buckets[:-1]]
+    assert les == sorted(les) == [0.1, 1.0, 10.0]
+
+
+def test_metrics_server_scrape_and_healthz():
+    r = MetricRegistry()
+    r.counter('pings_total').inc(7)
+    with MetricsServer(registry=r) as srv:
+        body = urllib.request.urlopen(srv.url + '/metrics',
+                                      timeout=5).read().decode()
+        types, samples = _parse_exposition(body)
+        assert ('pings_total', {}, 7.0) in samples
+
+        health = json.loads(urllib.request.urlopen(
+            srv.url + '/healthz', timeout=5).read().decode())
+        assert health['status'] == 'ok'
+        assert health['uptime_s'] >= 0
+
+        snap = json.loads(urllib.request.urlopen(
+            srv.url + '/metrics.json', timeout=5).read().decode())
+        assert snap['pings_total']['samples'][0]['value'] == 7.0
+
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(srv.url + '/nope', timeout=5)
+    with pytest.raises(RuntimeError):
+        srv.port                           # stopped server has no port
+
+
+# -- runtime sampler ---------------------------------------------------------
+
+def test_runtime_sampler_populates_gauges():
+    r = MetricRegistry()
+    s = RuntimeSampler(registry=r, interval=3600)
+    s.sample_once()
+    snap = to_dict(r)
+    assert snap['process_resident_bytes']['samples'][0]['value'] > 1e6
+    assert snap['jax_device_count']['samples'][0]['value'] == 8  # conftest
+    assert snap['jax_live_array_count']['samples'][0]['value'] >= 0
+    assert snap['runtime_samples_total']['samples'][0]['value'] == 1
+
+    calls = []
+    s.add_source(lambda reg: calls.append(reg))
+    s.add_source(lambda reg: 1 / 0)        # broken probe must not kill it
+    s.sample_once()
+    assert calls == [r]
+    assert snap != to_dict(r)              # samples counter advanced
+
+
+def test_runtime_sampler_thread_start_stop():
+    r = MetricRegistry()
+    s = RuntimeSampler(registry=r, interval=0.05)
+    s.start()
+    deadline = time.monotonic() + 5.0
+    fam = r.get('runtime_samples_total')
+    while fam.value() < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    s.stop()
+    assert fam.value() >= 2
+
+
+# -- disabled-path overhead guard (acceptance criterion) ---------------------
+
+def test_disabled_registry_adds_no_measurable_channel_overhead():
+    """ResilientChannel.call against a loopback embedding server, with
+    the default registry disabled vs enabled. Disabled does strictly
+    less work per call, so its mean must not exceed enabled + a generous
+    slack (this is a guard against accidentally putting allocation or
+    locking on the disabled path, not a benchmark)."""
+    from paddle_tpu.distributed.ps.embedding_service import EmbeddingServer
+    from paddle_tpu.distributed.resilience import ResilientChannel
+
+    srv = EmbeddingServer()
+    srv.create_table(0, dim=4, seed=0)
+    srv.start()
+    reg = monitor.default_registry()
+    ch = ResilientChannel(srv.endpoint)
+    msg = {'op': 'dims', 'table_id': 0}
+
+    def mean_call_s(n=60):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            ch.call(msg)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return sum(ts[:n // 2]) / (n // 2)   # trimmed: drop GC/sched noise
+
+    try:
+        assert reg.enabled
+        mean_call_s(10)                      # warm both paths
+        enabled = mean_call_s()
+        reg.disable()
+        try:
+            disabled = mean_call_s()
+        finally:
+            reg.enable()
+    finally:
+        ch.close()
+        srv.stop()
+    # generous: 2 ms absolute slack on a loopback call that takes ~100 us
+    assert disabled <= enabled + 2e-3, (disabled, enabled)
+
+    # and the disabled single-child fast path is branch-cheap in absolute
+    # terms: 100k no-op incs well under a second
+    c = MetricRegistry(enabled=False).counter('noop_total')
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        c.inc()
+    assert time.perf_counter() - t0 < 1.0
+
+
+# -- telemetry snapshot line + schema gate (acceptance criterion) ------------
+
+def test_dryrun_snapshot_passes_committed_baseline(tmp_path):
+    """The same helper __graft_entry__ uses produces a line that the CI
+    gate accepts against the COMMITTED baseline — so the dryrun and this
+    test can only drift together with the baseline file."""
+    reg = monitor.telemetry.dryrun_registry(0.25, 2.5, batch=16)
+    lines = '\n'.join([
+        'dryrun_multichip(8)[dp/mp]: mp=2 loss=2.5000',
+        monitor.telemetry.snapshot_line(reg, 8, '[dp/mp]'),
+        monitor.telemetry.snapshot_line(reg, 8, '[dp/sp]'),
+    ])
+    p = tmp_path / 'out.txt'
+    p.write_text(lines + '\n')
+    proc = subprocess.run(
+        [sys.executable, REPO + '/tools/check_metrics_snapshot.py',
+         '--text', str(p)], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary['ok'] and summary['configs'] == ['dp/mp', 'dp/sp']
+    assert summary['new_unbaselined'] == []
+
+    # a capture-file form works too (the MULTICHIP_r*.json shape)
+    cap = tmp_path / 'cap.json'
+    cap.write_text(json.dumps({'n_devices': 8, 'tail': lines}))
+    proc = subprocess.run(
+        [sys.executable, REPO + '/tools/check_metrics_snapshot.py',
+         '--new', str(cap)], capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_snapshot_gate_fails_when_metric_disappears(tmp_path):
+    reg = monitor.telemetry.dryrun_registry(0.25, 2.5, batch=16)
+    reg.unregister('train_loss')           # the silent de-instrumentation
+    p = tmp_path / 'out.txt'
+    p.write_text(monitor.telemetry.snapshot_line(reg, 8, '[dp/mp]') + '\n')
+    proc = subprocess.run(
+        [sys.executable, REPO + '/tools/check_metrics_snapshot.py',
+         '--text', str(p)], capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    findings = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert any(f.get('metric') == 'train_loss'
+               and f.get('problem') == 'missing' for f in findings)
+
+
+def test_snapshot_gate_nothing_to_compare(tmp_path):
+    p = tmp_path / 'empty.txt'
+    p.write_text('no telemetry here\n')
+    proc = subprocess.run(
+        [sys.executable, REPO + '/tools/check_metrics_snapshot.py',
+         '--text', str(p)], capture_output=True, text=True)
+    assert proc.returncode == 2
+
+
+def test_schema_of_ignores_values_and_label_values():
+    r = MetricRegistry()
+    c = r.counter('a_total', 'x', ('ep',))
+    c.labels('one').inc()
+    s1 = schema_of(to_dict(r))
+    c.labels('two').inc(99)                # new series, same schema
+    assert schema_of(to_dict(r)) == s1
+
+
+# -- hapi TelemetryCallback --------------------------------------------------
+
+def test_telemetry_callback_records_fit_metrics():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.hapi.callbacks import TelemetryCallback
+    from paddle_tpu.io import Dataset
+
+    class Toy(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            x = np.full((4,), i, np.float32)
+            return x, np.zeros((1,), np.float32)
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 1))
+    model = paddle.Model(net)
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.01, parameters=net.parameters()),
+        loss=nn.MSELoss())
+    reg = MetricRegistry()
+    cb = TelemetryCallback(registry=reg, sample_every=3)
+    model.fit(Toy(), batch_size=4, epochs=2, verbose=0, callbacks=[cb])
+
+    snap = to_dict(reg)
+    assert snap['train_steps_total']['samples'][0]['value'] == 4   # 2x2
+    assert snap['train_examples_total']['samples'][0]['value'] == 16
+    assert snap['train_step_duration_seconds']['samples'][0]['count'] == 4
+    assert snap['train_epoch']['samples'][0]['value'] == 1
+    assert math.isfinite(snap['train_loss']['samples'][0]['value'])
+    # sampler fired (on_train_end guarantees at least one capture)
+    assert snap['runtime_samples_total']['samples'][0]['value'] >= 1
+    assert snap['process_resident_bytes']['samples'][0]['value'] > 0

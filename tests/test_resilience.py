@@ -28,6 +28,7 @@ from paddle_tpu.distributed.ps.embedding_service import (EmbeddingClient,
 from paddle_tpu.distributed.checkpoint import CheckpointManager
 from paddle_tpu.framework import io_save
 from paddle_tpu.incubate.auto_checkpoint import TrainEpochRange
+from paddle_tpu import monitor
 from paddle_tpu.testing import chaos
 
 # fast-failing policy for tests: whole retry ladder < ~0.5 s
@@ -275,6 +276,90 @@ def test_ps_push_is_not_blind_resent():
         assert ei.value.attempts == 1       # single attempt, no resend
     finally:
         srv.stop()
+
+
+# -- monitor counters as the chaos oracle ------------------------------------
+# The default registry is process-wide and shared with every other test,
+# so every assertion here is a DELTA around the faulted section — and the
+# deltas must be EXACT: N injected faults means N counted failures, which
+# is only true because counter updates are locked (registry design rule 2).
+
+def _counter(name, *labels):
+    return monitor.default_registry().get(name).labels(*labels).value()
+
+
+def test_monitor_failure_counters_match_injected_faults_exactly():
+    srv, client = _graph_cluster()
+    ep = client._channels[0].endpoint
+    f0 = _counter('rpc_attempt_failures_total', ep)
+    a0 = _counter('rpc_attempts_total', ep)
+    b0 = _counter('rpc_backoff_seconds_total', ep)
+    try:
+        with chaos.drop_connections(point='send', times=3) as fault:
+            deg = client.get_degree('default', [0, 1, 2])
+        assert deg.tolist() == [1, 1, 1]
+        assert fault.fired == 3
+        # the oracle: every injected fault is one counted failure
+        assert _counter('rpc_attempt_failures_total', ep) - f0 == fault.fired
+        # 3 failures + the final success = 4 attempts begun
+        assert _counter('rpc_attempts_total', ep) - a0 == 4
+        # 3 backoff sleeps were accounted (FAST ladder: each >= 20 ms)
+        slept = _counter('rpc_backoff_seconds_total', ep) - b0
+        assert 3 * 0.02 <= slept < 2.0
+    finally:
+        client.stop_server()
+
+
+def test_monitor_breaker_transitions_and_fast_fail_counters():
+    ep = '127.0.0.1:1'                       # nothing listens here
+    t0 = _counter('rpc_breaker_transitions_total', ep, 'open')
+    r0 = _counter('rpc_circuit_open_total', ep)
+    ch = ResilientChannel(ep,
+                          retry_policy=RetryPolicy(max_attempts=1,
+                                                   base_delay=0.01),
+                          breaker=CircuitBreaker(failure_threshold=2,
+                                                 reset_timeout=30.0))
+    for _ in range(2):
+        with pytest.raises(RetryableError):
+            ch.call({'op': 'stats'})
+    # threshold hit exactly once -> one closed->open transition, and the
+    # state gauge shows open (code 1)
+    assert _counter('rpc_breaker_transitions_total', ep, 'open') - t0 == 1
+    assert monitor.default_registry().get(
+        'rpc_breaker_state').labels(ep).value() == 1
+    with pytest.raises(CircuitOpenError):
+        ch.call({'op': 'stats'})
+    assert _counter('rpc_circuit_open_total', ep) - r0 == 1
+
+
+def test_monitor_counts_deadline_expirations():
+    srv, client = _graph_cluster()
+    ep = client._channels[0].endpoint
+    chaos.kill_server(srv)
+    d0 = _counter('rpc_deadline_expired_total', ep)
+    ch = client._channels[0]
+    ch.policy = RetryPolicy(max_attempts=1000, base_delay=0.01,
+                            max_delay=0.05)
+    ch.breaker = CircuitBreaker(failure_threshold=10**9)
+    with pytest.raises(DeadlineExceeded):
+        ch.call({'op': 'degree', 'etype': 'default', 'ids': [0]},
+                deadline=Deadline(0.3))
+    assert _counter('rpc_deadline_expired_total', ep) - d0 == 1
+    client.close()
+
+
+def test_monitor_ps_call_counters_per_op():
+    srv, client = _ps_cluster()
+    c0 = _counter('ps_client_calls_total', 'pull')
+    e0 = _counter('ps_client_call_errors_total', 'pull')
+    client.pull(0, [1, 2])
+    # one data pull + the client's dim-probe pull = exactly 2 RPCs
+    assert _counter('ps_client_calls_total', 'pull') - c0 == 2
+    assert _counter('ps_client_call_errors_total', 'pull') - e0 == 0
+    chaos.kill_server(srv)
+    with pytest.raises(RetryableError):
+        client.pull(0, [1, 2])
+    assert _counter('ps_client_call_errors_total', 'pull') - e0 == 1
 
 
 # -- checkpoint integrity: manifests, atomicity, fallback --------------------

@@ -229,6 +229,36 @@ def test_metrics_report():
     assert percentile([1.0, 2.0], 50) == pytest.approx(1.5)
 
 
+def test_percentile_is_linear_interpolation_not_nearest_rank():
+    """The docstring/behavior contract: linear interpolation between
+    closest ranks (numpy's default method). Nearest-rank would return a
+    member of the input for every q; interpolation doesn't."""
+    # empty and singleton
+    assert percentile([], 0) is None
+    assert percentile([], 100) is None
+    assert percentile([7.0], 0) == 7.0
+    assert percentile([7.0], 50) == 7.0
+    assert percentile([7.0], 100) == 7.0
+    # q = 0 / 100 are exact extremes regardless of order
+    xs = [5.0, 1.0, 3.0]
+    assert percentile(xs, 0) == 1.0
+    assert percentile(xs, 100) == 5.0
+    # two elements: q interpolates linearly between them
+    assert percentile([1.0, 3.0], 0) == 1.0
+    assert percentile([1.0, 3.0], 25) == pytest.approx(1.5)
+    assert percentile([1.0, 3.0], 50) == pytest.approx(2.0)
+    assert percentile([1.0, 3.0], 75) == pytest.approx(2.5)
+    assert percentile([1.0, 3.0], 100) == 3.0
+    # parity with numpy's default ('linear') on a bigger sample —
+    # including a q where nearest-rank and interpolation disagree
+    rng = np.random.RandomState(0)
+    vals = rng.rand(17).tolist()
+    for q in (0, 10, 33.3, 50, 90, 99, 100):
+        assert percentile(vals, q) == pytest.approx(
+            float(np.percentile(vals, q)))
+    assert percentile([1.0, 2.0, 4.0], 75) == pytest.approx(3.0)  # not 2/4
+
+
 def test_predictor_decode_engine(model, prompts, tmp_path):
     """The serving front door reached the inference API: a jit.save'd
     causal LM round-trips into an engine whose output matches the live

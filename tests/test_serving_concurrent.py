@@ -70,6 +70,96 @@ def test_thread_pool_over_predictor_clones(saved_mlp):
     assert not np.allclose(expect[0], expect[1])
 
 
+def test_live_metrics_scrape_during_concurrent_serving():
+    """Scrape /metrics WHILE a continuous-batching engine serves
+    concurrent requests: every mid-flight scrape must be valid
+    Prometheus text (the exporter reads under the family locks), and the
+    final counters must account for exactly the work done."""
+    import threading
+    import urllib.request
+
+    from paddle_tpu import monitor
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+    from test_monitor import _parse_exposition
+
+    # counters and scrape validity are the subject here, not parity, so
+    # the model is as small as the engine accepts
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=1,
+                    num_heads=2, max_position_embeddings=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(3)
+    prompts = [[int(t) for t in rng.randint(0, 97, n)]
+               for n in (3, 11, 7, 9, 5, 13)]
+    mnt = 4
+
+    reg = monitor.default_registry()
+
+    def counter(name):
+        return reg.get(name).labels().value() if reg.get(name) else 0.0
+
+    # engine construction registers the families; baselines AFTER it
+    eng = ContinuousBatchingEngine(model, num_slots=3, max_len=64,
+                                   prefill_chunk=8, decode_block=4)
+    base = {n: counter(n) for n in
+            ('serving_requests_total', 'serving_requests_admitted_total',
+             'serving_requests_retired_total', 'serving_tokens_total')}
+
+    results = [None] * 3
+    bodies = []
+    done = threading.Event()
+
+    def worker(i):
+        results[i] = eng.generate(prompts[2 * i:2 * i + 2],
+                                  max_new_tokens=mnt)
+
+    with monitor.MetricsServer(registry=reg) as srv:
+        def scraper():
+            while not done.is_set():
+                bodies.append(urllib.request.urlopen(
+                    srv.url + '/metrics', timeout=5).read().decode())
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        s = threading.Thread(target=scraper)
+        s.start()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        done.set()
+        s.join()
+        final = urllib.request.urlopen(srv.url + '/metrics',
+                                       timeout=5).read().decode()
+
+    # every scrape taken mid-serving parses as valid exposition
+    assert bodies, 'scraper never ran'
+    for body in bodies:
+        _parse_exposition(body)
+    types, samples = _parse_exposition(final)
+    assert types['serving_tokens_total'] == 'counter'
+    assert types['serving_ttft_seconds'] == 'histogram'
+
+    # outputs are untouched by the scraping, and the counters account
+    # for exactly the work done
+    assert all(len(toks) == mnt for pair in results for toks in pair)
+    assert counter('serving_requests_total') - \
+        base['serving_requests_total'] == len(prompts)
+    assert counter('serving_requests_admitted_total') - \
+        base['serving_requests_admitted_total'] == len(prompts)
+    assert counter('serving_requests_retired_total') - \
+        base['serving_requests_retired_total'] == len(prompts)
+    assert counter('serving_tokens_total') - \
+        base['serving_tokens_total'] == len(prompts) * mnt
+    assert eng.compiled_sizes() == {'prefill': 1, 'decode': 1}
+    # the zero-retrace invariant is itself scrapeable
+    trace = {(l['program'], v) for n, l, v in samples
+             if n == 'serving_trace_count'}
+    assert trace >= {('prefill', 1.0), ('decode', 1.0)}
+
+
 CLIENT_MT_C = r'''
 #include <pthread.h>
 #include <stdio.h>

@@ -1,0 +1,136 @@
+"""Metrics-schema gate over dryrun telemetry snapshots.
+
+The driver's dryrun prints one `telemetry_snapshot(N)[tag]: {json}` line
+per config (__graft_entry__, same pattern as sharding_audit). This tool
+re-parses those lines and diffs the METRIC SCHEMA — metric names, types,
+and label keys — against a committed baseline
+(tools/metrics_schema_baseline.json), failing when an instrumented
+metric silently disappears or changes shape. Values are deliberately
+ignored: loss and RSS move run to run; the instrumentation's existence
+must not.
+
+Inputs (one of):
+    --new  MULTICHIP_rNN.json   a driver capture ({..., 'tail': ...})
+    --text FILE|-               raw driver output (or stdin)
+
+Rules:
+  * every baseline metric must appear in the new run's union schema,
+    with the same type and label keys (missing/changed -> exit 1);
+  * NEW metrics pass with a note — add them to the baseline via
+    --write-baseline once they are intentional;
+  * no telemetry lines / no baseline -> exit 2 (nothing to compare).
+
+Same shape as tools/check_sharding_regression.py so CI wires both the
+same way.
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# paddle_tpu/monitor is stdlib-only, but the paddle_tpu package __init__
+# pulls in jax (seconds per invocation). CI calls this gate per capture,
+# so load the subpackage without executing the parent __init__.
+if 'paddle_tpu' not in sys.modules:
+    _pkg = types.ModuleType('paddle_tpu')
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, 'paddle_tpu')]
+    sys.modules['paddle_tpu'] = _pkg
+
+from paddle_tpu.monitor import schema_of  # noqa: E402
+from paddle_tpu.monitor.telemetry import parse_snapshot_lines  # noqa: E402
+
+__all__ = ['union_schema', 'check', 'main']
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, 'tools',
+                                'metrics_schema_baseline.json')
+
+
+def union_schema(text):
+    """Union {metric: {'type', 'labels'}} across every config's
+    telemetry snapshot in the captured text (plus per-tag schemas)."""
+    per_tag = {tag: schema_of(snap)
+               for tag, snap in parse_snapshot_lines(text).items()}
+    union = {}
+    for schema in per_tag.values():
+        union.update(schema)
+    return union, per_tag
+
+
+def check(text, baseline):
+    """Pure gate: list of findings (empty == pass)."""
+    union, per_tag = union_schema(text)
+    findings = []
+    for name in sorted(baseline):
+        want = baseline[name]
+        got = union.get(name)
+        if got is None:
+            findings.append({'metric': name, 'problem': 'missing',
+                             'note': 'instrumented metric disappeared '
+                                     'from the dryrun telemetry'})
+        elif got != want:
+            findings.append({'metric': name, 'problem': 'schema_changed',
+                             'baseline': want, 'new': got})
+    return findings
+
+
+def _load_text(args):
+    if args.new:
+        with open(args.new, errors='replace') as f:
+            return json.load(f).get('tail', '')
+    if args.text == '-':
+        return sys.stdin.read()
+    with open(args.text, errors='replace') as f:
+        return f.read()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument('--new', help='driver capture JSON (MULTICHIP_r*.json)')
+    src.add_argument('--text', help="raw driver output file, or '-' (stdin)")
+    ap.add_argument('--baseline', default=DEFAULT_BASELINE,
+                    help='schema baseline JSON (default: %(default)s)')
+    ap.add_argument('--write-baseline', action='store_true',
+                    help='write the new union schema to --baseline and '
+                         'exit 0')
+    args = ap.parse_args(argv)
+
+    text = _load_text(args)
+    union, per_tag = union_schema(text)
+    if not union:
+        print(json.dumps({'checked': 0,
+                          'note': 'no telemetry_snapshot lines found'}))
+        return 2
+
+    if args.write_baseline:
+        with open(args.baseline, 'w') as f:
+            json.dump(union, f, indent=2, sort_keys=True)
+            f.write('\n')
+        print(json.dumps({'wrote': args.baseline, 'metrics': len(union)}))
+        return 0
+
+    if not os.path.exists(args.baseline):
+        print(json.dumps({'checked': 0, 'note': 'no baseline schema'}))
+        return 2
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+
+    findings = check(text, baseline)
+    for f_ in findings:
+        print(json.dumps(dict(f_, regression=True)))
+    extra = sorted(set(union) - set(baseline))
+    if not findings:
+        print(json.dumps({'regressions': 0, 'metrics_seen': len(union),
+                          'configs': sorted(per_tag),
+                          'new_unbaselined': extra, 'ok': True}))
+        return 0
+    return 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

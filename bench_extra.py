@@ -386,6 +386,118 @@ def bench_serving(on_tpu):
     return rows
 
 
+def _drive_paged(engine, prompts, arrivals, mnt):
+    """_drive_cb plus the paged engine's capacity counters: returns
+    (tok/s, report, peak pages in use across steps)."""
+    from paddle_tpu.serving.metrics import ServingMetrics
+    engine.metrics = ServingMetrics()     # drop warmup samples
+    reqs, peak = [], 0
+    i = 0
+    t0 = time.time()
+    while i < len(prompts) or engine.scheduler.pending:
+        now = time.time() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            reqs.append(engine.add_request(prompts[i], max_new_tokens=mnt))
+            i += 1
+        if engine.scheduler.pending:
+            engine.step()
+            peak = max(peak, engine.pages.in_use)
+        elif i < len(prompts):
+            time.sleep(min(arrivals[i] - now, 0.01))
+    dt = time.time() - t0
+    toks = sum(len(r.tokens) for r in reqs)
+    return toks / dt, engine.metrics.report(), peak
+
+
+def bench_serving_paged(on_tpu):
+    """Paged-KV serving rung: page-granular KV + prefix sharing + spec
+    decode vs the PR-3 slot engine at the SAME occupancy, on a shared-
+    system-prompt workload (every request opens with the same system
+    prefix, the traffic shape prefix caching exists for).
+
+    Rows (all keyed by workload/page_size/spec_k for the regression
+    gate): the headline paged tok/s row carries the slot engine's tok/s
+    on the identical trace plus prefix hit-rate, prefilled-token count
+    and peak pages-in-use as fields; prefix hit-rate and spec accept-
+    rate also get their own gated rows (both regress DOWN). Greedy
+    parity across all three modes is asserted in tests/test_serving.py,
+    so none of these numbers is bought with output drift.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    PagedContinuousBatchingEngine)
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        sys_len, tail_lens, mnt, n_req = 64, (8, 16, 24, 32), 64, 32
+        max_len, chunk, block, num_seqs, page = 256, 32, 8, 8, 16
+    else:
+        # same regime as bench_serving's CPU branch: decode GEMMs big
+        # enough to outweigh host dispatch, burst arrivals so the run is
+        # service-bound at full occupancy
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        sys_len, tail_lens, mnt, n_req = 32, (4, 8, 12, 16), 32, 24
+        max_len, chunk, block, num_seqs, page = 96, 32, 8, 8, 16
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    system = [int(t) for t in rng.randint(0, cfg.vocab_size, sys_len)]
+    prompts = [system + [int(t) for t in rng.randint(
+                   0, cfg.vocab_size, tail_lens[i % len(tail_lens)])]
+               for i in range(n_req)]
+    arrivals = [0.0] * n_req                 # burst: full occupancy
+    base = {'new_tokens': mnt, 'num_slots': num_seqs, 'page_size': page,
+            'workload': 'shared_prefix', 'trace': 'burst',
+            'requests': n_req, 'degraded': not on_tpu}
+    rows = []
+
+    # slot engine on the identical trace = the same-occupancy baseline
+    slot = ContinuousBatchingEngine(model, num_slots=num_seqs,
+                                    max_len=max_len, prefill_chunk=chunk,
+                                    decode_block=block)
+    slot.generate(prompts[:2], max_new_tokens=2)             # compile
+    slot_tps, _ = _drive_cb(slot, prompts, arrivals, mnt)
+
+    for spec_k in (0, 4):
+        eng = PagedContinuousBatchingEngine(
+            model, num_seqs=num_seqs, max_len=max_len, page_size=page,
+            prefill_chunk=chunk, decode_block=block, spec_k=spec_k)
+        eng.generate(prompts[:2], max_new_tokens=2)          # compile
+        tps, rep, peak = _drive_paged(eng, prompts, arrivals, mnt)
+        tag = '_spec' if spec_k else ''
+        rows.append(dict(base, metric='serving_paged_tokens_per_sec' + tag,
+                         value=round(tps, 2), unit='tokens/sec',
+                         spec_k=spec_k,
+                         slot_tokens_per_sec=round(slot_tps, 2),
+                         speedup_vs_slot=round(tps / slot_tps, 3),
+                         prefix_hit_rate=round(rep['prefix_hit_rate'], 3),
+                         prefill_tokens=rep['prefill_tokens'],
+                         pages_in_use_peak=peak,
+                         spec_accept_rate=round(rep['spec_accept_rate'], 3),
+                         occupancy_mean=round(rep['occupancy_mean'], 3),
+                         traces=eng.compiled_sizes()))
+        if not spec_k:
+            rows.append(dict(base, metric='serving_paged_prefix_hit_rate',
+                             value=round(rep['prefix_hit_rate'], 4),
+                             unit='ratio', spec_k=spec_k,
+                             prefill_tokens=rep['prefill_tokens']))
+        else:
+            rows.append(dict(base, metric='serving_paged_spec_accept_rate',
+                             value=round(rep['spec_accept_rate'], 4),
+                             unit='ratio', spec_k=spec_k,
+                             spec_proposed=rep['spec_proposed'],
+                             spec_accepted=rep['spec_accepted']))
+    return rows
+
+
 def main():
     try:
         _enable_cache()
@@ -393,7 +505,7 @@ def main():
         pass
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
-               bench_serving):
+               bench_serving, bench_serving_paged):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

@@ -363,12 +363,15 @@ class Predictor:
         return Predictor(self._config)
 
     def decode_engine(self, num_slots=8, max_len=None, prefill_chunk=16,
-                      decode_block=4):
+                      decode_block=4, paged=False, **paged_kwargs):
         """Continuous-batching front door over the loaded model.
 
         Only meaningful when the artifact is a causal LM with the slot-
         cache decode path (GPTForCausalLM); anything else fails here
         with a clear error instead of deep inside the first step().
+        `paged=True` returns the page-granular engine (prefix sharing,
+        optional speculative decoding); extra keyword args — page_size,
+        num_pages, spec_k, prefix_cache, ... — pass through to it.
         """
         layer = self._layer
         if layer is None or not (hasattr(layer, 'generate')
@@ -378,6 +381,15 @@ class Predictor:
                 'decode_engine() needs a causal-LM artifact '
                 '(GPTForCausalLM with a KV-cache decode path); loaded '
                 'model is %s' % type(layer).__name__)
+        if paged:
+            from ..serving import PagedContinuousBatchingEngine
+            return PagedContinuousBatchingEngine(
+                layer, num_seqs=num_slots, max_len=max_len,
+                prefill_chunk=prefill_chunk, decode_block=decode_block,
+                **paged_kwargs)
+        if paged_kwargs:
+            raise TypeError('decode_engine() got paged-only arguments %r '
+                            'without paged=True' % sorted(paged_kwargs))
         from ..serving import ContinuousBatchingEngine
         return ContinuousBatchingEngine(
             layer, num_slots=num_slots, max_len=max_len,

@@ -11,8 +11,8 @@ from . import export
 from .registry import MetricRegistry
 from .runtime import RuntimeSampler
 
-__all__ = ['record_dryrun_step', 'snapshot_line', 'parse_snapshot_lines',
-           'LINE_RE']
+__all__ = ['record_dryrun_step', 'record_serving_schema', 'snapshot_line',
+           'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
                      r'\[(?P<tag>[^\]]*)\]:\s*(?P<json>\{.*\})\s*$')
@@ -35,11 +35,42 @@ def record_dryrun_step(registry, step_seconds, loss, batch=None):
                                batch / step_seconds)
 
 
+# the paged serving engine's capacity/efficiency families. Declared here
+# (not in serving/metrics.py) so the schema-baseline gate and the engine
+# register the exact same names/types — same single-source rule as
+# record_dryrun_step. (kind, name, help) with no labels: registration
+# alone creates the unlabeled child, so these appear in every snapshot.
+SERVING_PAGED_FAMILIES = (
+    ('gauge', 'serving_kv_pages_in_use',
+     'physical KV pages currently referenced (sequences + prefix cache)'),
+    ('counter', 'serving_prefix_cache_hits_total',
+     'full prompt blocks served from the prefix cache'),
+    ('counter', 'serving_prefix_cache_misses_total',
+     'full prompt blocks that had to prefill'),
+    ('counter', 'serving_spec_tokens_proposed_total',
+     'draft tokens proposed for speculative verification'),
+    ('counter', 'serving_spec_tokens_accepted_total',
+     'draft tokens accepted by the verify pass'),
+)
+
+
+def record_serving_schema(registry):
+    """Register the paged-serving metric families on `registry` and
+    return {name: family}. Used by ServingMetrics at engine construction
+    and by dryrun_registry so the committed schema baseline covers
+    serving without a serving run."""
+    out = {}
+    for kind, name, doc in SERVING_PAGED_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None):
     """Fresh per-config registry holding the full dryrun telemetry
-    schema: training gauges + one runtime sample."""
+    schema: training gauges + serving families + one runtime sample."""
     reg = MetricRegistry()
     record_dryrun_step(reg, step_seconds, loss, batch=batch)
+    record_serving_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -12,16 +12,26 @@ static shape — no retrace across request churn.
     engine.run()                 # or step() / stream(req) / serve threads
     req.tokens                   # generated ids, identical to generate()
 
-Layering: kv_cache.py owns slot bookkeeping, scheduler.py owns the
-request queue + admission/prefill policy, engine.py owns the two jitted
-programs (chunked prefill, fixed-K decode burst) and the thread-safe
-front door, metrics.py turns step timestamps into tok/s + latency
-percentiles. See docs/serving.md.
+Two engines share that skeleton:
+
+- ContinuousBatchingEngine — every slot reserves max_len KV rows;
+- PagedContinuousBatchingEngine — block-granular KV pool with prefix
+  sharing and optional speculative decoding (paged_engine.py).
+
+Layering: kv_cache.py owns slot/page bookkeeping, scheduler.py owns the
+request queue + admission/prefill policy, engine.py + paged_engine.py
+own the jitted programs (chunked prefill, fixed-K decode burst, spec
+verify) and the thread-safe front door, metrics.py turns step
+timestamps into tok/s + latency percentiles. See docs/serving.md.
 """
 from .engine import ContinuousBatchingEngine
-from .kv_cache import SlotAllocator, build_slot_caches
+from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
+                       build_paged_pools, build_slot_caches)
 from .metrics import ServingMetrics
-from .scheduler import Request, Scheduler
+from .paged_engine import NGramProposer, PagedContinuousBatchingEngine
+from .scheduler import PagedScheduler, Request, Scheduler
 
-__all__ = ['ContinuousBatchingEngine', 'SlotAllocator', 'build_slot_caches',
-           'ServingMetrics', 'Request', 'Scheduler']
+__all__ = ['ContinuousBatchingEngine', 'PagedContinuousBatchingEngine',
+           'SlotAllocator', 'PageAllocator', 'PrefixCache',
+           'NGramProposer', 'build_slot_caches', 'build_paged_pools',
+           'ServingMetrics', 'Request', 'Scheduler', 'PagedScheduler']

@@ -22,6 +22,11 @@ slot attention contributes exact zeros for invalid rows (scores hit
 -1e9 and underflow to 0.0 after the f32 softmax), and sampling mirrors
 generate()'s per-request PRNG stream (one split at prefill, one per
 decode step, advanced only on active steps).
+
+`_EngineBase` holds everything that is NOT about the cache layout — the
+thread-safe front door, the scheduler glue, metrics, shutdown — so the
+paged engine (serving/paged_engine.py) shares it verbatim and differs
+only in its compiled programs and page bookkeeping.
 """
 import queue as _queue
 import threading
@@ -60,28 +65,28 @@ def _pick_token(lg, key, temp, topk, sample):
     return jnp.where(sample, sampled, greedy)
 
 
-class ContinuousBatchingEngine:
-    """Slot-based continuous batching over a GPTForCausalLM.
+class _EngineBase:
+    """Cache-layout-agnostic half of a continuous-batching engine.
 
     Front door (`add_request` / `step` / `run` / `stream` / `generate`)
     is thread-safe: any number of threads may submit and drive; an RLock
     serializes scheduler state and device dispatches while `Request.wait`
-    and stream consumption stay lock-free.
+    and stream consumption stay lock-free. Subclasses own the compiled
+    programs: they set `self.allocator` / `self.scheduler` and implement
+    `_prefill_step` / `_decode_step` (and may hook `_bind` /
+    `_on_step_metrics`).
     """
 
-    def __init__(self, model, num_slots=8, max_len=None, prefill_chunk=16,
-                 decode_block=4, donate=None):
+    # traced-body counter keys, one per compiled program; the zero-
+    # retrace assertion is `trace_counts` staying all-ones across an
+    # arbitrary admit/retire workload
+    _programs = ('prefill', 'decode')
+
+    def __init__(self, model, num_slots, max_len):
         model.eval()
         self._model = model
         self.num_slots = int(num_slots)
         self.max_len = int(max_len or model.config.max_position_embeddings)
-        self.decode_block = int(decode_block)
-        if self.decode_block < 1:
-            raise ValueError('decode_block must be >= 1')
-        self._caches = build_slot_caches(model, self.num_slots, self.max_len)
-        self.allocator = SlotAllocator(self.num_slots)
-        self.scheduler = Scheduler(self.allocator, self.max_len,
-                                   prefill_chunk)
         self.metrics = ServingMetrics()
         self._params = _fm.extract_params(model)
         self._bufs = _fm.extract_buffers(model)
@@ -100,19 +105,161 @@ class ContinuousBatchingEngine:
         self._sample = np.zeros((s,), bool)
         self._requests = {}                           # slot -> Request
         self._lock = threading.RLock()
-        # traced-body counters: each increments ONLY when jax traces the
-        # function, i.e. on (re)compilation — the zero-retrace assertion
-        # is `trace_counts stays {"prefill": 1, "decode": 1}` across an
-        # arbitrary admit/retire workload
-        self.trace_counts = {'prefill': 0, 'decode': 0}
-        # scrape-visible retrace canary: flat at 1/1 == the zero-retrace
-        # contract holds in production, not just under the test
+        self._closed = False
+        self.trace_counts = {k: 0 for k in self._programs}
+        # scrape-visible retrace canary: flat at 1 per program == the
+        # bounded-compilation contract holds in production, not just
+        # under the test
         trace_gauge = self.metrics.registry.gauge(
             'serving_trace_count',
             'times each serving program has been traced '
             '(flat == zero retrace)', ('program',))
         self._m_trace = {k: trace_gauge.labels(k)
                          for k in self.trace_counts}
+
+    # ---- front door ---------------------------------------------------
+
+    def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
+                    top_k=0, do_sample=False, seed=0, stream=False):
+        """Queue a generation request; returns the Request handle."""
+        req = Request(prompt, max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k,
+                      do_sample=do_sample, seed=seed)
+        if stream:
+            req._stream_q = _queue.Queue()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    'engine is shut down — it no longer admits requests')
+            self._validate(req)
+            self.scheduler.submit(req)
+            self.metrics.on_arrival(req.id)
+        return req
+
+    def _validate(self, req):
+        """Subclass hook: extra front-door checks (lock held)."""
+
+    def shutdown(self):
+        """Refuse all future add_request calls. In-flight requests may
+        still be driven to completion with step()/run(); shutdown only
+        closes the front door."""
+        with self._lock:
+            self._closed = True
+
+    def step(self):
+        """One scheduler iteration: admit → prefill chunks → decode
+        burst → retire. Returns the number of requests still pending."""
+        with self._lock, no_grad_guard():
+            self._admit()
+            self._prefill_step()
+            self._decode_step()
+            self.metrics.on_step(self.allocator.in_use, self.num_slots)
+            self.metrics.on_queue_depth(len(self.scheduler.queue))
+            self._on_step_metrics()
+            for prog, child in self._m_trace.items():
+                child.set(self.trace_counts[prog])
+            return self.scheduler.pending
+
+    def run(self):
+        """Drive until every submitted request has finished."""
+        while True:
+            with self._lock:
+                if not self.scheduler.pending:
+                    return
+                self.step()
+
+    def generate(self, prompts, **sampling):
+        """Blocking batch door: submit all, drive to completion, return
+        generated ids per prompt (prompt not included) in order."""
+        reqs = [self.add_request(p, **sampling) for p in prompts]
+        self.run()
+        return [r.tokens for r in reqs]
+
+    def stream(self, req):
+        """Yield req's tokens as they are produced. Cooperative: if no
+        other thread is driving the engine, this one steps it."""
+        q = req._stream_q
+        if q is None:
+            raise ValueError('request was not added with stream=True')
+        while True:
+            try:
+                tok = q.get_nowait()
+            except _queue.Empty:
+                if req.done:
+                    return         # sentinel already consumed
+                self.step()
+                continue
+            if tok is None:
+                return
+            yield tok
+
+    def compiled_sizes(self):
+        """Times each program has been traced — the no-retrace metric."""
+        return dict(self.trace_counts)
+
+    @property
+    def occupancy(self):
+        return self.allocator.occupancy
+
+    # ---- scheduler glue (lock held) -----------------------------------
+
+    def _admit(self):
+        for slot, req in self.scheduler.admit():
+            self.metrics.on_admitted(req.id)
+            self._requests[slot] = req
+            self._budgets[slot] = req.max_new_tokens
+            self._temps[slot] = req.temperature
+            self._topks[slot] = req.top_k
+            self._sample[slot] = req.do_sample
+            # generate()'s stream: key = PRNGKey(seed), split once at
+            # prefill end — created here, advanced by the final chunk
+            req._key = np.asarray(jax.random.PRNGKey(req.seed))
+            # no cache reset needed: the first prefill chunk writes from
+            # the occupant's own offset and its write-back length
+            # unreaches the previous occupant's rows
+            self._bind(slot, req)
+
+    def _bind(self, slot, req):
+        """Subclass hook: extra per-admission state (lock held)."""
+
+    def _on_step_metrics(self):
+        """Subclass hook: extra per-step gauges (lock held)."""
+
+    def _emit(self, req, tokens):
+        if not tokens:
+            return
+        req.tokens.extend(tokens)
+        if req._stream_q is not None:
+            for t in tokens:
+                req._stream_q.put(t)
+        self.metrics.on_tokens(req.id, len(tokens))
+
+    def _retire(self, req):
+        slot = req.slot
+        self._active[slot] = False
+        del self._requests[slot]
+        self.scheduler.retire(req)
+        self.metrics.on_retired(req.id)
+
+
+class ContinuousBatchingEngine(_EngineBase):
+    """Slot-based continuous batching over a GPTForCausalLM.
+
+    Every slot reserves `max_len` KV rows (GPTSlotCache); see
+    PagedContinuousBatchingEngine for the page-granular variant with
+    prefix sharing and speculative decoding.
+    """
+
+    def __init__(self, model, num_slots=8, max_len=None, prefill_chunk=16,
+                 decode_block=4, donate=None):
+        super().__init__(model, num_slots, max_len)
+        self.decode_block = int(decode_block)
+        if self.decode_block < 1:
+            raise ValueError('decode_block must be >= 1')
+        self._caches = build_slot_caches(model, self.num_slots, self.max_len)
+        self.allocator = SlotAllocator(self.num_slots)
+        self.scheduler = Scheduler(self.allocator, self.max_len,
+                                   prefill_chunk)
         if donate is None:
             # cache buffers dominate engine memory; donating them lets
             # XLA update in place. CPU donation is a no-op that warns.
@@ -194,91 +341,7 @@ class ContinuousBatchingEngine:
         new_caches, tok2, gen2, keys2 = carry
         return new_caches, tok2, gen2, keys2, toks, actives
 
-    # ---- front door ---------------------------------------------------
-
-    def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
-                    top_k=0, do_sample=False, seed=0, stream=False):
-        """Queue a generation request; returns the Request handle."""
-        req = Request(prompt, max_new_tokens=max_new_tokens,
-                      temperature=temperature, top_k=top_k,
-                      do_sample=do_sample, seed=seed)
-        if stream:
-            req._stream_q = _queue.Queue()
-        with self._lock:
-            self.scheduler.submit(req)
-            self.metrics.on_arrival(req.id)
-        return req
-
-    def step(self):
-        """One scheduler iteration: admit → prefill chunks → decode
-        burst → retire. Returns the number of requests still pending."""
-        with self._lock, no_grad_guard():
-            self._admit()
-            self._prefill_step()
-            self._decode_step()
-            self.metrics.on_step(self.allocator.in_use, self.num_slots)
-            self.metrics.on_queue_depth(len(self.scheduler.queue))
-            for prog, child in self._m_trace.items():
-                child.set(self.trace_counts[prog])
-            return self.scheduler.pending
-
-    def run(self):
-        """Drive until every submitted request has finished."""
-        while True:
-            with self._lock:
-                if not self.scheduler.pending:
-                    return
-                self.step()
-
-    def generate(self, prompts, **sampling):
-        """Blocking batch door: submit all, drive to completion, return
-        generated ids per prompt (prompt not included) in order."""
-        reqs = [self.add_request(p, **sampling) for p in prompts]
-        self.run()
-        return [r.tokens for r in reqs]
-
-    def stream(self, req):
-        """Yield req's tokens as they are produced. Cooperative: if no
-        other thread is driving the engine, this one steps it."""
-        q = req._stream_q
-        if q is None:
-            raise ValueError('request was not added with stream=True')
-        while True:
-            try:
-                tok = q.get_nowait()
-            except _queue.Empty:
-                if req.done:
-                    return         # sentinel already consumed
-                self.step()
-                continue
-            if tok is None:
-                return
-            yield tok
-
-    def compiled_sizes(self):
-        """Times each program has been traced — the no-retrace metric."""
-        return dict(self.trace_counts)
-
-    @property
-    def occupancy(self):
-        return self.allocator.occupancy
-
-    # ---- scheduler glue (lock held) -----------------------------------
-
-    def _admit(self):
-        for slot, req in self.scheduler.admit():
-            self.metrics.on_admitted(req.id)
-            self._requests[slot] = req
-            self._budgets[slot] = req.max_new_tokens
-            self._temps[slot] = req.temperature
-            self._topks[slot] = req.top_k
-            self._sample[slot] = req.do_sample
-            # generate()'s stream: key = PRNGKey(seed), split once at
-            # prefill end — created here, advanced by the final chunk
-            req._key = np.asarray(jax.random.PRNGKey(req.seed))
-            # no cache reset needed: the first prefill chunk writes from
-            # row 0 and its write-back sets lengths[slot] = the new
-            # occupant's own length, unreaching the old rows
+    # ---- per-step dispatches (lock held) ------------------------------
 
     def _prefill_step(self):
         for req, start, ids, valid, final in self.scheduler.prefill_plan():
@@ -292,6 +355,7 @@ class ContinuousBatchingEngine:
                 np.int32(start), np.int32(valid), req._key,
                 np.float32(req.temperature), np.int32(req.top_k),
                 np.asarray(req.do_sample))
+            self.metrics.on_prefill_tokens(valid)
             self.scheduler.mark_prefilled(req, start + valid)
             if not final:
                 continue
@@ -327,19 +391,3 @@ class ContinuousBatchingEngine:
             self._emit(req, new)
             if len(req.tokens) >= req.max_new_tokens:
                 self._retire(req)
-
-    def _emit(self, req, tokens):
-        if not tokens:
-            return
-        req.tokens.extend(tokens)
-        if req._stream_q is not None:
-            for t in tokens:
-                req._stream_q.put(t)
-        self.metrics.on_tokens(req.id, len(tokens))
-
-    def _retire(self, req):
-        slot = req.slot
-        self._active[slot] = False
-        del self._requests[slot]
-        self.scheduler.retire(req)
-        self.metrics.on_retired(req.id)

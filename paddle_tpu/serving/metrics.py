@@ -19,6 +19,7 @@ import time
 
 from ..monitor import exponential_buckets
 from ..monitor.registry import default_registry
+from ..monitor.telemetry import record_serving_schema
 
 __all__ = ['ServingMetrics', 'percentile']
 
@@ -77,6 +78,24 @@ class ServingMetrics:
                                 'requests waiting for a slot')
         self._m_occupancy = r.gauge('serving_occupancy',
                                     'occupied-slot fraction, last step')
+        self._m_prefill = r.counter('serving_prefill_tokens_total',
+                                    'prompt tokens actually prefilled '
+                                    '(prefix-cache hits excluded)')
+        # paged-engine families; registered unconditionally (zeros for
+        # the slot engine) so the scrape schema does not depend on which
+        # engine a process happens to run
+        paged = record_serving_schema(r)
+        self._m_pages = paged['serving_kv_pages_in_use']
+        self._m_prefix_hits = paged['serving_prefix_cache_hits_total']
+        self._m_prefix_misses = paged['serving_prefix_cache_misses_total']
+        self._m_spec_proposed = paged['serving_spec_tokens_proposed_total']
+        self._m_spec_accepted = paged['serving_spec_tokens_accepted_total']
+        self._prefill_tokens = 0
+        self._prefix_hits = 0
+        self._prefix_misses = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._pages_in_use = 0
 
     def now(self):
         return self._clock()
@@ -129,12 +148,43 @@ class ServingMetrics:
         self._occupancy.append(frac)
         self._m_occupancy.set(frac)
 
+    def on_prefill_tokens(self, count):
+        """`count` prompt tokens were actually forwarded through the
+        model (prefix-cache hits never reach here — the win IS the
+        missing increments)."""
+        self._prefill_tokens += count
+        self._m_prefill.inc(count)
+
+    def on_pages_in_use(self, pages):
+        self._pages_in_use = pages
+        self._m_pages.set(pages)
+
+    def on_prefix_lookup(self, hits, misses):
+        """Deltas: `hits` full blocks served from the prefix cache,
+        `misses` full blocks that had to prefill, since last call."""
+        if hits:
+            self._prefix_hits += hits
+            self._m_prefix_hits.inc(hits)
+        if misses:
+            self._prefix_misses += misses
+            self._m_prefix_misses.inc(misses)
+
+    def on_spec(self, proposed, accepted):
+        """One speculative verify pass: `proposed` draft tokens went in,
+        `accepted` matched the model's own picks."""
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        self._m_spec_proposed.inc(proposed)
+        if accepted:
+            self._m_spec_accepted.inc(accepted)
+
     def report(self):
         elapsed = ((self._end - self._start)
                    if self._start is not None and self._end is not None
                    else 0.0)
         ttft = [self._first_token[r] - self._arrival[r]
                 for r in self._first_token if r in self._arrival]
+        lookups = self._prefix_hits + self._prefix_misses
         return {
             'tokens': self._tokens,
             'elapsed_s': elapsed,
@@ -144,6 +194,16 @@ class ServingMetrics:
             'ttft_p50_ms': _ms(percentile(ttft, 50)),
             'occupancy_mean': (sum(self._occupancy) / len(self._occupancy)
                                if self._occupancy else 0.0),
+            'prefill_tokens': self._prefill_tokens,
+            'pages_in_use': self._pages_in_use,
+            'prefix_hits': self._prefix_hits,
+            'prefix_misses': self._prefix_misses,
+            'prefix_hit_rate': (self._prefix_hits / lookups
+                                if lookups else 0.0),
+            'spec_proposed': self._spec_proposed,
+            'spec_accepted': self._spec_accepted,
+            'spec_accept_rate': (self._spec_accepted / self._spec_proposed
+                                 if self._spec_proposed else 0.0),
         }
 
 

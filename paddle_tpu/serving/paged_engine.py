@@ -1,0 +1,336 @@
+"""Paged continuous batching: block-granular KV + prefix reuse + spec
+decode, in exactly THREE compiled programs.
+
+The slot engine (engine.py) reserves `max_len` KV rows per slot, so
+memory density scales with the WORST-CASE sequence and identical system
+prompts re-prefill on every request. This engine keeps one physical pool
+of fixed-size pages per layer and maps sequences onto it through host
+numpy block tables (vLLM's PagedAttention layout):
+
+  - a sequence holds only the pages its actual length needs (reserved
+    up front at admission — residents can never fail mid-flight);
+  - requests sharing a prompt prefix map their leading block-table
+    entries to the SAME already-filled pages (PrefixCache, chain-hashed
+    full blocks) and skip that part of prefill entirely;
+  - optionally, an n-gram proposer drafts K tokens per decode step and
+    ONE batched verify forward accepts the longest prefix matching the
+    model's own greedy picks — up to K+1 tokens per dispatch, output
+    token-identical to sequential generate() by construction (every
+    accepted token equals the greedy pick the model would have made).
+
+Program set (the PR-3 two-program invariant, generalized but still
+bounded — trace-count gauges assert it):
+
+  prefill chunk  — [1, C] prompt tokens through one sequence's block-
+                   table row;
+  decode burst   — K cached steps for ALL sequences (spec off);
+  verify pass    — [S, K+1] draft tokens for ALL sequences (spec on).
+
+Only the page pools live on device; block tables and lengths are host
+numpy handed to jit per dispatch (values change freely, shapes never).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import functional as _fm
+from ..framework.core import Tensor
+from ..text.models.gpt import GPTPagedCache
+from .engine import _EngineBase, _pick_token
+from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
+                       build_paged_pools)
+from .scheduler import PagedScheduler
+
+__all__ = ['PagedContinuousBatchingEngine', 'NGramProposer']
+
+
+class NGramProposer:
+    """Prompt-lookup drafting: find the most recent earlier occurrence
+    of the sequence's trailing n-gram and propose whatever followed it.
+
+    Free (no draft model, no device work) and surprisingly effective on
+    serving traffic, where outputs quote their prompts — exactly the
+    regime prefix sharing also targets. Wrong drafts cost only their
+    share of one verify pass; the accept rule keeps output exact.
+    """
+
+    def __init__(self, n=2):
+        if n < 1:
+            raise ValueError('n-gram size must be >= 1')
+        self.n = int(n)
+
+    def propose(self, history, k):
+        """k draft ids continuing `history` (prompt + generated so far).
+        Falls back to repeating the last token when the n-gram has no
+        earlier occurrence — a cheap guess beats proposing nothing,
+        since the verify pass runs at [S, K+1] either way."""
+        n = min(self.n, len(history) - 1)
+        draft = []
+        if n > 0:
+            tail = history[-n:]
+            for i in range(len(history) - n - 1, -1, -1):
+                if history[i:i + n] == tail:
+                    draft = list(history[i + n:i + n + k])
+                    break
+        last = history[-1]
+        while len(draft) < k:
+            draft.append(draft[-1] if draft else last)
+        return draft[:k]
+
+
+class PagedContinuousBatchingEngine(_EngineBase):
+    """Page-granular continuous batching over a GPTForCausalLM.
+
+    Same front door and scheduling policy as ContinuousBatchingEngine;
+    differs in the KV layout (page pool + block tables), prefix-cache
+    admission, and the optional speculative decode path. `spec_k > 0`
+    replaces the decode burst with draft-and-verify and is greedy-only:
+    sampled requests are rejected at add_request, because the accept
+    rule compares drafts against argmax picks.
+    """
+
+    _programs = ('prefill', 'decode', 'verify')
+
+    def __init__(self, model, num_seqs=8, max_len=None, page_size=16,
+                 num_pages=None, prefill_chunk=16, decode_block=4,
+                 spec_k=0, ngram=2, prefix_cache=True, donate=None):
+        super().__init__(model, num_seqs, max_len)
+        if self.max_len > model.config.max_position_embeddings:
+            raise ValueError(
+                'max_len %d exceeds max_position_embeddings %d'
+                % (self.max_len, model.config.max_position_embeddings))
+        self.page_size = int(page_size)
+        self.num_blocks = -(-self.max_len // self.page_size)
+        if num_pages is None:
+            # parity default: enough for every sequence at max_len plus
+            # scratch — same footprint as the slot engine. Real
+            # deployments size the pool to ACTUAL length distributions
+            # (the density win); the scheduler's up-front reservation
+            # keeps a small pool safe, just slower to admit.
+            num_pages = self.num_slots * self.num_blocks + 1
+        self.num_pages = int(num_pages)
+        self.decode_block = int(decode_block)
+        if self.decode_block < 1:
+            raise ValueError('decode_block must be >= 1')
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError('spec_k must be >= 0')
+        self._proposer = NGramProposer(ngram) if self.spec_k else None
+        self._pools = build_paged_pools(model, self.num_pages,
+                                        self.page_size)
+        self.pages = PageAllocator(self.num_pages)
+        self.prefix = (PrefixCache(self.page_size, self.pages)
+                       if prefix_cache else None)
+        self.allocator = SlotAllocator(self.num_slots)
+        self.scheduler = PagedScheduler(self.allocator, self.pages,
+                                        self.max_len, prefill_chunk,
+                                        self.page_size, self.prefix)
+        # per-row KV length (rows written), the block-table companion to
+        # the base class's host control arrays. Mid-prefill rows track
+        # consumed so in-program garbage writes from frozen lanes land
+        # on rows the next real pass overwrites anyway.
+        self._lens = np.zeros((self.num_slots,), np.int32)
+        self._prefix_seen = [0, 0]    # hit/miss totals already reported
+        if donate is None:
+            donate = jax.default_backend() in ('tpu', 'gpu')
+        dn = (2,) if donate else ()
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dn)
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dn)
+        self._verify_jit = jax.jit(self._verify_fn, donate_argnums=dn)
+
+    @property
+    def num_seqs(self):
+        return self.num_slots
+
+    def _validate(self, req):
+        if self.spec_k and req.do_sample:
+            raise ValueError(
+                'speculative decoding (spec_k=%d) is greedy-only: the '
+                'accept rule compares drafts against argmax picks. '
+                'Submit with do_sample=False or run spec_k=0.'
+                % self.spec_k)
+
+    def _bind(self, slot, req):
+        # a prefix hit means rows [0, hit) are already valid shared
+        # pages: the row's length starts there, not at zero
+        self._lens[slot] = req._consumed
+
+    def _on_step_metrics(self):
+        self.metrics.on_pages_in_use(self.pages.in_use)
+        if self.prefix is not None:
+            h, m = self.prefix.hits, self.prefix.misses
+            self.metrics.on_prefix_lookup(h - self._prefix_seen[0],
+                                          m - self._prefix_seen[1])
+            self._prefix_seen = [h, m]
+
+    def _retire(self, req):
+        slot = req.slot
+        super()._retire(req)
+        self._lens[slot] = 0
+
+    # ---- the three compiled programs ----------------------------------
+
+    def _caches(self, pools, bt, lens):
+        return [GPTPagedCache(Tensor(k), Tensor(v), bt, lens)
+                for k, v in pools]
+
+    @staticmethod
+    def _unpack(caches):
+        return [(c.k._data, c.v._data) for c in caches]
+
+    def _prefill_fn(self, params, bufs, pools, bt1, len1, ids, valid,
+                    key, temp, topk, sample):
+        """One [1, C] prompt chunk through block-table row `bt1` at
+        offset len1. Same contract as the slot prefill: only `valid`
+        tokens are real, padded-tail writes are garbage the next pass
+        overwrites, and the returned pick matters on the final chunk."""
+        self.trace_counts['prefill'] += 1
+        caches = self._caches(pools, bt1, len1)
+        (lg, new_cs), _ = _fm.functional_call(
+            self._model, params, bufs, args=(Tensor(ids),),
+            kwargs={'caches': caches}, training=False)
+        last = jax.lax.dynamic_index_in_dim(lg[0], valid - 1, axis=0,
+                                            keepdims=False)
+        key2, sub = jax.random.split(key)
+        tok = _pick_token(last, sub, temp, topk, sample)
+        return self._unpack(new_cs), tok, key2
+
+    def _decode_fn(self, params, bufs, pools, bt, lens, tok, gen,
+                   budgets, active, keys, temps, topks, sample):
+        """K cached decode steps for all rows — the slot engine's burst
+        with lengths carried through the scan instead of living inside
+        the cache pytree (block tables are per-dispatch constants)."""
+        self.trace_counts['decode'] += 1
+
+        def body(carry, _):
+            pools, lens, tok, gen, keys = carry
+            step_active = active & (gen < budgets)
+            caches = self._caches(pools, bt, lens)
+            (lg, new_cs), _ = _fm.functional_call(
+                self._model, params, bufs, args=(Tensor(tok),),
+                kwargs={'caches': caches}, training=False)
+            inc = step_active.astype(jnp.int32)
+            ks = jax.vmap(jax.random.split)(keys)
+            subs = ks[:, 1]
+            keys2 = jnp.where(step_active[:, None], ks[:, 0], keys)
+            nxt = jax.vmap(_pick_token)(lg[:, -1], subs, temps, topks,
+                                        sample)
+            tok2 = jnp.where(step_active, nxt, tok[:, 0])[:, None]
+            return ((self._unpack(new_cs), lens + inc, tok2, gen + inc,
+                     keys2), (tok2[:, 0], step_active))
+
+        carry, (toks, actives) = jax.lax.scan(
+            body, (pools, lens, tok, gen, keys), None,
+            length=self.decode_block)
+        pools2, lens2, tok2, gen2, keys2 = carry
+        return pools2, lens2, tok2, gen2, keys2, toks, actives
+
+    def _verify_fn(self, params, bufs, pools, bt, lens, toks):
+        """ONE forward over [S, K+1] rows: position 0 feeds each row's
+        last emitted token, positions 1..K feed its drafts. Returns the
+        greedy pick after every position — pick i is the model's true
+        next token given [..., tok_0..tok_i], which is what the host
+        accept rule compares drafts against. Writes land at lens..
+        lens+K; rows past what acceptance advances are garbage the next
+        pass overwrites (or scratch-mapped, past the reservation)."""
+        self.trace_counts['verify'] += 1
+        caches = self._caches(pools, bt, lens)
+        (lg, new_cs), _ = _fm.functional_call(
+            self._model, params, bufs, args=(Tensor(toks),),
+            kwargs={'caches': caches}, training=False)
+        picks = jnp.argmax(lg.astype(jnp.float32), axis=-1).astype(
+            jnp.int32)
+        return self._unpack(new_cs), picks
+
+    # ---- per-step dispatches (lock held) ------------------------------
+
+    def _prefill_step(self):
+        for req, start, ids, valid, final in self.scheduler.prefill_plan():
+            slot = req.slot
+            self._pools, tok, key2 = self._prefill_jit(
+                self._params, self._bufs, self._pools,
+                self.scheduler.block_tables[slot:slot + 1],
+                np.asarray([start], np.int32),
+                np.asarray(ids, np.int32)[None, :],
+                np.int32(valid), req._key,
+                np.float32(req.temperature), np.int32(req.top_k),
+                np.asarray(req.do_sample))
+            self.metrics.on_prefill_tokens(valid)
+            self._lens[slot] = start + valid
+            self.scheduler.mark_prefilled(req, start + valid)
+            if not final:
+                continue
+            tok = int(tok)
+            self._last[slot, 0] = tok
+            self._gen[slot] = 1
+            self._keys[slot] = np.asarray(key2)
+            self._active[slot] = True
+            self._emit(req, [tok])
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(req)
+
+    def _decode_step(self):
+        slots = self.scheduler.decode_slots()
+        if not slots:
+            return
+        if self.spec_k:
+            return self._spec_step(slots)
+        (self._pools, lens, last, gen, keys, toks,
+         actives) = self._decode_jit(
+            self._params, self._bufs, self._pools,
+            self.scheduler.block_tables, self._lens, self._last,
+            self._gen, self._budgets, self._active, self._keys,
+            self._temps, self._topks, self._sample)
+        lens, last, gen, keys, toks, actives = jax.device_get(
+            (lens, last, gen, keys, toks, actives))
+        self._lens = np.array(lens)
+        self._last = np.array(last)
+        self._gen = np.array(gen)
+        self._keys = np.array(keys)
+        for slot in slots:
+            req = self._requests[slot]
+            new = [int(toks[k, slot]) for k in range(toks.shape[0])
+                   if actives[k, slot]]
+            self._emit(req, new)
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(req)
+
+    def _spec_step(self, slots):
+        """Draft K tokens per decoding row, verify all rows in ONE
+        [S, K+1] forward, accept each row's longest draft prefix that
+        matches the model's own greedy picks, plus the pick after it
+        (the 'bonus' token — free, since the verify forward already
+        computed it). Worst case (0 accepted) this emits 1 token per
+        row, exactly a decode step; best case K+1."""
+        K = self.spec_k
+        toks = np.zeros((self.num_slots, K + 1), np.int32)
+        drafts = {}
+        for slot in slots:
+            req = self._requests[slot]
+            d = self._proposer.propose(req.prompt + req.tokens, K)
+            drafts[slot] = d
+            toks[slot, 0] = self._last[slot, 0]
+            toks[slot, 1:] = d
+        self._pools, picks = self._verify_jit(
+            self._params, self._bufs, self._pools,
+            self.scheduler.block_tables, self._lens, toks)
+        picks = np.asarray(jax.device_get(picks))
+        for slot in slots:
+            req = self._requests[slot]
+            d, g = drafts[slot], picks[slot]
+            a = 0
+            while a < K and d[a] == int(g[a]):
+                a += 1
+            # accepted drafts + the bonus pick, clipped to budget; a
+            # decoding row always has budget left (it would have retired
+            # otherwise), so at least one token emits and lens advances
+            left = int(self._budgets[slot]) - int(self._gen[slot])
+            emit = [int(x) for x in g[:min(a + 1, left)]]
+            self.metrics.on_spec(K, max(len(emit) - 1, 0))
+            self._lens[slot] += len(emit)
+            self._gen[slot] += len(emit)
+            self._last[slot, 0] = emit[-1]
+            self._emit(req, emit)
+            if len(req.tokens) >= req.max_new_tokens:
+                self._retire(req)

@@ -13,14 +13,19 @@ Policy (Orca-style iteration-level scheduling, FIFO within a step):
      burst together (engine-side); finished sequences retire and their
      slots return to the free list the same step.
 
-Everything here is host-side bookkeeping with plain Python ints — the
-scheduler never touches device arrays, so it cannot cause a retrace.
+Everything here is host-side bookkeeping with plain Python ints (plus
+host numpy block tables for the paged variant) — the scheduler never
+touches device arrays, so it cannot cause a retrace.
 """
 import itertools
 import threading
 from collections import deque
 
-__all__ = ['Request', 'Scheduler']
+import numpy as np
+
+from .kv_cache import SCRATCH_PAGE
+
+__all__ = ['Request', 'Scheduler', 'PagedScheduler']
 
 _req_ids = itertools.count()
 
@@ -51,6 +56,9 @@ class Request:
         self.slot = None          # bound while resident
         self._key = None          # PRNG key, set at admission
         self._consumed = 0        # prompt tokens already prefilled
+        self._prefix_hit = 0      # prompt tokens served by the prefix
+        #                           cache (paged engine; 0 elsewhere)
+        self._published = 0       # prompt blocks already in the cache
         self._finished = threading.Event()
         # engine.stream() consumers read tokens from here; None until the
         # first stream() call so non-streamed requests pay nothing
@@ -158,3 +166,134 @@ class Scheduler:
     def pending(self):
         """Requests not yet DONE anywhere in the system."""
         return len(self.queue) + len(self.resident)
+
+
+class PagedScheduler(Scheduler):
+    """Page-aware admission over a PageAllocator + optional PrefixCache.
+
+    Same FIFO iteration-level policy as Scheduler, with two additions:
+
+    - ADMIT reserves the request's ENTIRE page need up front (prefix-hit
+      blocks are shared via incref, the rest freshly allocated). Because
+      every resident request already holds everything it will ever
+      write, residents always run to completion — no mid-flight
+      allocation failure, no preemption, no deadlock. When the HEAD
+      request cannot get its pages (even after evicting idle prefix-
+      cache entries) admission stops for the step rather than skipping
+      ahead: FIFO order is what makes waiting bounded.
+    - A prefix-cache hit fast-forwards `_consumed` to the shared length,
+      so prefill work is paid only for the unshared tail.
+
+    Block tables live here as one host numpy array [num_slots,
+    max_blocks] (int32 page ids, SCRATCH_PAGE where unmapped); the
+    engine hands rows of it to the jitted programs verbatim.
+    """
+
+    def __init__(self, allocator, pages, max_len, prefill_chunk,
+                 page_size, prefix_cache=None):
+        super().__init__(allocator, max_len, prefill_chunk)
+        if page_size < 1:
+            raise ValueError('page_size must be >= 1')
+        self.pages = pages
+        self.page_size = int(page_size)
+        self.prefix = prefix_cache
+        self.num_blocks = -(-self.max_len // self.page_size)
+        self.block_tables = np.full(
+            (allocator.num_slots, self.num_blocks), SCRATCH_PAGE, np.int32)
+        self._nblocks = {}        # slot -> mapped block count
+
+    def submit(self, req):
+        """Front-door capacity check, page-aware: the worst padded
+        prefill end over any possible prefix-hit length is n0 + chunk -
+        1 (a hit mid-chunk shifts the chunk grid right), and the cache
+        contents at admission time are unknowable here — so validate
+        against that bound, not today's cache."""
+        n0 = len(req.prompt)
+        if n0 < 1:
+            raise ValueError('empty prompt')
+        if req.max_new_tokens < 1:
+            raise ValueError('max_new_tokens must be >= 1')
+        need = max(n0 + req.max_new_tokens - 1,
+                   n0 + self.prefill_chunk - 1)
+        if need > self.max_len:
+            raise ValueError(
+                'request needs up to %d cache rows (prompt %d + %d new '
+                'tokens, worst-case prefill padding) but sequences hold '
+                '%d' % (need, n0, req.max_new_tokens, self.max_len))
+        total = self.pages.num_pages - 1       # minus the scratch page
+        if -(-need // self.page_size) > total:
+            raise ValueError(
+                'request needs %d pages but the pool only has %d'
+                % (-(-need // self.page_size), total))
+        self.queue.append(req)
+
+    def admit(self):
+        admitted = []
+        while self.queue and self.allocator.available:
+            req = self.queue[0]
+            plan = self._reserve(req)
+            if plan is None:
+                break                          # head blocked => stop: FIFO
+            self.queue.popleft()
+            pages, hit_len = plan
+            slot = self.allocator.alloc(req.id)
+            row = self.block_tables[slot]
+            row[:] = SCRATCH_PAGE
+            row[:len(pages)] = pages
+            self._nblocks[slot] = len(pages)
+            req.slot = slot
+            req.state = PREFILL
+            req._consumed = hit_len            # shared prefix: already
+            req._prefix_hit = hit_len          # prefilled, skip it
+            req._published = hit_len // self.page_size
+            self.resident[slot] = req
+            admitted.append((slot, req))
+        return admitted
+
+    def _reserve(self, req):
+        """All pages for `req` up front: [pages], hit_len — or None when
+        the pool cannot cover it this step."""
+        P, c, n0 = self.page_size, self.prefill_chunk, len(req.prompt)
+        # (`is not None`, not truthiness — an empty PrefixCache has
+        # __len__ 0 and still must count its misses)
+        hit_pages = (self.prefix.match(req.prompt)
+                     if self.prefix is not None else [])
+        # hold the hits BEFORE any eviction: a matched page at cache-
+        # refcount 1 must not be evicted out from under this reservation
+        for p in hit_pages:
+            self.pages.incref(p)
+        hit_len = len(hit_pages) * P
+        need = max(n0 + req.max_new_tokens - 1,
+                   hit_len + -(-(n0 - hit_len) // c) * c)
+        want = -(-need // P) - len(hit_pages)
+        short = want - self.pages.available
+        if short > 0 and self.prefix is not None:
+            self.prefix.evict(short)
+        if want > self.pages.available:
+            for p in hit_pages:
+                self.pages.decref(p)
+            return None
+        return hit_pages + [self.pages.alloc() for _ in range(want)], \
+            hit_len
+
+    def mark_prefilled(self, req, consumed):
+        super().mark_prefilled(req, consumed)
+        if self.prefix is None:
+            return
+        # publish every prompt block this chunk completed: its page now
+        # holds final, immutable K/V that any later request may share
+        P = self.page_size
+        row = self.block_tables[req.slot]
+        done = min(consumed, len(req.prompt)) // P
+        for b in range(req._published, done):
+            self.prefix.publish(req.prompt, b, int(row[b]))
+        req._published = max(req._published, done)
+
+    def retire(self, req):
+        slot = req.slot
+        row = self.block_tables[slot]
+        for b in range(self._nblocks.pop(slot, 0)):
+            if row[b] != SCRATCH_PAGE:
+                self.pages.decref(int(row[b]))
+        row[:] = SCRATCH_PAGE
+        super().retire(req)

@@ -165,6 +165,66 @@ jax.tree_util.register_pytree_node(GPTSlotCache, _slot_cache_flatten,
                                    _slot_cache_unflatten)
 
 
+class GPTPagedCache:
+    """Block/page-granular KV cache for the paged serving engine
+    (paddle_tpu/serving/paged_engine.py): per layer, a physical pool of
+    `[num_pages, page_size, H, Dh]` K/V pages plus a per-sequence
+    BLOCK TABLE `[B, max_blocks]` (int32 page ids) and per-sequence
+    valid lengths `[B]`. A sequence's logical row j lives in pool row
+    `block_tables[s, j // page_size] * page_size + j % page_size`, so
+    sequences of different lengths occupy only the pages they need and
+    several sequences may map leading blocks to the SAME physical page
+    (prefix sharing).
+
+    Invariants (owned by the serving engine / PagedScheduler):
+      - block-table entry 0 is the reserved SCRATCH page: never handed
+        to a real block, so garbage writes from frozen/retired rows land
+        there (or on the row's own dead rows past its length) and are
+        unreachable — shared pages are only ever FULL, immutable blocks
+        strictly below every writer's length, so no real write can touch
+        them;
+      - like GPTSlotCache, attention writes this step's K/V at each
+        row's current length but does NOT advance `lengths`; the engine
+        advances them host-side after the full forward;
+      - pool rows at/beyond a sequence's length are garbage and never
+        attended (the validity mask allows logical positions <= the
+        query's absolute position only);
+      - capacity/ownership is guarded host-side at admission: a traced
+        block table cannot be range-checked in-program (writes are
+        clipped to the pool as a memory-safety net; a clipped write is
+        by construction a garbage write).
+    """
+
+    def __init__(self, k_pool, v_pool, block_tables, lengths):
+        self.k = k_pool          # [num_pages, page_size, H, Dh]
+        self.v = v_pool
+        self.block_tables = block_tables  # [B, max_blocks] int32
+        self.lengths = lengths            # [B] int32 (traced under jit)
+
+    @staticmethod
+    def empty(num_pages, page_size, max_blocks, batch, num_heads,
+              head_dim, dtype='float32'):
+        import paddle_tpu as paddle
+        k = paddle.zeros([num_pages, page_size, num_heads, head_dim], dtype)
+        v = paddle.zeros([num_pages, page_size, num_heads, head_dim], dtype)
+        return GPTPagedCache(k, v,
+                             jnp.zeros((batch, max_blocks), jnp.int32),
+                             jnp.zeros((batch,), jnp.int32))
+
+
+def _paged_cache_flatten(c):
+    return (c.k._data, c.v._data, c.block_tables, c.lengths), None
+
+
+def _paged_cache_unflatten(_, children):
+    k, v, bt, lengths = children
+    return GPTPagedCache(Tensor(k), Tensor(v), bt, lengths)
+
+
+jax.tree_util.register_pytree_node(GPTPagedCache, _paged_cache_flatten,
+                                   _paged_cache_unflatten)
+
+
 def _cache_get(cache, key, build, cap=8):
     """Bounded per-model compiled-executable cache: a serving loop with
     naturally varying prompt/generation shapes must not pin one XLA
@@ -221,6 +281,65 @@ class GPTAttention(nn.Layer):
             q = qkv[:, :, 0]
             k = qkv[:, :, 1]
             v = qkv[:, :, 2]
+        if isinstance(cache, GPTPagedCache):
+            import jax
+            from ...framework.core import is_grad_enabled
+            if self.training and is_grad_enabled():
+                raise RuntimeError(
+                    'GPTPagedCache is an inference-only serving path — '
+                    'call model.eval() / no_grad')
+            num_pages, page = cache.k.shape[0], cache.k.shape[1]
+            nb = cache.block_tables.shape[1]
+            L = nb * page                       # logical capacity per row
+            t = cache.lengths                   # [B] per-row write offsets
+            bt = cache.block_tables             # [B, nb] physical page ids
+            if not isinstance(t, jax.core.Tracer) and \
+                    int(jnp.max(t)) + n > L:
+                # (under jit lengths are traced; the serving engine guards
+                # capacity at admission instead)
+                raise ValueError(
+                    'paged cache overflow: max row length %d + %d new '
+                    'tokens > capacity %d' % (int(jnp.max(t)), n, L))
+            # write: token i of row s sits at absolute position t[s]+i;
+            # its pool row is bt[s, pos // page] * page + pos % page.
+            # ONE flat scatter covers all rows; clipping keeps garbage
+            # from frozen rows inside the pool (it lands on the scratch
+            # page or the row's own dead rows — both unreachable, see
+            # GPTPagedCache invariants)
+            pos = jnp.clip(t[:, None] + jnp.arange(n)[None, :], 0, L - 1)
+            rows = (jnp.take_along_axis(bt, pos // page, axis=1) * page
+                    + pos % page)                                # [B, n]
+            flat_shape = (num_pages * page,) + tuple(cache.k.shape[2:])
+            kf = cache.k._data.reshape(flat_shape)
+            vf = cache.v._data.reshape(flat_shape)
+            idx = rows.reshape(-1)
+            kf = kf.at[idx].set(k._data.astype(kf.dtype).reshape(
+                (b * n,) + flat_shape[1:]))
+            vf = vf.at[idx].set(v._data.astype(vf.dtype).reshape(
+                (b * n,) + flat_shape[1:]))
+            new_cache = GPTPagedCache(
+                Tensor(kf.reshape(cache.k._data.shape)),
+                Tensor(vf.reshape(cache.v._data.shape)), bt, t)
+            # read: gather each row's logical [L] view through its block
+            # table (this step's rows included — written above), then the
+            # same masked attention as the slot path. The gather
+            # materializes [B, L, H, Dh] activations; persistent memory
+            # stays page-granular, which is where the density win lives.
+            view = (bt[:, :, None] * page
+                    + jnp.arange(page)[None, None, :]).reshape(b, L)
+            kg = jnp.take(kf, view, axis=0)                # [B, L, H, Dh]
+            vg = jnp.take(vf, view, axis=0)
+            # per-row validity mask: query row i of sequence s sits at
+            # absolute position t[s]+i and sees logical positions <= it
+            qpos = t[:, None] + jnp.arange(n)[None, :]           # [B, n]
+            allow = qpos[:, :, None] >= jnp.arange(L)[None, None, :]
+            mask = Tensor(jnp.where(allow, 0.0, -1e9)[:, None].astype(
+                jnp.float32))                                # [B,1,n,L]
+            out = F.scaled_dot_product_attention(
+                q, Tensor(kg), Tensor(vg), attn_mask=mask,
+                is_causal=False, dropout_p=0.0)
+            out = M.reshape(out, [b, n, self.hidden_size])
+            return self.out_proj(out), new_cache
         if isinstance(cache, GPTSlotCache):
             import jax
             from ...framework.core import is_grad_enabled
@@ -390,7 +509,8 @@ class GPTModel(nn.Layer):
     def forward(self, input_ids, position_ids=None, caches=None):
         n = input_ids.shape[1]
         if position_ids is None:
-            if caches is not None and isinstance(caches[0], GPTSlotCache):
+            if caches is not None and isinstance(
+                    caches[0], (GPTSlotCache, GPTPagedCache)):
                 # serving: each slot's positions continue from ITS length
                 position_ids = Tensor(
                     caches[0].lengths[:, None] + jnp.arange(n)[None, :])

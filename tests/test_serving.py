@@ -3,9 +3,12 @@
 The two load-bearing assertions from the engine's contract:
   1. greedy tokens through the engine are IDENTICAL to sequential
      model.generate() for mixed-length prompts — continuous batching
-     must not buy throughput with output drift;
-  2. the two compiled programs trace exactly once across an arbitrary
-     admit/retire workload — slot churn must never retrace.
+     must not buy throughput with output drift; the paged engine must
+     hold the same bar with prefix sharing and speculative decoding on;
+  2. the compiled program set is FIXED and traces once per program
+     across an arbitrary admit/retire workload — churn must never
+     retrace (two programs for the slot engine, at most four overall
+     for the paged engine).
 """
 import threading
 
@@ -13,7 +16,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.serving import (ContinuousBatchingEngine, Scheduler,
+from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                PagedContinuousBatchingEngine, Scheduler,
                                 ServingMetrics, SlotAllocator)
 from paddle_tpu.serving.metrics import percentile
 from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
@@ -259,6 +263,90 @@ def test_percentile_is_linear_interpolation_not_nearest_rank():
     assert percentile([1.0, 2.0, 4.0], 75) == pytest.approx(3.0)  # not 2/4
 
 
+def test_paged_greedy_parity_and_bounded_compilation(model, prompts):
+    """The paged acceptance bar: token-identical to generate() with
+    sequences << requests (page/slot churn), the program set stays at
+    the fixed prefill/decode pair, and every page returns to the free
+    list or the prefix cache when the workload drains."""
+    mnt = 11
+    expect = [_sequential(model, p, mnt) for p in prompts]
+    eng = PagedContinuousBatchingEngine(model, num_seqs=3, max_len=64,
+                                        page_size=8, prefill_chunk=8,
+                                        decode_block=4)
+    got = eng.generate(prompts, max_new_tokens=mnt)
+    assert got == expect
+    assert eng.compiled_sizes() == {'prefill': 1, 'decode': 1, 'verify': 0}
+    assert eng.allocator.in_use == 0
+    assert eng.scheduler.pending == 0
+    # only prefix-cache references may outlive the requests
+    assert eng.pages.in_use == len(eng.prefix)
+
+
+def test_paged_prefix_sharing_parity_and_reduced_prefill(model):
+    """Requests sharing a system prompt hit the prefix cache (> 0 hit
+    rate), skip the shared blocks' prefill (fewer prefilled tokens than
+    a cache-off engine on the same workload) and still match
+    sequential generate() token-for-token."""
+    rng = np.random.RandomState(11)
+    system = [int(t) for t in rng.randint(0, 211, 16)]
+    prompts = [system + [int(t) for t in rng.randint(0, 211, 3)]
+               for _ in range(6)]
+    mnt = 8
+    expect = [_sequential(model, p, mnt) for p in prompts]
+    kw = dict(num_seqs=2, max_len=64, page_size=8, prefill_chunk=8,
+              decode_block=4)
+    shared = PagedContinuousBatchingEngine(model, **kw)
+    got = shared.generate(prompts, max_new_tokens=mnt)
+    assert got == expect
+    rep = shared.metrics.report()
+    assert rep['prefix_hits'] > 0
+    assert rep['prefix_hit_rate'] > 0
+    cold = PagedContinuousBatchingEngine(model, prefix_cache=False, **kw)
+    assert cold.generate(prompts, max_new_tokens=mnt) == expect
+    cold_rep = cold.metrics.report()
+    assert cold_rep['prefix_hits'] == 0
+    # the hit-rate win is real work not done: strictly fewer prompt
+    # tokens went through the prefill program
+    assert rep['prefill_tokens'] < cold_rep['prefill_tokens']
+
+
+def test_paged_spec_decode_parity(model, prompts):
+    """Draft-and-verify emits the exact greedy sequence (the accept rule
+    only keeps drafts equal to the model's own argmax picks), reports
+    its acceptance counters, and the overall program set stays within
+    the four-program bound."""
+    mnt = 11
+    expect = [_sequential(model, p, mnt) for p in prompts[:6]]
+    eng = PagedContinuousBatchingEngine(model, num_seqs=3, max_len=64,
+                                        page_size=8, prefill_chunk=8,
+                                        decode_block=4, spec_k=3)
+    got = eng.generate(prompts[:6], max_new_tokens=mnt)
+    assert got == expect
+    rep = eng.metrics.report()
+    assert rep['spec_proposed'] > 0
+    assert 0.0 <= rep['spec_accept_rate'] <= 1.0
+    traces = eng.compiled_sizes()
+    assert traces == {'prefill': 1, 'decode': 0, 'verify': 1}
+    assert sum(1 for v in traces.values() if v) <= 4
+    # greedy-only: the accept rule compares against argmax picks
+    with pytest.raises(ValueError, match='greedy-only'):
+        eng.add_request(prompts[0], max_new_tokens=4, do_sample=True)
+
+
+def test_paged_sampling_stream_parity(model, prompts):
+    """With spec off, the paged engine serves sampled requests through
+    the same per-request PRNG stream as generate() — page indirection
+    must not perturb logits or key order."""
+    mnt = 8
+    kw = dict(do_sample=True, temperature=0.8, top_k=5, seed=42)
+    expect = [_sequential(model, p, mnt, **kw) for p in prompts[:4]]
+    eng = PagedContinuousBatchingEngine(model, num_seqs=2, max_len=64,
+                                        page_size=8, prefill_chunk=8,
+                                        decode_block=4)
+    got = eng.generate(prompts[:4], max_new_tokens=mnt, **kw)
+    assert got == expect
+
+
 def test_predictor_decode_engine(model, prompts, tmp_path):
     """The serving front door reached the inference API: a jit.save'd
     causal LM round-trips into an engine whose output matches the live
@@ -271,6 +359,12 @@ def test_predictor_decode_engine(model, prompts, tmp_path):
                              decode_block=4)
     got = eng.generate(prompts[:3], max_new_tokens=6)
     assert got == [_sequential(model, p, 6) for p in prompts[:3]]
+    # and the paged variant through the same door
+    paged = pred.decode_engine(num_slots=2, max_len=64, prefill_chunk=8,
+                               decode_block=4, paged=True, page_size=8)
+    assert paged.generate(prompts[:3], max_new_tokens=6) == got
+    with pytest.raises(TypeError, match='paged=True'):
+        pred.decode_engine(page_size=8)
 
 
 def test_predictor_decode_engine_rejects_non_lm(tmp_path):

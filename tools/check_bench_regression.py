@@ -34,9 +34,12 @@ if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
 # auxiliary config fields that distinguish otherwise same-env rows
-# (bench_extra rungs vary these, not the knob env)
+# (bench_extra rungs vary these, not the knob env). The paged-serving
+# rung adds page_size/spec_k/workload: a spec-on row must never land in
+# a spec-off row's regression bucket.
 _AUX_CONFIG = ('num_slots', 'new_tokens', 'prompt_len', 'image_size',
-               'trace', 'model', 'scan_steps')
+               'trace', 'model', 'scan_steps', 'page_size', 'spec_k',
+               'workload')
 
 __all__ = ['eligible', 'config_key', 'higher_is_better', 'check', 'main']
 

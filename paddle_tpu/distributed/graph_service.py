@@ -15,6 +15,7 @@ import threading
 import numpy as np
 
 from ..monitor import default_registry as _monitor_registry
+from ..monitor import tracing as _tracing
 from ..native.graph_store import GraphStore
 from .ps.embedding_service import _send_msg, _recv_msg
 from .resilience import Deadline, ResilientChannel, RetryPolicy
@@ -44,6 +45,9 @@ class _GraphHandler(socketserver.BaseRequestHandler):
                 msg = _recv_msg(self.request)
             except (ConnectionError, OSError):
                 return
+            # continues the client's rpc.attempt span when the message
+            # carries trace context; always strips the metadata key
+            span = _tracing.default_tracer().server_span(msg, 'graph.server')
             op = msg['op']
             try:
                 if op == 'stop':
@@ -90,7 +94,10 @@ class _GraphHandler(socketserver.BaseRequestHandler):
                 else:
                     _send_msg(self.request, {'error': 'unknown op %r' % op})
             except Exception as e:  # report instead of killing the server
+                span.set_error(e)
                 _send_msg(self.request, {'error': repr(e)})
+            finally:
+                span.finish()
 
 
 class _GraphTCPServer(socketserver.ThreadingTCPServer):

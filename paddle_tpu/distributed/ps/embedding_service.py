@@ -21,6 +21,7 @@ import threading
 import numpy as np
 
 from ...monitor import default_registry as _monitor_registry
+from ...monitor import tracing as _tracing
 from ..resilience import Deadline, ResilientChannel, call_once
 
 __all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient',
@@ -238,6 +239,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 msg = _recv_msg(self.request)
             except (ConnectionError, OSError):
                 return
+            # continues the client's rpc.attempt span when the message
+            # carries trace context; always strips the metadata key
+            span = _tracing.default_tracer().server_span(msg, 'ps.server')
             try:
                 op = msg['op']
                 if op == 'pull':
@@ -284,10 +288,13 @@ class _Handler(socketserver.BaseRequestHandler):
                 else:
                     _send_msg(self.request, {'error': 'unknown op %r' % op})
             except Exception as e:  # report instead of killing the server
+                span.set_error(e)
                 try:
                     _send_msg(self.request, {'error': repr(e)})
                 except OSError:
                     return
+            finally:
+                span.finish()
 
 
 class _PsTCPServer(socketserver.ThreadingTCPServer):

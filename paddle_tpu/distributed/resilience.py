@@ -26,6 +26,7 @@ import threading
 import time
 
 from ..monitor import default_registry as _monitor_registry
+from ..monitor import tracing as _tracing
 
 __all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
            'RpcError', 'RetryableError', 'DeadlineExceeded',
@@ -239,6 +240,9 @@ class CircuitBreaker:
                 self._note_transition(self.CLOSED)
 
     def record_failure(self):
+        """Count one failure; returns True exactly when this failure
+        (re)opened the breaker — the edge the flight recorder dumps on,
+        so a failure storm yields one dump, not one per call."""
         with self._lock:
             was = self._state_locked()
             self._failures += 1
@@ -248,6 +252,8 @@ class CircuitBreaker:
                 self._opened_at = time.monotonic()
                 if was != self.OPEN:
                     self._note_transition(self.OPEN)
+                    return True
+        return False
 
 
 # -- framed messages over the PS wire codec ---------------------------------
@@ -357,54 +363,100 @@ class ResilientChannel:
         failure the server may or may not have applied the op, so a
         blind resend could double-apply (grad pushes). The connection is
         still timed out and reconnected for the NEXT call.
+
+        With tracing enabled the call runs under an 'rpc.call' span with
+        one 'rpc.attempt' child per wire attempt; each attempt's trace
+        context rides the message under TRACE_KEY so the server-side
+        handler span parents on the exact attempt that reached it.
         """
         if timeout is None:
             timeout = self.call_timeout
         attempts = self.policy.max_attempts if idempotent else 1
+        tr = _tracing.default_tracer()
+        if not tr.enabled:
+            with self._lock:
+                return self._call_locked(msg, timeout, deadline, attempts,
+                                         tr, _tracing.NULL_SPAN)
+        with tr.start_span('rpc.call',
+                           tags={'endpoint': self.endpoint,
+                                 'idempotent': bool(idempotent)}) as span:
+            with self._lock:
+                return self._call_locked(msg, timeout, deadline, attempts,
+                                         tr, span)
+
+    def _call_locked(self, msg, timeout, deadline, attempts, tr, span):
         last_exc = None
-        with self._lock:
-            for attempt in range(1, attempts + 1):
-                if deadline is not None and deadline.expired():
-                    self._m_deadline.inc()
-                    raise DeadlineExceeded(
-                        'deadline expired before attempt %d to %s'
-                        % (attempt, self.endpoint),
-                        endpoint=self.endpoint, attempts=attempt - 1) \
-                        from last_exc
-                if not self.breaker.allow():
-                    self._m_circuit.inc()
-                    raise CircuitOpenError(
-                        'circuit open for %s (%d consecutive failures)'
-                        % (self.endpoint, self.breaker._failures),
-                        endpoint=self.endpoint, attempts=attempt - 1) \
-                        from last_exc
-                try:
-                    self._m_attempts.inc()
-                    out = self._attempt(msg, timeout, deadline)
-                    self.breaker.record_success()
-                    return out
-                except DeadlineExceeded:
-                    self._drop_connection()
-                    self._m_deadline.inc()
+        for attempt in range(1, attempts + 1):
+            if deadline is not None and deadline.expired():
+                self._m_deadline.inc()
+                if span:
+                    span.set_tag('deadline_expired', True)
+                    tr.recorder.maybe_dump('deadline_expired')
+                raise DeadlineExceeded(
+                    'deadline expired before attempt %d to %s'
+                    % (attempt, self.endpoint),
+                    endpoint=self.endpoint, attempts=attempt - 1) \
+                    from last_exc
+            if not self.breaker.allow():
+                self._m_circuit.inc()
+                span.set_tag('circuit_open_fast_fail', True)
+                raise CircuitOpenError(
+                    'circuit open for %s (%d consecutive failures)'
+                    % (self.endpoint, self.breaker._failures),
+                    endpoint=self.endpoint, attempts=attempt - 1) \
+                    from last_exc
+            if span:
+                att = tr.start_span('rpc.attempt', parent=span,
+                                    tags={'attempt': attempt,
+                                          'retries': attempt - 1,
+                                          'breaker': self.breaker.state})
+                wire = dict(msg)
+                wire[_tracing.TRACE_KEY] = att.ctx()
+            else:
+                att = _tracing.NULL_SPAN
+                wire = msg
+            try:
+                self._m_attempts.inc()
+                out = self._attempt(wire, timeout, deadline)
+                self.breaker.record_success()
+                att.finish()
+                return out
+            except DeadlineExceeded as e:
+                self._drop_connection()
+                self._m_deadline.inc()
+                att.set_error(e)
+                att.finish()
+                if span:
+                    tr.recorder.maybe_dump('deadline_expired')
+                raise
+            except Exception as e:
+                self._drop_connection()
+                att.set_error(e)
+                att.finish()
+                if not self.policy.is_retryable(e):
                     raise
-                except Exception as e:
-                    self._drop_connection()
-                    if not self.policy.is_retryable(e):
-                        raise
-                    self.breaker.record_failure()
-                    self._m_failures.inc()
-                    last_exc = e
-                    if attempt < attempts:
-                        delay = self.policy.backoff(attempt)
-                        if deadline is not None:
-                            rem = deadline.remaining()
-                            if rem <= 0:
-                                break
-                            delay = min(delay, rem)
-                        self._m_backoff.inc(delay)
-                        time.sleep(delay)
+                opened = self.breaker.record_failure()
+                self._m_failures.inc()
+                if opened and span:
+                    # the failing attempt span is already in the ring
+                    tr.recorder.maybe_dump('circuit_open')
+                last_exc = e
+                if attempt < attempts:
+                    delay = self.policy.backoff(attempt)
+                    if deadline is not None:
+                        rem = deadline.remaining()
+                        if rem <= 0:
+                            break
+                        delay = min(delay, rem)
+                    span.add_event('backoff', attempt=attempt,
+                                   seconds=round(delay, 6))
+                    self._m_backoff.inc(delay)
+                    time.sleep(delay)
         if deadline is not None and deadline.expired():
             self._m_deadline.inc()
+            if span:
+                span.set_tag('deadline_expired', True)
+                tr.recorder.maybe_dump('deadline_expired')
             raise DeadlineExceeded(
                 'deadline expired after %d attempts to %s: %r'
                 % (attempts, self.endpoint, last_exc),

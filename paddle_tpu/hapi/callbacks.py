@@ -281,9 +281,24 @@ class TelemetryCallback(Callback):
                               'examples/s of the last step')
         self._m_loss = r.gauge('train_loss', 'loss of the last step')
         self._m_epoch = r.gauge('train_epoch', 'current epoch index')
+        from ..monitor import tracing as _tracing
+        self._tracer = _tracing.default_tracer()
+        self._epoch_span = None
 
     def on_epoch_begin(self, epoch, logs=None):
         self._m_epoch.set(epoch)
+        self._finish_epoch_span()
+        if self._tracer.enabled:
+            self._epoch_span = self._tracer.start_span(
+                'train.epoch', tags={'epoch': epoch})
+
+    def _finish_epoch_span(self):
+        if self._epoch_span is not None:
+            self._epoch_span.finish()
+            self._epoch_span = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._finish_epoch_span()
 
     def on_train_batch_begin(self, step, logs=None):
         self._t0 = self._clock()
@@ -307,6 +322,7 @@ class TelemetryCallback(Callback):
             self._sampler.sample_once()
 
     def on_train_end(self, logs=None):
+        self._finish_epoch_span()
         if self._sampler is not None:
             self._sampler.sample_once()
 

@@ -9,7 +9,11 @@ Four layers, each usable alone:
 - ``server``    — MetricsServer: stdlib http.server on /metrics,
   /healthz (and /metrics.json) for curl / Prometheus scrapes;
 - ``runtime``   — RuntimeSampler: host RSS, live jax array bytes,
-  device count, tracing-cache sizes on a background thread.
+  device count, tracing-cache sizes on a background thread;
+- ``tracing``   — distributed span tracer (trace_id/span_id/parent,
+  contextvars propagation, cross-process context injection) with a
+  flight-recorder ring served at /debug/traces and exportable as
+  Chrome-trace JSON for profiler.merge_traces.
 
 Built-in instrumentation (resilient RPC, the serving engine, PS/graph
 clients, hapi TelemetryCallback, the dryrun telemetry line) feeds
@@ -23,9 +27,14 @@ from .registry import (Counter, Gauge, Histogram, MetricRegistry,
 from .export import schema_of, to_dict, to_json, to_prometheus
 from .server import MetricsServer
 from .runtime import RuntimeSampler
+from .tracing import (FlightRecorder, Span, Tracer, default_tracer,
+                      set_default_tracer, spans_to_chrome)
 from . import telemetry
+from . import tracing
 
 __all__ = ['MetricRegistry', 'Counter', 'Gauge', 'Histogram',
            'exponential_buckets', 'default_registry',
            'set_default_registry', 'to_prometheus', 'to_dict', 'to_json',
-           'schema_of', 'MetricsServer', 'RuntimeSampler', 'telemetry']
+           'schema_of', 'MetricsServer', 'RuntimeSampler', 'telemetry',
+           'Tracer', 'Span', 'FlightRecorder', 'default_tracer',
+           'set_default_tracer', 'spans_to_chrome', 'tracing']

@@ -91,6 +91,17 @@ def to_dict(registry, buckets=True):
                         _fmt_value(float(b)): n
                         for b, n in zip(fam.buckets, snap['buckets'])}
                     s['buckets']['+Inf'] = snap['buckets'][-1]
+                    ex = snap.get('exemplars')
+                    if ex:
+                        # text exposition 0.0.4 has no exemplar syntax,
+                        # so trace links ride the JSON snapshot only
+                        def _bound(i):
+                            return (_fmt_value(float(fam.buckets[i]))
+                                    if i < len(fam.buckets) else '+Inf')
+                        s['exemplars'] = {
+                            _bound(i): {'trace_id': t, 'value': v,
+                                        'ts': ts}
+                            for i, (t, v, ts) in sorted(ex.items())}
             else:
                 s['value'] = child.value()
             samples.append(s)

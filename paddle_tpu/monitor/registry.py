@@ -98,7 +98,8 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild:
-    __slots__ = ('_reg', '_lock', '_bounds', '_counts', '_sum', '_count')
+    __slots__ = ('_reg', '_lock', '_bounds', '_counts', '_sum', '_count',
+                 '_exemplars')
 
     def __init__(self, reg, lock, bounds):
         self._reg = reg
@@ -107,8 +108,12 @@ class _HistogramChild:
         self._counts = [0] * (len(bounds) + 1)   # trailing +Inf bucket
         self._sum = 0.0
         self._count = 0
+        # bucket index -> (exemplar id, value, t): the LAST annotated
+        # observation per bucket, so an outlier bucket links back to a
+        # concrete trace (monitor/tracing.py exemplars)
+        self._exemplars = {}
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         if not self._reg._enabled:
             return
         i = bisect.bisect_left(self._bounds, value)
@@ -116,6 +121,9 @@ class _HistogramChild:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), float(value),
+                                      self._reg.clock())
 
     def value(self):
         """(count, sum) — the scalar view used by tests/snapshots."""
@@ -125,7 +133,8 @@ class _HistogramChild:
     def snapshot(self):
         with self._lock:
             return {'count': self._count, 'sum': self._sum,
-                    'buckets': list(self._counts)}
+                    'buckets': list(self._counts),
+                    'exemplars': dict(self._exemplars)}
 
 
 class _Family:

@@ -2,9 +2,12 @@
 
 Prometheus-compatible without the prometheus_client dependency (the
 image bakes nothing in): text exposition 0.0.4 on /metrics, a tiny JSON
-liveness body on /healthz, 404 elsewhere. Ephemeral-port by default so
-tests and multi-engine processes never collide; `.port`/`.url` report
-the bound address.
+liveness body on /healthz, the tracer's flight-recorder ring on
+/debug/traces (?format=chrome for a Perfetto-loadable body), 404
+elsewhere. HEAD is answered on every route (load-balancer probes use it
+and must not see http.server's default 501). Ephemeral-port by default
+so tests and multi-engine processes never collide; `.port`/`.url`
+report the bound address.
 """
 import http.server
 import json
@@ -23,29 +26,51 @@ class _Handler(http.server.BaseHTTPRequestHandler):
     # one scrape per connection is fine; keep-alive complicates shutdown
     protocol_version = 'HTTP/1.0'
 
-    def do_GET(self):
-        path = self.path.split('?', 1)[0]
+    def _route(self):
+        """(code, content-type, body) for the request path — shared by
+        GET and HEAD so probe responses carry the real headers."""
+        path, _, query = self.path.partition('?')
         if path == '/metrics':
-            body = export.to_prometheus(self.server.registry).encode()
-            self._reply(200, CONTENT_TYPE, body)
-        elif path in ('/healthz', '/health'):
+            return (200, CONTENT_TYPE,
+                    export.to_prometheus(self.server.registry).encode())
+        if path in ('/healthz', '/health'):
             body = json.dumps({
                 'status': 'ok',
                 'uptime_s': round(time.monotonic() - self.server.started,
                                   3)}).encode()
-            self._reply(200, 'application/json', body)
-        elif path == '/metrics.json':
-            body = export.to_json(self.server.registry).encode()
-            self._reply(200, 'application/json', body)
-        else:
-            self._reply(404, 'text/plain; charset=utf-8', b'not found\n')
+            return 200, 'application/json', body
+        if path == '/metrics.json':
+            return (200, 'application/json',
+                    export.to_json(self.server.registry).encode())
+        if path == '/debug/traces':
+            tracer = getattr(self.server, 'tracer', None)
+            if tracer is None:
+                return (404, 'text/plain; charset=utf-8',
+                        b'no tracer attached\n')
+            rec = tracer.recorder
+            if 'format=chrome' in query:
+                body = json.dumps(rec.to_chrome()).encode()
+            else:
+                body = json.dumps({'enabled': tracer.enabled,
+                                   'capacity': rec.capacity,
+                                   'dropped': rec.dropped,
+                                   'spans': rec.spans()}).encode()
+            return 200, 'application/json', body
+        return 404, 'text/plain; charset=utf-8', b'not found\n'
 
-    def _reply(self, code, ctype, body):
+    def do_GET(self):
+        self._reply(*self._route())
+
+    def do_HEAD(self):
+        self._reply(*self._route(), head=True)
+
+    def _reply(self, code, ctype, body, head=False):
         self.send_response(code)
         self.send_header('Content-Type', ctype)
         self.send_header('Content-Length', str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        if not head:
+            self.wfile.write(body)
 
     def log_message(self, fmt, *args):
         pass  # scrapes every few seconds must not spam stderr
@@ -68,9 +93,14 @@ class MetricsServer:
     exit never hangs on an open scrape socket.
     """
 
-    def __init__(self, registry=None, host='127.0.0.1', port=0):
+    def __init__(self, registry=None, host='127.0.0.1', port=0,
+                 tracer=None):
         self.registry = registry if registry is not None \
             else default_registry()
+        if tracer is None:
+            from .tracing import default_tracer
+            tracer = default_tracer()
+        self.tracer = tracer
         self._host = host
         self._port = int(port)
         self._srv = None
@@ -81,6 +111,7 @@ class MetricsServer:
             return self
         self._srv = _HTTPServer((self._host, self._port), _Handler)
         self._srv.registry = self.registry
+        self._srv.tracer = self.tracer
         self._srv.started = time.monotonic()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name='metrics-server', daemon=True)

@@ -11,7 +11,8 @@ from . import export
 from .registry import MetricRegistry
 from .runtime import RuntimeSampler
 
-__all__ = ['record_dryrun_step', 'record_serving_schema', 'snapshot_line',
+__all__ = ['record_dryrun_step', 'record_serving_schema',
+           'record_tracing_schema', 'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -65,12 +66,24 @@ def record_serving_schema(registry):
     return out
 
 
+def record_tracing_schema(registry):
+    """Register the span-tracer health families (spans started /
+    finished / dropped, flight dumps, exemplar count) on `registry` —
+    the tracing block of the dryrun snapshot. Same single-source rule:
+    tracers and the schema baseline both go through
+    tracing.register_metrics."""
+    from . import tracing
+    return tracing.register_metrics(registry)
+
+
 def dryrun_registry(step_seconds, loss, batch=None):
     """Fresh per-config registry holding the full dryrun telemetry
-    schema: training gauges + serving families + one runtime sample."""
+    schema: training gauges + serving + tracing families + one runtime
+    sample."""
     reg = MetricRegistry()
     record_dryrun_step(reg, step_seconds, loss, batch=batch)
     record_serving_schema(reg)
+    record_tracing_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -10,25 +10,38 @@ import time
 
 import jax
 
+from ..monitor import tracing as _tracing
+
 __all__ = ['RecordEvent', 'profiler', 'start_profiler', 'stop_profiler',
            'Profiler', 'ProfilerTarget', 'ProfilerState',
            'export_chrome_tracing', 'load_profiler_result', 'merge_traces']
 
 
 class RecordEvent:
-    """RAII trace annotation (platform/profiler.h:127 parity)."""
+    """RAII trace annotation (platform/profiler.h:127 parity).
+
+    Dual-sink: the name lands in the device trace as a
+    jax.profiler.TraceAnnotation AND in the host tracer as a span, so
+    the same region shows up in Perfetto next to XLA ops and in the
+    flight recorder / /debug/traces view."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
+        self._span = None
 
     def __enter__(self):
+        self._span = _tracing.default_tracer().start_span(self.name)
+        self._span.__enter__()
         self._ctx = jax.profiler.TraceAnnotation(self.name)
         self._ctx.__enter__()
         return self
 
     def __exit__(self, *exc):
         self._ctx.__exit__(*exc)
+        if self._span is not None:
+            self._span.__exit__(*(exc or (None, None, None)))
+            self._span = None
         return False
 
     def begin(self):
@@ -43,14 +56,19 @@ _active_dir = [None]
 
 def start_profiler(state='All', tracer_option='Default',
                    log_dir='/tmp/paddle_tpu_profile'):
-    _active_dir[0] = log_dir
+    # mark active only AFTER start_trace succeeds, so a failed start
+    # (bad dir, trace already running) leaves no stale state behind and
+    # the paired stop_profiler stays a no-op
     jax.profiler.start_trace(log_dir)
+    _active_dir[0] = log_dir
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
-    if _active_dir[0] is not None:
-        jax.profiler.stop_trace()
-        _active_dir[0] = None
+    """Idempotent: safe to call repeatedly, or without a start."""
+    if _active_dir[0] is None:
+        return
+    _active_dir[0] = None
+    jax.profiler.stop_trace()
 
 
 @contextlib.contextmanager
@@ -92,6 +110,7 @@ class Profiler:
         self._on_trace_ready = on_trace_ready
         self._times = []
         self._t0 = None
+        self._tracing = False     # a device trace is actually running
 
     def __enter__(self):
         self.start()
@@ -109,9 +128,14 @@ class Profiler:
             if self._on_trace_ready is not None:
                 self._on_trace_ready(self)
             jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
 
     def stop(self):
-        if not self.timer_only:
+        # only stop a trace this profiler actually started: stop()
+        # without start(), after a failed start(), or called twice must
+        # not raise (and must not kill someone else's trace)
+        if self._tracing:
+            self._tracing = False
             jax.profiler.stop_trace()
 
     def step(self, num_samples=None):

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from ..framework import functional as _fm
 from ..framework.core import Tensor, no_grad_guard
+from ..monitor import tracing as _tracing
 from ..text.models.gpt import GPTSlotCache
 from .kv_cache import SlotAllocator, build_slot_caches
 from .metrics import ServingMetrics
@@ -106,6 +107,9 @@ class _EngineBase:
         self._requests = {}                           # slot -> Request
         self._lock = threading.RLock()
         self._closed = False
+        # cached at construction (like the registry): swap the default
+        # tracer BEFORE building the engine under test
+        self._tracer = _tracing.default_tracer()
         self.trace_counts = {k: 0 for k in self._programs}
         # scrape-visible retrace canary: flat at 1 per program == the
         # bounded-compilation contract holds in production, not just
@@ -134,6 +138,15 @@ class _EngineBase:
             self._validate(req)
             self.scheduler.submit(req)
             self.metrics.on_arrival(req.id)
+            tr = self._tracer
+            if tr.enabled:
+                req._span = tr.start_span(
+                    'serving.request',
+                    tags={'request_id': req.id,
+                          'prompt_len': len(req.prompt),
+                          'max_new_tokens': req.max_new_tokens})
+                req._span.add_event('queued',
+                                    queue_depth=len(self.scheduler.queue))
         return req
 
     def _validate(self, req):
@@ -206,6 +219,11 @@ class _EngineBase:
     def _admit(self):
         for slot, req in self.scheduler.admit():
             self.metrics.on_admitted(req.id)
+            if req._span is not None:
+                req._span.add_event('admitted', slot=slot)
+                req._phase = self._tracer.start_span(
+                    'serving.prefill', parent=req._span,
+                    tags={'slot': slot})
             self._requests[slot] = req
             self._budgets[slot] = req.max_new_tokens
             self._temps[slot] = req.temperature
@@ -225,6 +243,18 @@ class _EngineBase:
     def _on_step_metrics(self):
         """Subclass hook: extra per-step gauges (lock held)."""
 
+    def _trace_prefill(self, req, start, valid, final):
+        """Annotate the request's prefill phase span with one chunk; the
+        final chunk closes it and opens the decode phase (lock held)."""
+        if req._phase is None:
+            return
+        req._phase.add_event('prefill_chunk', start=start, valid=valid)
+        if final:
+            req._phase.finish()
+            req._phase = self._tracer.start_span(
+                'serving.decode', parent=req._span,
+                tags={'slot': req.slot})
+
     def _emit(self, req, tokens):
         if not tokens:
             return
@@ -232,7 +262,9 @@ class _EngineBase:
         if req._stream_q is not None:
             for t in tokens:
                 req._stream_q.put(t)
-        self.metrics.on_tokens(req.id, len(tokens))
+        self.metrics.on_tokens(
+            req.id, len(tokens),
+            trace_id=None if req._span is None else req._span.trace_id)
 
     def _retire(self, req):
         slot = req.slot
@@ -240,6 +272,13 @@ class _EngineBase:
         del self._requests[slot]
         self.scheduler.retire(req)
         self.metrics.on_retired(req.id)
+        if req._phase is not None:
+            req._phase.finish()
+            req._phase = None
+        if req._span is not None:
+            req._span.set_tag('tokens', len(req.tokens))
+            req._span.add_event('retired')
+            req._span.finish()
 
 
 class ContinuousBatchingEngine(_EngineBase):
@@ -357,6 +396,7 @@ class ContinuousBatchingEngine(_EngineBase):
                 np.asarray(req.do_sample))
             self.metrics.on_prefill_tokens(valid)
             self.scheduler.mark_prefilled(req, start + valid)
+            self._trace_prefill(req, start, valid, final)
             if not final:
                 continue
             tok = int(tok)
@@ -372,13 +412,18 @@ class ContinuousBatchingEngine(_EngineBase):
         slots = self.scheduler.decode_slots()
         if not slots:
             return
-        (self._caches, last, gen, keys, toks,
-         actives) = self._decode_jit(
-            self._params, self._bufs, self._caches, self._last, self._gen,
-            self._budgets, self._active, self._keys, self._temps,
-            self._topks, self._sample)
-        last, gen, keys, toks, actives = jax.device_get(
-            (last, gen, keys, toks, actives))
+        # span covers dispatch AND the device_get sync — the burst's
+        # actual wall time, not just the async enqueue
+        with self._tracer.start_span('serving.decode_burst',
+                                     tags={'rows': len(slots),
+                                           'block': self.decode_block}):
+            (self._caches, last, gen, keys, toks,
+             actives) = self._decode_jit(
+                self._params, self._bufs, self._caches, self._last,
+                self._gen, self._budgets, self._active, self._keys,
+                self._temps, self._topks, self._sample)
+            last, gen, keys, toks, actives = jax.device_get(
+                (last, gen, keys, toks, actives))
         # device_get can hand back read-only views; these three are
         # mutated in place at prefill/retire
         self._last = np.array(last)

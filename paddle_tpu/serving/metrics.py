@@ -18,6 +18,7 @@ Two outputs from the same events:
 import time
 
 from ..monitor import exponential_buckets
+from ..monitor import tracing as _tracing
 from ..monitor.registry import default_registry
 from ..monitor.telemetry import record_serving_schema
 
@@ -90,6 +91,8 @@ class ServingMetrics:
         self._m_prefix_misses = paged['serving_prefix_cache_misses_total']
         self._m_spec_proposed = paged['serving_spec_tokens_proposed_total']
         self._m_spec_accepted = paged['serving_spec_tokens_accepted_total']
+        self._m_exemplars = _tracing.register_metrics(
+            r)['trace_exemplars_total']
         self._prefill_tokens = 0
         self._prefix_hits = 0
         self._prefix_misses = 0
@@ -116,13 +119,17 @@ class ServingMetrics:
     def on_queue_depth(self, depth):
         self._m_queue.set(depth)
 
-    def on_tokens(self, rid, count, t=None):
+    def on_tokens(self, rid, count, t=None, trace_id=None):
         """`count` tokens became visible for request rid at time t.
 
         Decode runs in bursts of K steps per dispatch, so K tokens land
         at once; the burst's gap is spread over its tokens — the honest
         accounting, since a consumer reading the stream experiences the
         burst wait once per K tokens.
+
+        A non-None trace_id rides the TTFT / inter-token histogram
+        observations as an exemplar, so an outlier bucket in a scrape
+        links back to the trace that produced it.
         """
         if count <= 0:
             return
@@ -132,12 +139,17 @@ class ServingMetrics:
             self._first_token[rid] = t
             prev = self._arrival.get(rid, t)
             if rid in self._arrival:
-                self._m_ttft.observe(t - self._arrival[rid])
+                self._m_ttft.observe(t - self._arrival[rid],
+                                     exemplar=trace_id)
+                if trace_id is not None:
+                    self._m_exemplars.inc()
         if prev is not None:
             gap = (t - prev) / count
             self._gaps.extend([gap] * count)
             for _ in range(count):
-                self._m_gap.observe(gap)
+                self._m_gap.observe(gap, exemplar=trace_id)
+            if trace_id is not None:
+                self._m_exemplars.inc(count)
         self._last_token[rid] = t
         self._tokens += count
         self._m_tokens.inc(count)

@@ -155,6 +155,9 @@ class PagedContinuousBatchingEngine(_EngineBase):
         # a prefix hit means rows [0, hit) are already valid shared
         # pages: the row's length starts there, not at zero
         self._lens[slot] = req._consumed
+        if req._prefix_hit and req._span is not None:
+            req._span.add_event('prefix_cache_hit',
+                                tokens=req._prefix_hit)
 
     def _on_step_metrics(self):
         self.metrics.on_pages_in_use(self.pages.in_use)
@@ -259,6 +262,7 @@ class PagedContinuousBatchingEngine(_EngineBase):
             self.metrics.on_prefill_tokens(valid)
             self._lens[slot] = start + valid
             self.scheduler.mark_prefilled(req, start + valid)
+            self._trace_prefill(req, start, valid, final)
             if not final:
                 continue
             tok = int(tok)
@@ -276,14 +280,19 @@ class PagedContinuousBatchingEngine(_EngineBase):
             return
         if self.spec_k:
             return self._spec_step(slots)
-        (self._pools, lens, last, gen, keys, toks,
-         actives) = self._decode_jit(
-            self._params, self._bufs, self._pools,
-            self.scheduler.block_tables, self._lens, self._last,
-            self._gen, self._budgets, self._active, self._keys,
-            self._temps, self._topks, self._sample)
-        lens, last, gen, keys, toks, actives = jax.device_get(
-            (lens, last, gen, keys, toks, actives))
+        # span covers dispatch AND the device_get sync — the burst's
+        # actual wall time, not just the async enqueue
+        with self._tracer.start_span('serving.decode_burst',
+                                     tags={'rows': len(slots),
+                                           'block': self.decode_block}):
+            (self._pools, lens, last, gen, keys, toks,
+             actives) = self._decode_jit(
+                self._params, self._bufs, self._pools,
+                self.scheduler.block_tables, self._lens, self._last,
+                self._gen, self._budgets, self._active, self._keys,
+                self._temps, self._topks, self._sample)
+            lens, last, gen, keys, toks, actives = jax.device_get(
+                (lens, last, gen, keys, toks, actives))
         self._lens = np.array(lens)
         self._last = np.array(last)
         self._gen = np.array(gen)
@@ -312,10 +321,13 @@ class PagedContinuousBatchingEngine(_EngineBase):
             drafts[slot] = d
             toks[slot, 0] = self._last[slot, 0]
             toks[slot, 1:] = d
-        self._pools, picks = self._verify_jit(
-            self._params, self._bufs, self._pools,
-            self.scheduler.block_tables, self._lens, toks)
-        picks = np.asarray(jax.device_get(picks))
+        with self._tracer.start_span('serving.decode_burst',
+                                     tags={'rows': len(slots),
+                                           'spec_k': K}):
+            self._pools, picks = self._verify_jit(
+                self._params, self._bufs, self._pools,
+                self.scheduler.block_tables, self._lens, toks)
+            picks = np.asarray(jax.device_get(picks))
         for slot in slots:
             req = self._requests[slot]
             d, g = drafts[slot], picks[slot]
@@ -328,6 +340,9 @@ class PagedContinuousBatchingEngine(_EngineBase):
             left = int(self._budgets[slot]) - int(self._gen[slot])
             emit = [int(x) for x in g[:min(a + 1, left)]]
             self.metrics.on_spec(K, max(len(emit) - 1, 0))
+            if req._span is not None:
+                req._span.add_event('spec_accept', proposed=K,
+                                    accepted=max(len(emit) - 1, 0))
             self._lens[slot] += len(emit)
             self._gen[slot] += len(emit)
             self._last[slot, 0] = emit[-1]

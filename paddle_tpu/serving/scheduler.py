@@ -59,6 +59,8 @@ class Request:
         self._prefix_hit = 0      # prompt tokens served by the prefix
         #                           cache (paged engine; 0 elsewhere)
         self._published = 0       # prompt blocks already in the cache
+        self._span = None         # 'serving.request' lifecycle span
+        self._phase = None        # current prefill/decode child span
         self._finished = threading.Event()
         # engine.stream() consumers read tokens from here; None until the
         # first stream() call so non-streamed requests pay nothing

@@ -21,6 +21,7 @@ import socket
 import threading
 
 from ..distributed import resilience
+from ..monitor import tracing as _tracing
 
 __all__ = ['inject', 'drop_connections', 'delay_connections',
            'fail_after', 'kill_server', 'truncate_file', 'active_faults']
@@ -64,6 +65,10 @@ class _Fault:
             if self._times is not None and self.fired >= self._times:
                 return
             self.fired += 1
+        # annotate the current span (the rpc.attempt in flight) and give
+        # the flight recorder a chance to dump, BEFORE the action fires —
+        # the action usually raises
+        _tracing.note_fault(point, endpoint)
         self._action(point, endpoint)
 
 

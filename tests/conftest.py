@@ -22,6 +22,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         'markers', 'slow: scale/perf datapoints excluded from the tier-1 '
         "run (-m 'not slow')")
+    config.addinivalue_line(
+        'markers', 'chaos: fault-injection tests (testing/chaos.py) that '
+        'exercise failure paths against live loopback servers')
 
 
 @pytest.fixture

@@ -202,6 +202,14 @@ def test_metrics_server_scrape_and_healthz():
             srv.url + '/metrics.json', timeout=5).read().decode())
         assert snap['pings_total']['samples'][0]['value'] == 7.0
 
+        # HEAD (load-balancer probes) must get 200 + headers, not 501
+        for path in ('/healthz', '/metrics'):
+            req = urllib.request.Request(srv.url + path, method='HEAD')
+            resp = urllib.request.urlopen(req, timeout=5)
+            assert resp.status == 200
+            assert int(resp.headers['Content-Length']) > 0
+            assert resp.read() == b''
+
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(srv.url + '/nope', timeout=5)
     with pytest.raises(RuntimeError):

@@ -127,6 +127,8 @@ def main(argv=None):
     if not findings:
         print(json.dumps({'regressions': 0, 'metrics_seen': len(union),
                           'configs': sorted(per_tag),
+                          'tracing_families': sum(
+                              1 for n in union if n.startswith('trace_')),
                           'new_unbaselined': extra, 'ok': True}))
         return 0
     return 1

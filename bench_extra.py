@@ -498,6 +498,102 @@ def bench_serving_paged(on_tpu):
     return rows
 
 
+def bench_serving_gateway(on_tpu):
+    """Multi-replica gateway rung: the Poisson-arrival chaos workload
+    from ISSUE 8 — a 2-replica ServingGateway under the bench_serving
+    arrival trace, measured clean and with one replica killed mid-burst.
+
+    Rows (keyed by replicas/kill_at/policy for the regression gate): the
+    clean gateway tok/s, the chaos-run tok/s (kill at 50% of
+    submissions, failover count as a field), and the chaos completed
+    ratio — the acceptance number, which must stay 1.0: every request
+    finishes even though half the pool died mid-run. Exact-token parity
+    of failed-over requests is asserted in
+    tests/test_serving_gateway.py, so the throughput is not bought with
+    drift or drops.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import ContinuousBatchingEngine, ServingGateway
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        lens, mnt, n_req = (32, 64, 96, 128), 64, 32
+        max_len, chunk, block, num_slots = 256, 32, 8, 8
+        mean_gap = 0.02
+    else:
+        # same service-bound regime as bench_serving's CPU branch
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        lens, mnt, n_req = (8, 16, 24, 32), 32, 24
+        max_len, chunk, block, num_slots = 64, 32, 8, 8
+        mean_gap = 0.002
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                            lens[i % len(lens)])]
+               for i in range(n_req)]
+    arrivals = _poisson_arrivals(n_req, mean_gap)
+    replicas, kill_frac = 2, 0.5
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=num_slots, max_len=max_len,
+            prefill_chunk=chunk, decode_block=block)
+
+    def drive(kill_at):
+        reg = MetricRegistry()
+        gw = ServingGateway(factory, replicas=replicas, registry=reg)
+        gw.generate(prompts[:replicas], max_new_tokens=2)     # compile
+        gw.start()
+        kill_i = None if kill_at is None else int(n_req * kill_at)
+        reqs = []
+        t0 = time.time()
+        for i, (p, arr) in enumerate(zip(prompts, arrivals)):
+            now = time.time() - t0
+            if arr > now:
+                time.sleep(arr - now)
+            if kill_i is not None and i == kill_i:
+                gw.kill_replica(1)
+            reqs.append(gw.submit(p, max_new_tokens=mnt))
+        for r in reqs:
+            r.wait(600)
+        dt = time.time() - t0
+        gw.shutdown()
+        toks = sum(len(r.tokens) for r in reqs)
+        completed = sum(1 for r in reqs if r.done)
+        failovers = int(reg.get('gateway_failover_total').value())
+        return (toks / dt, completed / float(len(reqs)), failovers,
+                gw.report())
+
+    base = {'unit': 'tokens/sec', 'trace': 'poisson',
+            'mean_gap_s': mean_gap, 'requests': n_req, 'new_tokens': mnt,
+            'num_slots': num_slots, 'replicas': replicas,
+            'policy': 'least_loaded', 'degraded': not on_tpu}
+    rows = []
+    tps, ratio, fo, rep = drive(None)
+    rows.append(dict(base, metric='serving_gateway_tokens_per_sec',
+                     value=round(tps, 2), kill_at='none', failovers=fo,
+                     completed_ratio=round(ratio, 4)))
+    tps, ratio, fo, rep = drive(kill_frac)
+    rows.append(dict(base, metric='serving_gateway_tokens_per_sec_chaos',
+                     value=round(tps, 2), kill_at=kill_frac, failovers=fo,
+                     completed_ratio=round(ratio, 4),
+                     replicas_alive=rep['replicas_alive']))
+    rows.append(dict(base, metric='serving_gateway_completed_ratio',
+                     value=round(ratio, 4), unit='ratio',
+                     kill_at=kill_frac, failovers=fo))
+    return rows
+
+
 def main():
     try:
         _enable_cache()
@@ -505,7 +601,7 @@ def main():
         pass
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
-               bench_serving, bench_serving_paged):
+               bench_serving, bench_serving_paged, bench_serving_gateway):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

@@ -30,7 +30,7 @@ from ..monitor import tracing as _tracing
 
 __all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
            'RpcError', 'RetryableError', 'DeadlineExceeded',
-           'CircuitOpenError', 'DEFAULT_CALL_TIMEOUT',
+           'CircuitOpenError', 'fire_fault_points', 'DEFAULT_CALL_TIMEOUT',
            'DEFAULT_CONNECT_TIMEOUT']
 
 DEFAULT_CALL_TIMEOUT = 30.0      # per-attempt send+recv budget (seconds)
@@ -76,6 +76,17 @@ _FAULT_HOOKS = []
 def _fire(point, endpoint):
     for hook in list(_FAULT_HOOKS):
         hook(point, endpoint)
+
+
+def fire_fault_points(point, endpoint):
+    """Public hook-point trigger for subsystems that are not socket
+    channels but still carry requests worth chaos-testing. The serving
+    gateway's in-proc replicas fire 'send' before a submission and
+    'recv' after each engine step, so chaos injectors (partition /
+    drop_connections scoped to the replica's endpoint string) apply to
+    them exactly as they do to a ResilientChannel: a partitioned replica
+    can neither accept new work nor deliver tokens."""
+    _fire(point, endpoint)
 
 
 # -- error taxonomy ---------------------------------------------------------

@@ -395,6 +395,23 @@ class Predictor:
             layer, num_slots=num_slots, max_len=max_len,
             prefill_chunk=prefill_chunk, decode_block=decode_block)
 
+    def decode_gateway(self, replicas=2, router=None, autoscaler=None,
+                       registry=None, **engine_kwargs):
+        """Multi-replica serving front door: a ServingGateway whose
+        replica factory clones this predictor's artifact into fresh
+        decode engines (the reference's fleet-of-AnalysisPredictors
+        deployment shape, in one process). Engine construction kwargs
+        — num_slots, max_len, paged=True, page_size, ... — pass through
+        to decode_engine() per replica."""
+        # non-causal-LM artifacts fail in the first factory call (the
+        # gateway builds its initial replicas eagerly), with
+        # decode_engine()'s clear TypeError
+        from ..serving import ServingGateway
+        return ServingGateway(
+            lambda: self.decode_engine(**engine_kwargs),
+            replicas=replicas, router=router, autoscaler=autoscaler,
+            registry=registry)
+
     def clear_intermediate_tensor(self):
         self._outputs = {}
 

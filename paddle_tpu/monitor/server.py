@@ -34,11 +34,20 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return (200, CONTENT_TYPE,
                     export.to_prometheus(self.server.registry).encode())
         if path in ('/healthz', '/health'):
+            # liveness only: the process is up and serving scrapes. A
+            # draining replica stays live (kubelet must not restart it)
+            # even though /readyz says to stop routing to it.
             body = json.dumps({
                 'status': 'ok',
                 'uptime_s': round(time.monotonic() - self.server.started,
                                   3)}).encode()
             return 200, 'application/json', body
+        if path == '/readyz':
+            check = getattr(self.server, 'readiness', None)
+            ready = True if check is None else bool(check())
+            body = json.dumps({
+                'status': 'ready' if ready else 'draining'}).encode()
+            return (200 if ready else 503), 'application/json', body
         if path == '/metrics.json':
             return (200, 'application/json',
                     export.to_json(self.server.registry).encode())
@@ -94,13 +103,18 @@ class MetricsServer:
     """
 
     def __init__(self, registry=None, host='127.0.0.1', port=0,
-                 tracer=None):
+                 tracer=None, readiness=None):
         self.registry = registry if registry is not None \
             else default_registry()
         if tracer is None:
             from .tracing import default_tracer
             tracer = default_tracer()
         self.tracer = tracer
+        # /readyz: liveness (/healthz) says "don't restart me", readiness
+        # says "route to me". None = always ready; otherwise a zero-arg
+        # callable — e.g. a gateway replica's `.ready` — evaluated per
+        # probe so a drain flips the route to 503 without a restart.
+        self.readiness = readiness
         self._host = host
         self._port = int(port)
         self._srv = None
@@ -112,6 +126,7 @@ class MetricsServer:
         self._srv = _HTTPServer((self._host, self._port), _Handler)
         self._srv.registry = self.registry
         self._srv.tracer = self.tracer
+        self._srv.readiness = self.readiness
         self._srv.started = time.monotonic()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name='metrics-server', daemon=True)

@@ -12,8 +12,8 @@ from .registry import MetricRegistry
 from .runtime import RuntimeSampler
 
 __all__ = ['record_dryrun_step', 'record_serving_schema',
-           'record_tracing_schema', 'snapshot_line',
-           'parse_snapshot_lines', 'LINE_RE']
+           'record_gateway_schema', 'record_tracing_schema',
+           'snapshot_line', 'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
                      r'\[(?P<tag>[^\]]*)\]:\s*(?P<json>\{.*\})\s*$')
@@ -66,6 +66,57 @@ def record_serving_schema(registry):
     return out
 
 
+# the multi-replica gateway's families (serving/gateway/). Same
+# single-source rule: the ServingGateway and the schema baseline both
+# register through record_gateway_schema. (kind, name, help, labels) —
+# labeled families appear in snapshots on registration alone (schema_of
+# lists the family even with zero children), so the gate covers them
+# without a gateway run. Label budgets (docs/observability.md): replica
+# is bounded by max_replicas (<= 8 by default), direction is {up, down}.
+GATEWAY_FAMILIES = (
+    ('counter', 'gateway_requests_total',
+     'requests accepted at the gateway front door', ()),
+    ('counter', 'gateway_requests_completed_total',
+     'requests fully delivered to the caller', ()),
+    ('counter', 'gateway_tokens_total',
+     'tokens delivered to callers across all replicas', ()),
+    ('counter', 'gateway_route_total',
+     'routing decisions per replica', ('replica',)),
+    ('counter', 'gateway_retries_total',
+     'submissions retried on another replica after a transport error',
+     ()),
+    ('counter', 'gateway_failover_total',
+     'in-flight requests re-admitted after a replica loss', ()),
+    ('counter', 'gateway_scale_events_total',
+     'autoscaler actions taken', ('direction',)),
+    ('gauge', 'gateway_replicas',
+     'replicas currently alive (ready or draining)', ()),
+    ('gauge', 'gateway_replica_state',
+     'per-replica state (0=ready 1=draining 2=dead 3=stopped)',
+     ('replica',)),
+    ('gauge', 'gateway_queue_depth',
+     'requests parked at the gateway awaiting a routable replica', ()),
+    ('gauge', 'gateway_slo_burn_rate',
+     'fraction of windowed TTFT samples over the SLO', ()),
+    ('histogram', 'gateway_ttft_seconds',
+     'time from gateway submission to first delivered token', ()),
+)
+
+
+def record_gateway_schema(registry):
+    """Register the gateway metric families on `registry` and return
+    {name: family}. Used by ServingGateway at construction and by
+    dryrun_registry so the committed baseline covers the gateway."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in GATEWAY_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            kw['buckets'] = exponential_buckets(0.002, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 def record_tracing_schema(registry):
     """Register the span-tracer health families (spans started /
     finished / dropped, flight dumps, exemplar count) on `registry` —
@@ -83,6 +134,7 @@ def dryrun_registry(step_seconds, loss, batch=None):
     reg = MetricRegistry()
     record_dryrun_step(reg, step_seconds, loss, batch=batch)
     record_serving_schema(reg)
+    record_gateway_schema(reg)
     record_tracing_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg

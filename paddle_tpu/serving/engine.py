@@ -129,6 +129,18 @@ class _EngineBase:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       do_sample=do_sample, seed=seed)
+        # front-door guard, shared by BOTH engines (the paged subclass
+        # overrides _validate without chaining): a request whose worst
+        # case — prompt plus every generated token but the last — cannot
+        # fit the cache would sit at the queue head forever, wedging
+        # admission for everyone behind it. Fail loud at submission.
+        worst = len(req.prompt) + req.max_new_tokens - 1
+        if len(req.prompt) and worst > self.max_len:
+            raise ValueError(
+                'request cannot ever be admitted: prompt of %d tokens + '
+                'max_new_tokens=%d needs %d cache rows but max_len=%d'
+                % (len(req.prompt), req.max_new_tokens, worst,
+                   self.max_len))
         if stream:
             req._stream_q = _queue.Queue()
         with self._lock:

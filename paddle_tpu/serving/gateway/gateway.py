@@ -1,0 +1,440 @@
+"""The gateway: one front door over a pool of engine replicas.
+
+    gw = ServingGateway(lambda: ContinuousBatchingEngine(model, ...),
+                        replicas=2)
+    req = gw.submit(prompt, max_new_tokens=32)
+    gw.run()                       # or gw.start() for driver threads
+    req.tokens                     # identical to a single engine's output
+
+Three jobs, one lock:
+
+- **Routing.** submit() walks the router's ranked candidates and places
+  the request on the first replica whose transport accepts; when none is
+  routable the request parks in the gateway queue and is drained on the
+  next step. Routing emits a `gateway.route` span and per-replica
+  `gateway_route_total` counts.
+- **Failover.** A replica lost mid-flight (chaos partition, driver
+  exception, kill_replica) has every non-finished assigned request
+  re-submitted elsewhere — full prompt, same seed. Engines are
+  deterministic for a fixed (prompt, sampling, seed), so the new replica
+  regenerates the identical token stream, and the gateway's
+  delivered-token ledger (`GatewayRequest.tokens`) forwards only the
+  suffix the caller has not seen: exactly-once delivery with
+  exact-token parity, no idempotency tokens needed. The breaker opens
+  on the loss, so the router never offers the dead replica again.
+- **Autoscaling.** autoscale_tick() feeds the pure AutoscalePolicy the
+  windowed TTFT SLO burn rate plus pool occupancy/queue depth and
+  applies the Decision: +1 builds a replica from the engine factory,
+  -1 drains the least-loaded READY replica (drain, never kill — its
+  in-flight work finishes).
+
+Locking: one gateway RLock guards pool membership, assignment maps,
+the pending queue, and delivery; replica driver threads call back into
+_collect/_on_lost which take it. Order is strictly gateway lock ->
+engine lock (replica.submit/step run under the gateway lock only in
+sync mode; drivers call them lock-free and only take the gateway lock
+inside the callbacks), and the replica condvar is never held across a
+callback.
+"""
+import collections
+import itertools
+import queue as _queue
+import threading
+import time
+
+from ...monitor import tracing as _tracing
+from ...monitor.registry import default_registry
+from ...monitor.telemetry import record_gateway_schema
+from .autoscaler import slo_burn_rate
+from .replica import DRAINING, READY, STATE_CODES, InprocReplica
+from .router import LeastLoadedRouter
+
+__all__ = ['ServingGateway', 'GatewayRequest']
+
+_gw_ids = itertools.count()
+
+
+class GatewayRequest:
+    """Caller-facing handle: the delivered-token ledger.
+
+    `tokens` holds only what the gateway has handed to the caller —
+    after a failover the replacement replica regenerates from scratch
+    and the gateway forwards `engine_tokens[len(self.tokens):]`, so the
+    caller never sees a duplicate or a gap. `replica_history` records
+    every placement (length > 1 == the request survived a failover).
+    """
+
+    def __init__(self, prompt, sampling, stream=False):
+        self.id = next(_gw_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.sampling = dict(sampling)
+        self.tokens = []
+        self.replica_history = []
+        self.arrival_t = None
+        self.error = None        # set iff rejected after being accepted
+        self._stream_q = _queue.Queue() if stream else None
+        self._finished = threading.Event()
+
+    @property
+    def done(self):
+        return self._finished.is_set()
+
+    def wait(self, timeout=None):
+        return self._finished.wait(timeout)
+
+    def stream(self):
+        """Yield tokens as the gateway delivers them (requires
+        submit(..., stream=True) and a start()ed gateway)."""
+        if self._stream_q is None:
+            raise ValueError('request was not submitted with stream=True')
+        while True:
+            tok = self._stream_q.get()
+            if tok is None:
+                return
+            yield tok
+
+    def __repr__(self):
+        return ('GatewayRequest(id=%d, delivered=%d/%d, replicas=%s)'
+                % (self.id, len(self.tokens),
+                   self.sampling.get('max_new_tokens', 0),
+                   self.replica_history))
+
+
+class ServingGateway:
+
+    def __init__(self, engine_factory, replicas=2, router=None,
+                 autoscaler=None, registry=None, clock=None):
+        if replicas < 1:
+            raise ValueError('need at least one replica')
+        self._factory = engine_factory
+        self._clock = clock or time.monotonic
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.router = router if router is not None else LeastLoadedRouter()
+        self.policy = autoscaler
+        self._lock = threading.RLock()
+        self._tracer = _tracing.default_tracer()
+        fams = record_gateway_schema(self.registry)
+        self._m_requests = fams['gateway_requests_total']
+        self._m_completed = fams['gateway_requests_completed_total']
+        self._m_tokens = fams['gateway_tokens_total']
+        self._m_route = fams['gateway_route_total']
+        self._m_retries = fams['gateway_retries_total']
+        self._m_failover = fams['gateway_failover_total']
+        self._m_scale = fams['gateway_scale_events_total']
+        self._m_replicas = fams['gateway_replicas']
+        self._m_state = fams['gateway_replica_state']
+        self._m_queue = fams['gateway_queue_depth']
+        self._m_burn = fams['gateway_slo_burn_rate']
+        self._m_ttft = fams['gateway_ttft_seconds']
+        self.pool = []                      # never shrinks; index == id
+        self._pending = collections.deque()
+        self._ttfts = collections.deque(maxlen=4096)   # (t, ttft_s)
+        self.failover_log = []
+        self._started = False
+        with self._lock:
+            for _ in range(int(replicas)):
+                self._add_replica_locked()
+
+    # ---- front door ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens=32, stream=False, **sampling):
+        """Accept one request; returns the GatewayRequest handle.
+        Raises ValueError for requests no replica could EVER admit (the
+        engines' front-door guard) — those must fail the caller, not
+        trip failover."""
+        sampling = dict(sampling, max_new_tokens=max_new_tokens)
+        gw = GatewayRequest(prompt, sampling, stream=stream)
+        with self._lock:
+            gw.arrival_t = self._clock()
+            routed = self._route_locked(gw)   # ValueError -> not accepted
+            self._m_requests.inc()
+            if not routed:
+                self._pending.append(gw)
+            self._m_queue.set(len(self._pending))
+        return gw
+
+    def generate(self, prompts, **sampling):
+        """Blocking batch door, mirroring the engines' generate()."""
+        reqs = [self.submit(p, **sampling) for p in prompts]
+        if self._started:
+            for r in reqs:
+                r.wait()
+        else:
+            self.run()
+        return [r.tokens for r in reqs]
+
+    # ---- routing ------------------------------------------------------
+
+    def _route_locked(self, gw):
+        """Place gw on the first accepting candidate; False if none.
+        A transport failure during placement counts as a retry AND a
+        replica loss (in-proc transports don't blip — see replica.py),
+        so one walk both fails over the dead replica's in-flight work
+        and still places gw if anyone is left."""
+        with self._tracer.start_span(
+                'gateway.route', tags={'request_id': gw.id}) as span:
+            for rep in self.router.candidates(self.pool):
+                if not rep.routable():     # lost earlier in this walk
+                    continue
+                try:
+                    eng_req = rep.submit(gw.prompt, **gw.sampling)
+                except ValueError:
+                    raise                  # inadmissible — caller's error
+                except Exception as exc:   # noqa: BLE001 — transport
+                    self._m_retries.inc()
+                    self._lost_locked(rep, exc)
+                    continue
+                rep.breaker.record_success()
+                rep.assigned[gw] = eng_req
+                gw.replica_history.append(rep.index)
+                self._m_route.labels(str(rep.index)).inc()
+                span.set_tag('replica', rep.index)
+                rep.wake()
+                return True
+            span.set_tag('replica', -1)
+            return False
+
+    def _drain_pending_locked(self):
+        while self._pending:
+            gw = self._pending.popleft()
+            try:
+                routed = self._route_locked(gw)
+            except ValueError as exc:
+                # a request parked while NO replica was routable turns
+                # out inadmissible once one is: fail it out-of-band (the
+                # submit() caller is long gone) instead of crashing the
+                # driver thread that happened to drain the queue
+                gw.error = exc
+                if gw._stream_q is not None:
+                    gw._stream_q.put(None)
+                gw._finished.set()
+                continue
+            if not routed:
+                self._pending.appendleft(gw)
+                break
+        self._m_queue.set(len(self._pending))
+
+    # ---- failover -----------------------------------------------------
+
+    def _lost_locked(self, rep, exc):
+        """rep's transport failed: open its breaker, mark it dead, and
+        re-admit every in-flight request elsewhere. Idempotent per
+        replica (drivers and routing walks may both observe the loss)."""
+        if not rep.alive:
+            return
+        opened = rep.breaker.record_failure()
+        rep.mark_dead()
+        victims = []
+        for gw in list(rep.assigned):
+            if len(gw.tokens) >= gw.sampling['max_new_tokens']:
+                self._complete_locked(gw)   # fully delivered already
+            else:
+                victims.append(gw)
+        rep.assigned.clear()
+        self.failover_log.append({
+            'replica': rep.index, 'error': repr(exc),
+            'requests': [g.id for g in victims]})
+        with self._tracer.start_span(
+                'gateway.failover',
+                tags={'from_replica': rep.index,
+                      'requests': len(victims),
+                      'breaker_opened': bool(opened)}):
+            for gw in victims:
+                self._m_failover.inc()
+                if not self._route_locked(gw):
+                    self._pending.append(gw)
+        self._m_queue.set(len(self._pending))
+        self._refresh_gauges_locked()
+
+    def kill_replica(self, index):
+        """Declare replica `index` lost (the non-chaos failover door —
+        tests and operators; chaos.partition exercises the same path
+        through the transport hooks)."""
+        with self._lock:
+            rep = self.pool[index]
+            self._lost_locked(rep, RuntimeError('replica killed'))
+            return rep
+
+    def drain_replica(self, index):
+        """Gracefully drain replica `index`: no new admissions, its
+        in-flight requests finish and deliver."""
+        with self._lock:
+            rep = self.pool[index]
+            if rep.state == READY:
+                rep.drain()
+                self._refresh_gauges_locked()
+            return rep
+
+    # ---- delivery -----------------------------------------------------
+
+    def _collect(self, rep):
+        """Driver/step callback: forward newly generated tokens."""
+        with self._lock:
+            self._collect_locked(rep)
+            self._drain_pending_locked()
+
+    def _collect_locked(self, rep):
+        now = self._clock()
+        for gw, er in list(rep.assigned.items()):
+            new = er.tokens[len(gw.tokens):]
+            if new:
+                if not gw.tokens:
+                    ttft = now - gw.arrival_t
+                    self._m_ttft.observe(ttft)
+                    self._ttfts.append((now, ttft))
+                gw.tokens.extend(new)
+                if gw._stream_q is not None:
+                    for t in new:
+                        gw._stream_q.put(t)
+                self._m_tokens.inc(len(new))
+            if er.done and len(gw.tokens) >= len(er.tokens):
+                del rep.assigned[gw]
+                self._complete_locked(gw)
+
+    def _complete_locked(self, gw):
+        if gw._stream_q is not None:
+            gw._stream_q.put(None)
+        gw._finished.set()
+        self._m_completed.inc()
+
+    # ---- drive: sync mode ---------------------------------------------
+
+    def step(self):
+        """One synchronous pass (no driver threads): step every replica
+        with work, collect, drain the parked queue. Returns the number
+        of gateway requests still outstanding — the deterministic drive
+        loop tests and benches use."""
+        if self._started:
+            raise RuntimeError('gateway is running driver threads; '
+                               'sync step() would race them')
+        with self._lock:
+            reps = [r for r in self.pool if r.alive]
+        for rep in reps:
+            with self._lock:
+                has_work = bool(rep.assigned) \
+                    or bool(rep.engine.scheduler.pending)
+            if not has_work:
+                continue
+            try:
+                rep.step()
+            except Exception as exc:   # noqa: BLE001 — transport
+                with self._lock:
+                    self._lost_locked(rep, exc)
+                continue
+            self._collect(rep)
+        with self._lock:
+            for rep in reps:
+                if rep.state == DRAINING and not rep.assigned \
+                        and not rep.engine.scheduler.pending:
+                    rep.mark_stopped()
+            self._refresh_gauges_locked()
+            self._drain_pending_locked()
+            return len(self._pending) + sum(
+                len(r.assigned) for r in self.pool)
+
+    def run(self):
+        """Drive synchronously until every accepted request finished."""
+        while self.step():
+            pass
+
+    # ---- drive: threaded mode -----------------------------------------
+
+    def start(self):
+        """Spawn one driver thread per live replica; submit() callers
+        then just wait() on their handles."""
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            for rep in self.pool:
+                if rep.alive:
+                    rep.start_driver(self._collect, self._on_lost)
+        return self
+
+    def _on_lost(self, rep, exc):
+        with self._lock:
+            self._lost_locked(rep, exc)
+
+    def shutdown(self, timeout=10.0):
+        """Graceful stop: drain every replica, join the drivers."""
+        with self._lock:
+            reps = list(self.pool)
+            for rep in reps:
+                if rep.state == READY:
+                    rep.drain()
+            self._refresh_gauges_locked()
+        for rep in reps:
+            rep.join(timeout)
+        with self._lock:
+            self._started = False
+            self._refresh_gauges_locked()
+
+    # ---- autoscaling --------------------------------------------------
+
+    def autoscale_tick(self, now=None):
+        """One policy evaluation + application. Call it on whatever
+        cadence fits (a scrape loop, a timer thread, a test's fake
+        clock); the policy's own hysteresis makes the cadence safe."""
+        from .autoscaler import Decision
+        if self.policy is None:
+            return Decision(0, 'no autoscaler policy configured')
+        now = self._clock() if now is None else now
+        with self._lock:
+            burn = slo_burn_rate(self._ttfts, now, self.policy.slo_ttft_s,
+                                 self.policy.window_s)
+            self._m_burn.set(burn)
+            ready = [r for r in self.pool if r.state == READY]
+            occ = (sum(r.occupancy() for r in ready) / len(ready)
+                   if ready else 0.0)
+            depth = len(self._pending) + sum(
+                int(r.queue_depth()) for r in ready)
+            decision = self.policy.decide(now, burn, occ, depth,
+                                          len(ready))
+            if decision.delta > 0:
+                self._add_replica_locked()
+                self._m_scale.labels('up').inc()
+            elif decision.delta < 0 and ready:
+                victim = min(ready, key=lambda r: (r.load(), r.index))
+                victim.drain()
+                self._m_scale.labels('down').inc()
+                self._refresh_gauges_locked()
+            return decision
+
+    # ---- pool management ----------------------------------------------
+
+    def _add_replica_locked(self):
+        rep = InprocReplica(len(self.pool), self._factory())
+        self.pool.append(rep)
+        if self._started:
+            rep.start_driver(self._collect, self._on_lost)
+        self._refresh_gauges_locked()
+        return rep
+
+    def _refresh_gauges_locked(self):
+        alive = 0
+        for rep in self.pool:
+            self._m_state.labels(str(rep.index)).set(
+                STATE_CODES[rep.state])
+            if rep.alive:
+                alive += 1
+        self._m_replicas.set(alive)
+
+    @property
+    def replicas_alive(self):
+        with self._lock:
+            return sum(1 for r in self.pool if r.alive)
+
+    def report(self):
+        """Scalar summary for benches (the engines' report() analogue)."""
+        with self._lock:
+            return {
+                'replicas': len(self.pool),
+                'replicas_alive': sum(1 for r in self.pool if r.alive),
+                'requests': int(self._m_requests.value()),
+                'completed': int(self._m_completed.value()),
+                'tokens': int(self._m_tokens.value()),
+                'failovers': int(self._m_failover.value()),
+                'retries': int(self._m_retries.value()),
+                'pending': len(self._pending),
+            }

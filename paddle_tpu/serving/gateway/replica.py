@@ -1,0 +1,210 @@
+"""One engine replica behind the gateway: transport shim + lifecycle.
+
+An InprocReplica wraps a ContinuousBatchingEngine (or the paged
+variant) running in this process and gives it the same *shape* as a
+remote worker:
+
+- an endpoint string ('inproc://gw-replica-N') that chaos injectors
+  scope to — every submission fires the resilience 'send' hook and
+  every completed step fires 'recv', so `chaos.partition(endpoint)`
+  black-holes this replica exactly as it would a socket peer;
+- a per-endpoint CircuitBreaker (distributed/resilience.py) with
+  in-proc defaults: one transport failure means partitioned-or-dead,
+  not a blip, so a single strike opens the breaker and the gateway
+  replaces rather than retries;
+- its OWN MetricRegistry. Engines on the shared default registry would
+  collide on the unlabeled serving gauges (last-writer-wins); a private
+  registry per replica keeps `serving_queue_depth` / `serving_occupancy`
+  honest, which is exactly what the router load-balances on — and what
+  `metrics_server()` exposes for a real per-replica scrape.
+
+Lifecycle: READY -> DRAINING (no new admissions, in-flight decode
+finishes) -> STOPPED, or -> DEAD on transport loss. The gateway owns
+all transitions except DRAINING -> STOPPED, which the driver thread
+takes when the drained engine runs empty.
+"""
+import threading
+
+from ...distributed.resilience import CircuitBreaker, fire_fault_points
+from ...monitor.registry import MetricRegistry
+from ..metrics import ServingMetrics
+
+__all__ = ['InprocReplica', 'READY', 'DRAINING', 'DEAD', 'STOPPED',
+           'STATE_CODES']
+
+READY = 'ready'
+DRAINING = 'draining'
+DEAD = 'dead'
+STOPPED = 'stopped'
+
+# Replicas commonly share ONE model object (decode_gateway clones the
+# engine, not the artifact). Compiled dispatches are re-entrant, but
+# TRACING is not: functional_call swaps params through the shared
+# module while jax traces, so two replicas' first steps racing each
+# other leak tracers. One process-wide lock, held only while a replica
+# still has untraced programs, serializes warmup and costs steady-state
+# nothing.
+_TRACE_LOCK = threading.Lock()
+
+# gauge encoding for gateway_replica_state (docs/observability.md)
+STATE_CODES = {READY: 0, DRAINING: 1, DEAD: 2, STOPPED: 3}
+
+
+class InprocReplica:
+
+    def __init__(self, index, engine, breaker=None, registry=None):
+        self.index = int(index)
+        self.engine = engine
+        self.endpoint = 'inproc://gw-replica-%d' % self.index
+        self.registry = registry if registry is not None \
+            else MetricRegistry()
+        # rebind the engine's metrics onto the private registry (the
+        # bench-established pattern for multi-engine processes); the
+        # construction-time trace gauge stays on the old registry, which
+        # is fine — it is per-program, not per-replica
+        engine.metrics = ServingMetrics(registry=self.registry)
+        if breaker is None:
+            breaker = CircuitBreaker(failure_threshold=1,
+                                     reset_timeout=3600.0)
+        breaker.bind_name(self.endpoint)
+        self.breaker = breaker
+        self.state = READY
+        # GatewayRequest -> engine Request; guarded by the GATEWAY lock
+        # (never touched by the driver thread directly)
+        self.assigned = {}
+        self._cv = threading.Condition()
+        self._thread = None
+
+    # ---- transport (chaos hook points fire around every engine op) ----
+
+    def submit(self, prompt, **sampling):
+        """Submit one request to the wrapped engine. Fires the 'send'
+        hook first: a partitioned replica rejects the submission before
+        the engine sees it, like a dead socket."""
+        fire_fault_points('send', self.endpoint)
+        eng_req = self.engine.add_request(prompt, **sampling)
+        # refresh the queue gauge immediately so the router's next
+        # ranking sees this submission without waiting for a step
+        self.engine.metrics.on_queue_depth(
+            len(self.engine.scheduler.queue))
+        return eng_req
+
+    def step(self):
+        """One engine step. Fires 'recv' after: a partition that lands
+        mid-burst surfaces as a failed token delivery, which is the case
+        failover must re-admit (tokens were generated but never made it
+        back to the caller)."""
+        if self._untraced():
+            with _TRACE_LOCK:
+                n = self.engine.step()
+        else:
+            n = self.engine.step()
+        fire_fault_points('recv', self.endpoint)
+        return n
+
+    def _untraced(self):
+        """Any program this engine will certainly trace still untraced?
+        ('verify' only traces when speculation is on.)"""
+        eng = self.engine
+        skip = () if getattr(eng, 'spec_k', 0) else ('verify',)
+        return any(v == 0 for k, v in eng.trace_counts.items()
+                   if k not in skip)
+
+    # ---- observable state ---------------------------------------------
+
+    def _gauge(self, name):
+        fam = self.registry.get(name)
+        return 0.0 if fam is None else fam.value()
+
+    def queue_depth(self):
+        return self._gauge('serving_queue_depth')
+
+    def occupancy(self):
+        return self._gauge('serving_occupancy')
+
+    def load(self):
+        """Router ranking key: queued requests + occupied slots, both in
+        request units."""
+        return (self.queue_depth()
+                + self.occupancy() * self.engine.num_slots)
+
+    def routable(self):
+        """May the router place NEW work here?"""
+        return self.state == READY and self.breaker.allow()
+
+    @property
+    def alive(self):
+        """Still worth stepping (in-flight work may exist)?"""
+        return self.state in (READY, DRAINING)
+
+    def ready(self):
+        """/readyz readiness: READY routes, anything else 503s while
+        /healthz stays 200 (drain must not get the process restarted)."""
+        return self.state == READY
+
+    def metrics_server(self, **kwargs):
+        """A MetricsServer over this replica's private registry with
+        readiness wired to its drain state (not started)."""
+        from ...monitor.server import MetricsServer
+        return MetricsServer(registry=self.registry, readiness=self.ready,
+                             **kwargs)
+
+    # ---- lifecycle (gateway lock held unless noted) -------------------
+
+    def drain(self):
+        """Stop admissions, let in-flight decode finish."""
+        self.state = DRAINING
+        self.engine.shutdown()
+        self.wake()
+
+    def mark_dead(self):
+        self.state = DEAD
+        self.wake()
+
+    def mark_stopped(self):
+        self.state = STOPPED
+        self.wake()
+
+    def wake(self):
+        with self._cv:
+            self._cv.notify_all()
+
+    # ---- driver thread ------------------------------------------------
+
+    def start_driver(self, on_step, on_lost):
+        """Spawn the replica's drive loop: step whenever work exists,
+        park on the condvar otherwise. `on_step(self)` runs after every
+        successful step (the gateway collects tokens there);
+        `on_lost(self, exc)` runs once on transport failure and the
+        thread exits. Neither callback is invoked under the condvar, so
+        the gateway lock ordering (gateway -> engine) holds."""
+        def _run():
+            while True:
+                with self._cv:
+                    while self.alive and not self.engine.scheduler.pending:
+                        if self.state == DRAINING and not self.assigned:
+                            self.state = STOPPED
+                            return
+                        self._cv.wait(0.02)
+                    if not self.alive:
+                        return
+                try:
+                    self.step()
+                except Exception as exc:     # noqa: BLE001 — transport
+                    on_lost(self, exc)
+                    return
+                on_step(self)
+
+        self._thread = threading.Thread(
+            target=_run, name='gw-replica-%d' % self.index, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def join(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __repr__(self):
+        return ('InprocReplica(%d, %s, load=%.1f, assigned=%d)'
+                % (self.index, self.state, self.load(),
+                   len(self.assigned)))

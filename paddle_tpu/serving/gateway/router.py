@@ -1,0 +1,49 @@
+"""Routing policies: order the replica pool for one placement attempt.
+
+A router never *commits* a placement — it ranks. The gateway walks the
+ranked candidates and submits to the first one whose transport accepts,
+so a policy stays a pure function of observable replica state and the
+failover path (try the next candidate) needs no policy cooperation.
+
+Load is read from the live serving gauges each replica already exports
+(`serving_queue_depth`, `serving_occupancy` — serving/metrics.py), not
+from gateway-side shadow accounting: whatever a scrape of the replica
+would show is exactly what the router balances on.
+"""
+
+__all__ = ['LeastLoadedRouter', 'RoundRobinRouter']
+
+
+class LeastLoadedRouter:
+    """Rank routable replicas by live load, ties broken by index.
+
+    load = queue_depth + occupancy * num_slots: queued requests and
+    occupied slots cost the same one unit, so an idle replica beats a
+    full one even when nothing is queued anywhere.
+    """
+
+    name = 'least_loaded'
+
+    def candidates(self, pool):
+        rs = [r for r in pool if r.routable()]
+        rs.sort(key=lambda r: (r.load(), r.index))
+        return rs
+
+
+class RoundRobinRouter:
+    """Rotate over routable replicas, blind to load — the baseline
+    policy benches compare against (and the fallback when a deployment
+    scrapes gauges too coarsely to trust them)."""
+
+    name = 'round_robin'
+
+    def __init__(self):
+        self._next = 0
+
+    def candidates(self, pool):
+        rs = [r for r in pool if r.routable()]
+        if not rs:
+            return rs
+        k = self._next % len(rs)
+        self._next += 1
+        return rs[k:] + rs[:k]

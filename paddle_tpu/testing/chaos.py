@@ -23,7 +23,7 @@ import threading
 from ..distributed import resilience
 from ..monitor import tracing as _tracing
 
-__all__ = ['inject', 'drop_connections', 'delay_connections',
+__all__ = ['inject', 'drop_connections', 'delay_connections', 'partition',
            'fail_after', 'kill_server', 'truncate_file', 'active_faults']
 
 
@@ -90,6 +90,18 @@ def drop_connections(endpoint=None, point=None, times=None):
     def action(p, ep):
         raise ConnectionError('chaos: dropped %s to %s' % (p, ep))
     return inject(_Fault(action, _as_points(point), endpoint, times))
+
+
+def partition(endpoint, times=None):
+    """Network-partition a single endpoint: both send AND recv raise until
+    the context exits (or `times` ops have been dropped). Unlike
+    drop_connections(point=None) this never touches 'connect', so a
+    partitioned peer looks *reachable* but black-holes traffic — the
+    failure mode that forces a gateway to fail requests over rather than
+    simply re-dial. Returns the fault (inspect `.fired`)."""
+    def action(p, ep):
+        raise ConnectionError('chaos: partitioned %s at %s' % (ep, p))
+    return inject(_Fault(action, ('send', 'recv'), endpoint, times))
 
 
 def delay_connections(seconds, endpoint=None, point='connect', times=None):

@@ -216,6 +216,44 @@ def test_metrics_server_scrape_and_healthz():
         srv.port                           # stopped server has no port
 
 
+def test_metrics_server_readyz_tracks_readiness_callable():
+    """/healthz is liveness (always 200 while serving); /readyz is
+    readiness and flips to 503 when the injected callable says the
+    process is draining — without taking /healthz down with it."""
+    ready = {'ok': True}
+    r = MetricRegistry()
+    with MetricsServer(registry=r, readiness=lambda: ready['ok']) as srv:
+        body = json.loads(urllib.request.urlopen(
+            srv.url + '/readyz', timeout=5).read().decode())
+        assert body['status'] == 'ready'
+
+        ready['ok'] = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + '/readyz', timeout=5)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())['status'] == 'draining'
+        # liveness unaffected: LB must keep the pod, only unrouting it
+        health = json.loads(urllib.request.urlopen(
+            srv.url + '/healthz', timeout=5).read().decode())
+        assert health['status'] == 'ok'
+
+        # HEAD probes mirror GET status on the new route
+        req = urllib.request.Request(srv.url + '/readyz', method='HEAD')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 503
+        ready['ok'] = True
+        resp = urllib.request.urlopen(req, timeout=5)
+        assert resp.status == 200
+        assert resp.read() == b''
+
+    # no readiness callable configured: /readyz degenerates to liveness
+    with MetricsServer(registry=r) as srv:
+        body = json.loads(urllib.request.urlopen(
+            srv.url + '/readyz', timeout=5).read().decode())
+        assert body['status'] == 'ready'
+
+
 # -- runtime sampler ---------------------------------------------------------
 
 def test_runtime_sampler_populates_gauges():

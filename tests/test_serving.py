@@ -174,6 +174,28 @@ def test_admission_validation(model):
     assert len(req.tokens) == 2
 
 
+@pytest.mark.parametrize('make', [
+    lambda m: ContinuousBatchingEngine(m, num_slots=2, max_len=32,
+                                       prefill_chunk=8, decode_block=2),
+    lambda m: PagedContinuousBatchingEngine(m, num_seqs=2, max_len=32,
+                                            page_size=8, prefill_chunk=8,
+                                            decode_block=2),
+], ids=['slot', 'paged'])
+def test_front_door_rejects_unservable_worst_case(model, make):
+    """Both engines share the _EngineBase submission-time guard: a
+    request whose worst case (prompt + budget - 1) exceeds max_len gets
+    a clear ValueError naming max_len at add_request, instead of
+    wedging the queue head forever."""
+    eng = make(model)
+    with pytest.raises(ValueError, match='max_len=32'):
+        eng.add_request(list(range(1, 20)), max_new_tokens=20)  # 38 > 32
+    # the guard is exact: worst case == max_len still admits and runs
+    req = eng.add_request(list(range(1, 20)), max_new_tokens=14)  # == 32
+    eng.run()
+    assert len(req.tokens) == 14
+    assert eng.scheduler.pending == 0
+
+
 def test_engine_cap_exceeds_model_positions(model):
     with pytest.raises(ValueError, match='max_position_embeddings'):
         ContinuousBatchingEngine(model, num_slots=2, max_len=4096)

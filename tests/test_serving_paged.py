@@ -271,6 +271,49 @@ def test_shutdown_rejects_new_requests_but_drains(model, make):
     assert eng.scheduler.pending == 0
 
 
+@pytest.mark.parametrize('make', [
+    lambda m: ContinuousBatchingEngine(m, num_slots=2, max_len=32,
+                                       prefill_chunk=8, decode_block=2),
+    lambda m: PagedContinuousBatchingEngine(m, num_seqs=2, max_len=32,
+                                            page_size=8, prefill_chunk=8,
+                                            decode_block=2),
+], ids=['slot', 'paged'])
+def test_shutdown_races_active_stream_consumers(model, make):
+    """shutdown() lands WHILE stream() consumers are cooperatively
+    driving the engine: the front door closes, but every consumer's
+    stream still terminates cleanly with its full token budget (the
+    retire/churn half of this contract is covered above)."""
+    import threading
+    eng = make(model)
+    reqs = [eng.add_request(p, max_new_tokens=6, stream=True)
+            for p in ([1, 2, 3], [4, 5], [6, 7, 8, 9])]
+    got = {i: [] for i in range(len(reqs))}
+    errs = []
+
+    def consume(i):
+        try:
+            for tok in eng.stream(reqs[i]):
+                got[i].append(tok)
+        except Exception as e:        # noqa: BLE001 — the assertion
+            errs.append(e)
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    eng.shutdown()                    # races the consumers' step() calls
+    with pytest.raises(RuntimeError, match='shut down'):
+        eng.add_request([1], max_new_tokens=2)
+    for t in threads:
+        t.join(120)
+    assert not any(t.is_alive() for t in threads)
+    assert errs == []
+    for i, r in enumerate(reqs):
+        assert got[i] == r.tokens
+        assert len(got[i]) == 6
+    assert eng.scheduler.pending == 0
+
+
 def test_engine_retire_releases_pages(model):
     """Engine-level leak check: after churning many requests through few
     sequences, only the prefix cache still references pages, and

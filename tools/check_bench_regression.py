@@ -37,7 +37,8 @@ if _REPO_ROOT not in sys.path:
 # (bench_extra rungs vary these, not the knob env). The paged-serving
 # rung adds page_size/spec_k/workload: a spec-on row must never land in
 # a spec-off row's regression bucket.
-_AUX_CONFIG = ('num_slots', 'new_tokens', 'prompt_len', 'image_size',
+_AUX_CONFIG = ('replicas', 'kill_at', 'policy',
+               'num_slots', 'new_tokens', 'prompt_len', 'image_size',
                'trace', 'model', 'scan_steps', 'page_size', 'spec_k',
                'workload')
 

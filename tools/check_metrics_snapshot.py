@@ -129,6 +129,8 @@ def main(argv=None):
                           'configs': sorted(per_tag),
                           'tracing_families': sum(
                               1 for n in union if n.startswith('trace_')),
+                          'gateway_families': sum(
+                              1 for n in union if n.startswith('gateway_')),
                           'new_unbaselined': extra, 'ok': True}))
         return 0
     return 1

@@ -171,6 +171,10 @@ def _run_measurement():
     # warmup/compile. The axon tunnel's dispatch path ramps over the first
     # ~tens of steps (fresh-process step times start 4-10x higher than
     # steady state), so warm until the measured window sees steady state.
+    # The CompileWatchdog arms after warmup: a recompile inside the
+    # measured window invalidates the number, and now gets reported.
+    from paddle_tpu.monitor.perf import CompileWatchdog, costmodel
+    wd = CompileWatchdog(strict=False, name='bench')
     warmup = int(os.environ.get('PADDLE_TPU_BENCH_WARMUP',
                                 15 if on_tpu else 1))
     if scan_k > 1:
@@ -179,17 +183,24 @@ def _run_measurement():
             ids.numpy(), (scan_k,) + tuple(ids.shape)).copy())
         labels_k = paddle.to_tensor(_np.broadcast_to(
             labels.numpy(), (scan_k,) + tuple(labels.shape)).copy())
+        t_cold = time.time()
         losses = step.multi_step(ids_k, labels_k)
+        _ = losses.numpy()
+        compile_s_cold = time.time() - t_cold
         # the relay's dispatch path ramps over the first dispatches, not
         # steps — warm at least 3 dispatches regardless of K
         for _ in range(max(3, -(-warmup // scan_k))):
             losses = step.multi_step(ids_k, labels_k)
         _ = losses.numpy()
     else:
+        t_cold = time.time()
         loss = step(ids, labels)
+        _ = loss.numpy()
+        compile_s_cold = time.time() - t_cold
         for _ in range(warmup):
             loss = step(ids, labels)
         _ = loss.numpy()
+    wd.declare_warmup('bench warmup done')
 
     profile_dir = os.environ.get('PADDLE_TPU_BENCH_PROFILE')
     if profile_dir:
@@ -223,6 +234,23 @@ def _run_measurement():
     dt = time.time() - t0
     if profile_dir:
         jax.profiler.stop_trace()
+    recompiles = wd.recompiles
+    wd.close()
+
+    # cost-model block: analytic FLOPs/bytes of the single-step program
+    # (per-step numbers even under scan), plus a warm compile time — the
+    # second lower+compile resolves through the compilation cache, so it
+    # measures the cache-hit path, not XLA
+    perf_est = None
+    compile_s_warm = None
+    try:
+        compiled = step.compiled_executable(ids, labels)
+        t_warm = time.time()
+        step.compiled_executable(ids, labels)
+        compile_s_warm = time.time() - t_warm
+        perf_est = costmodel.estimate(compiled, step_seconds=dt / steps)
+    except Exception:
+        pass
 
     samples_per_sec = batch * steps / dt
     n_params = model.num_params()
@@ -263,6 +291,15 @@ def _run_measurement():
            if 'PADDLE_TPU_BLOCKWISE_BLOCK' in os.environ else {}),
         'platform': platform,
         'degraded': not on_tpu,
+        'compile_s_cold': round(compile_s_cold, 3),
+        **({'compile_s_warm': round(compile_s_warm, 3)}
+           if compile_s_warm is not None else {}),
+        'recompiles': recompiles,
+        **({'mfu_est': round(perf_est['mfu_est'], 4),
+            'arithmetic_intensity':
+                round(perf_est['arithmetic_intensity'], 2),
+            'roofline_bound': perf_est['roofline_bound']}
+           if perf_est and 'mfu_est' in perf_est else {}),
         **({'dispatch_ms': dispatch_ms} if dispatch_ms else {}),
     }))
 

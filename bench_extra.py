@@ -246,6 +246,30 @@ def _poisson_arrivals(n, mean_gap, seed=0):
     return np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
 
 
+def _perf_fields(eng, t_cold=None, bursts=None, wall=None):
+    """Perf-introspection fields for a serving bench row: cold/warm
+    compile seconds, post-warmup recompile count, and the cost-model
+    MFU/roofline block over the engine's steady-state program (decode,
+    or the verify forward under speculation)."""
+    out = {}
+    if t_cold is not None:
+        out['compile_s_cold'] = round(t_cold, 3)
+    out['recompiles'] = eng.perf.recompiles
+    try:
+        est = eng.perf_estimate(bursts=bursts, wall_seconds=wall)
+    except Exception:
+        est = None
+    if est:
+        out['compile_s_warm'] = round(est['compile_s_warm'], 3)
+        intensity = est.get('arithmetic_intensity')
+        if intensity is not None and intensity != float('inf'):
+            out['arithmetic_intensity'] = round(intensity, 2)
+        out['roofline_bound'] = est['roofline_bound']
+        if 'mfu_est' in est:
+            out['mfu_est'] = round(est['mfu_est'], 4)
+    return out
+
+
 def _drive_cb(engine, prompts, arrivals, mnt):
     """Feed the engine its arrival trace in real time and drain it."""
     from paddle_tpu.serving.metrics import ServingMetrics
@@ -345,7 +369,11 @@ def bench_serving(on_tpu):
             eng = ContinuousBatchingEngine(
                 model, num_slots=num_slots, max_len=max_len,
                 prefill_chunk=chunk, decode_block=block)
+            t0c = time.time()
             eng.generate(prompts[:2], max_new_tokens=2)     # compile
+            t_cold = time.time() - t0c
+            b0 = eng.timeline.steps
+            w0 = time.time()
             if num_slots == slot_curve[0]:
                 # headline point: the real-time Poisson trace
                 tps, rep = _drive_cb(eng, prompts, arrivals, mnt)
@@ -371,6 +399,9 @@ def bench_serving(on_tpu):
                        'occupancy_mean': round(rep['occupancy_mean'], 3),
                        'trace': 'burst', 'requests': n_req,
                        'new_tokens': mnt, 'degraded': not on_tpu}
+            row.update(_perf_fields(eng, t_cold,
+                                    eng.timeline.steps - b0,
+                                    time.time() - w0))
             row.update(extra)
             rows.append(row)
 
@@ -470,8 +501,13 @@ def bench_serving_paged(on_tpu):
         eng = PagedContinuousBatchingEngine(
             model, num_seqs=num_seqs, max_len=max_len, page_size=page,
             prefill_chunk=chunk, decode_block=block, spec_k=spec_k)
+        t0c = time.time()
         eng.generate(prompts[:2], max_new_tokens=2)          # compile
+        t_cold = time.time() - t0c
+        b0 = eng.timeline.steps
+        w0 = time.time()
         tps, rep, peak = _drive_paged(eng, prompts, arrivals, mnt)
+        wall = time.time() - w0
         tag = '_spec' if spec_k else ''
         rows.append(dict(base, metric='serving_paged_tokens_per_sec' + tag,
                          value=round(tps, 2), unit='tokens/sec',
@@ -483,7 +519,9 @@ def bench_serving_paged(on_tpu):
                          pages_in_use_peak=peak,
                          spec_accept_rate=round(rep['spec_accept_rate'], 3),
                          occupancy_mean=round(rep['occupancy_mean'], 3),
-                         traces=eng.compiled_sizes()))
+                         traces=eng.compiled_sizes(),
+                         **_perf_fields(eng, t_cold,
+                                        eng.timeline.steps - b0, wall)))
         if not spec_k:
             rows.append(dict(base, metric='serving_paged_prefix_hit_rate',
                              value=round(rep['prefix_hit_rate'], 4),
@@ -552,7 +590,10 @@ def bench_serving_gateway(on_tpu):
     def drive(kill_at):
         reg = MetricRegistry()
         gw = ServingGateway(factory, replicas=replicas, registry=reg)
+        t0c = time.time()
         gw.generate(prompts[:replicas], max_new_tokens=2)     # compile
+        t_cold = time.time() - t0c
+        b0 = sum(r.engine.timeline.steps for r in gw.pool)
         gw.start()
         kill_i = None if kill_at is None else int(n_req * kill_at)
         reqs = []
@@ -567,27 +608,32 @@ def bench_serving_gateway(on_tpu):
         for r in reqs:
             r.wait(600)
         dt = time.time() - t0
+        bursts = sum(r.engine.timeline.steps for r in gw.pool) - b0
         gw.shutdown()
         toks = sum(len(r.tokens) for r in reqs)
         completed = sum(1 for r in reqs if r.done)
         failovers = int(reg.get('gateway_failover_total').value())
+        # replica 0 always survives the chaos run: its decode program is
+        # representative, and bursts summed pool-wide make the MFU an
+        # aggregate utilization over the whole gateway
+        perf = _perf_fields(gw.pool[0].engine, t_cold, bursts, dt)
         return (toks / dt, completed / float(len(reqs)), failovers,
-                gw.report())
+                gw.report(), perf)
 
     base = {'unit': 'tokens/sec', 'trace': 'poisson',
             'mean_gap_s': mean_gap, 'requests': n_req, 'new_tokens': mnt,
             'num_slots': num_slots, 'replicas': replicas,
             'policy': 'least_loaded', 'degraded': not on_tpu}
     rows = []
-    tps, ratio, fo, rep = drive(None)
+    tps, ratio, fo, rep, perf = drive(None)
     rows.append(dict(base, metric='serving_gateway_tokens_per_sec',
                      value=round(tps, 2), kill_at='none', failovers=fo,
-                     completed_ratio=round(ratio, 4)))
-    tps, ratio, fo, rep = drive(kill_frac)
+                     completed_ratio=round(ratio, 4), **perf))
+    tps, ratio, fo, rep, perf = drive(kill_frac)
     rows.append(dict(base, metric='serving_gateway_tokens_per_sec_chaos',
                      value=round(tps, 2), kill_at=kill_frac, failovers=fo,
                      completed_ratio=round(ratio, 4),
-                     replicas_alive=rep['replicas_alive']))
+                     replicas_alive=rep['replicas_alive'], **perf))
     rows.append(dict(base, metric='serving_gateway_completed_ratio',
                      value=round(ratio, 4), unit='ratio',
                      kill_at=kill_frac, failovers=fo))

@@ -5,6 +5,7 @@ Adapter; here train_batch always runs through the jitted TrainStep
 (framework/functional.py), which IS the static path — eager fallback only
 when the model structure defeats functionalization.
 """
+import contextlib
 import os
 
 import numpy as np
@@ -26,6 +27,7 @@ class Model:
         self._optimizer = None
         self._metrics = []
         self._train_step = None
+        self._perf_timeline = None    # StepTimeline while fit() runs
         self.stop_training = False
         self.mode = 'train'
 
@@ -51,14 +53,24 @@ class Model:
     # -- batch-level API ----------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
+        tl = self._perf_timeline
+        dispatch = tl.phase('host_dispatch') if tl is not None \
+            else contextlib.nullcontext()
+        block = tl.phase('device_block') if tl is not None \
+            else contextlib.nullcontext()
         try:
             step = self._ensure_train_step()
-            loss = step(inputs, labels)
+            with dispatch:
+                loss = step(inputs, labels)
         except Exception:
             # eager fallback: run unfused (still correct)
             loss = self._eager_train_batch(inputs, labels)
+        with block:
+            # blocks until the device result is ready — the
+            # dispatch-to-materialize gap is the device-bound phase
+            loss_np = loss.numpy()
         metrics = self._update_metrics(inputs, labels)
-        return [loss.numpy()] if not metrics else ([loss.numpy()], metrics)
+        return [loss_np] if not metrics else ([loss_np], metrics)
 
     def _eager_train_batch(self, inputs, labels):
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -141,31 +153,60 @@ class Model:
                                 metrics=[m.name() for m in self._metrics])
         cbks.on_train_begin()
         self.stop_training = False
+        from ..monitor.perf import CompileWatchdog, StepTimeline
+        wd = CompileWatchdog(owner=self, name='Model.fit')
+        tl = StepTimeline()
+        self._perf_timeline = tl
+        # everything up to and including the FIRST eval pass may
+        # legitimately compile (train step on epoch 0, eval's eager ops
+        # on their first shapes); compiles after that barrier are
+        # steady-state recompiles
+        warmup_epoch = 0 if eval_loader is None \
+            else min(eval_freq, epochs) - 1
         it = 0
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
-            cbks.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(train_loader):
-                cbks.on_train_batch_begin(step)
-                ins, labs = self._split_batch(batch)
-                res = self.train_batch(ins, labs)
-                logs = self._pack_logs(res)
-                cbks.on_train_batch_end(step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
+        logs = {}
+        try:
+            for epoch in range(epochs):
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(epoch)
+                logs = {}
+                data_iter = iter(train_loader)
+                step = 0
+                while True:
+                    try:
+                        with tl.phase('data_wait'):
+                            batch = next(data_iter)
+                    except StopIteration:
+                        tl.discard()
+                        break
+                    cbks.on_train_batch_begin(step)
+                    ins, labs = self._split_batch(batch)
+                    res = self.train_batch(ins, labs)
+                    tl.end_step()
+                    logs = self._pack_logs(res)
+                    cbks.on_train_batch_end(step, logs)
+                    step += 1
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                        break
+                if eval_loader is not None and \
+                        (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              num_workers=num_workers)
+                    logs.update({'eval_' + k: v
+                                 for k, v in eval_logs.items()})
+                    cbks.on_eval_end(eval_logs)
+                if epoch == warmup_epoch:
+                    wd.declare_warmup('Model.fit epoch %d done' % epoch)
+                cbks.on_epoch_end(epoch, logs)
+                if self.stop_training:
                     break
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          num_workers=num_workers)
-                logs.update({'eval_' + k: v for k, v in eval_logs.items()})
-                cbks.on_eval_end(eval_logs)
-            cbks.on_epoch_end(epoch, logs)
-            if self.stop_training:
-                break
-        cbks.on_train_end(logs)
+            cbks.on_train_end(logs)
+        finally:
+            self._perf_timeline = None
+            wd.close()
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -275,3 +316,20 @@ class Model:
     def summary(self, input_size=None, dtype=None):
         from . import summary as summary_fn
         return summary_fn(self.network, input_size, dtypes=dtype)
+
+    def summary_perf(self, inputs, labels=None, step_seconds=None,
+                     registry=None):
+        """Cost-model companion to ``summary()``: compile the jitted
+        train step for this batch and report analytic FLOPs, bytes
+        accessed, arithmetic intensity, roofline bound and ideal step
+        time; with a measured ``step_seconds``, also ``mfu_est`` and
+        ``roofline_frac``. Publishes the perf gauges as a side effect.
+        Requires ``prepare(loss=..., optimizer=...)``; returns None when
+        the backend exposes no cost model."""
+        from ..monitor.perf import costmodel
+        step = self._ensure_train_step()
+        compiled = step.compiled_executable(inputs, labels)
+        est = costmodel.estimate(compiled, step_seconds=step_seconds)
+        if est is not None:
+            costmodel.record(est, registry=registry)
+        return est

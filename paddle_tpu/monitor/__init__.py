@@ -13,7 +13,10 @@ Four layers, each usable alone:
 - ``tracing``   — distributed span tracer (trace_id/span_id/parent,
   contextvars propagation, cross-process context injection) with a
   flight-recorder ring served at /debug/traces and exportable as
-  Chrome-trace JSON for profiler.merge_traces.
+  Chrome-trace JSON for profiler.merge_traces;
+- ``perf``      — performance introspection: CompileWatchdog (recompile
+  attribution + warmup barrier), StepTimeline (step phase split +
+  straggler detection), and the cost-model roofline/MFU estimator.
 
 Built-in instrumentation (resilient RPC, the serving engine, PS/graph
 clients, hapi TelemetryCallback, the dryrun telemetry line) feeds
@@ -29,12 +32,15 @@ from .server import MetricsServer
 from .runtime import RuntimeSampler
 from .tracing import (FlightRecorder, Span, Tracer, default_tracer,
                       set_default_tracer, spans_to_chrome)
+from . import perf
 from . import telemetry
 from . import tracing
+from .perf import CompileWatchdog, RecompileError, StepTimeline
 
 __all__ = ['MetricRegistry', 'Counter', 'Gauge', 'Histogram',
            'exponential_buckets', 'default_registry',
            'set_default_registry', 'to_prometheus', 'to_dict', 'to_json',
            'schema_of', 'MetricsServer', 'RuntimeSampler', 'telemetry',
            'Tracer', 'Span', 'FlightRecorder', 'default_tracer',
-           'set_default_tracer', 'spans_to_chrome', 'tracing']
+           'set_default_tracer', 'spans_to_chrome', 'tracing', 'perf',
+           'CompileWatchdog', 'RecompileError', 'StepTimeline']

@@ -1,0 +1,25 @@
+"""Performance introspection: the third leg of the monitor subsystem.
+
+Three components, each usable alone (stdlib at import; jax touched only
+when live):
+
+- ``watchdog``  — CompileWatchdog: jax.monitoring compile listeners,
+  recompile attribution (callsite + abstract-shape signature), warmup
+  barrier with flight-dump + optional strict hard-fail;
+- ``timeline``  — StepTimeline: data-wait / host-dispatch /
+  device-blocked phase split with rolling percentiles and straggler
+  detection;
+- ``costmodel`` — XLA cost-analysis -> arithmetic intensity, roofline
+  bound, ideal step time, and MFU estimates.
+
+All metric families are single-sourced in
+``monitor.telemetry.PERF_FAMILIES`` (registered via
+``record_perf_schema``) so the dryrun schema gate covers them without a
+perf run. See docs/observability.md for the family/label inventory.
+"""
+from . import costmodel
+from .timeline import PHASES, StepTimeline
+from .watchdog import COMPILE_EVENTS, CompileWatchdog, RecompileError
+
+__all__ = ['CompileWatchdog', 'RecompileError', 'COMPILE_EVENTS',
+           'StepTimeline', 'PHASES', 'costmodel']

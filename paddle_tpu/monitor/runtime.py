@@ -40,7 +40,13 @@ def read_rss_bytes():
 
 def jax_cache_entries():
     """Total entries across jax's weakref-LRU tracing caches plus the
-    pjit infer-params cache — a flat number means no retrace churn."""
+    C++ pjit executable caches — a flat number means no retrace churn.
+
+    The infer-params cache is already a member of the weakref-LRU list,
+    so it must not be added again; the C++ fast-path caches
+    (PjitFunctionCache) are NOT in that list, and without them this
+    probe under-reports jax.jit churn on current jaxlib — every
+    steady-state jit call resolves through them."""
     total = 0
     try:
         import jax._src.util as _u
@@ -53,7 +59,9 @@ def jax_cache_entries():
         return None
     try:
         import jax._src.pjit as _pjit
-        total += _pjit._infer_params_cached.cache_info().currsize
+        for cache in (_pjit._cpp_pjit_cache_fun_only,
+                      _pjit._cpp_pjit_cache_explicit_attributes):
+            total += cache.size()
     except Exception:
         pass
     return total

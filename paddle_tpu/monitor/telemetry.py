@@ -13,7 +13,8 @@ from .runtime import RuntimeSampler
 
 __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_gateway_schema', 'record_tracing_schema',
-           'snapshot_line', 'parse_snapshot_lines', 'LINE_RE']
+           'record_perf_schema', 'snapshot_line', 'parse_snapshot_lines',
+           'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
                      r'\[(?P<tag>[^\]]*)\]:\s*(?P<json>\{.*\})\s*$')
@@ -117,6 +118,56 @@ def record_gateway_schema(registry):
     return out
 
 
+# the performance-introspection families (monitor/perf/). Same
+# single-source rule: CompileWatchdog, StepTimeline, the cost-model
+# gauges and the schema baseline all register through
+# record_perf_schema. Label budgets: kind is the three jax compile
+# stages, phase the four step-timeline phases — both closed sets.
+PERF_FAMILIES = (
+    ('counter', 'perf_compiles_total',
+     'jit compilation events seen by the CompileWatchdog', ('kind',)),
+    ('histogram', 'perf_compile_seconds',
+     'duration of jit trace/lower/compile events', ('kind',)),
+    ('counter', 'perf_recompiles_total',
+     'compiles after a declared warmup barrier '
+     '(steady state must stay 0)', ()),
+    ('histogram', 'perf_step_phase_seconds',
+     'per-step phase durations '
+     '(data_wait/host_dispatch/device_block/other)', ('phase',)),
+    ('counter', 'perf_steps_total',
+     'steps finalized by a StepTimeline', ()),
+    ('counter', 'perf_stragglers_total',
+     'steps slower than straggler_factor x the rolling median', ()),
+    ('gauge', 'perf_mfu_est',
+     'cost-model MFU estimate of the measured step', ()),
+    ('gauge', 'perf_arithmetic_intensity',
+     'analytic flops per byte accessed of the compiled step', ()),
+    ('gauge', 'perf_roofline_bound',
+     'roofline classification of the compiled step '
+     '(0=bandwidth 1=compute)', ()),
+)
+
+
+def record_perf_schema(registry):
+    """Register the perf-introspection families on `registry` and return
+    {name: family}. Used by CompileWatchdog/StepTimeline at construction
+    and by dryrun_registry so the committed baseline covers perf."""
+    from .registry import exponential_buckets
+    buckets = {
+        # trace/lower/compile stages span ~1ms (CPU toy) to minutes
+        'perf_compile_seconds': exponential_buckets(0.001, 2.0, 18),
+        # step phases span ~0.1ms (decode dispatch) to tens of seconds
+        'perf_step_phase_seconds': exponential_buckets(1e-4, 2.0, 20),
+    }
+    out = {}
+    for kind, name, doc, labels in PERF_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            kw['buckets'] = buckets[name]
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 def record_tracing_schema(registry):
     """Register the span-tracer health families (spans started /
     finished / dropped, flight dumps, exemplar count) on `registry` —
@@ -127,15 +178,19 @@ def record_tracing_schema(registry):
     return tracing.register_metrics(registry)
 
 
-def dryrun_registry(step_seconds, loss, batch=None):
+def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
-    schema: training gauges + serving + tracing families + one runtime
-    sample."""
-    reg = MetricRegistry()
+    schema: training gauges + serving + tracing + perf families + one
+    runtime sample. Pass `registry` to fold live instrumentation into
+    the snapshot (the dryrun hands in the registry its CompileWatchdog /
+    StepTimeline populated around the measured step); families already
+    present are reused via get-or-create."""
+    reg = registry if registry is not None else MetricRegistry()
     record_dryrun_step(reg, step_seconds, loss, batch=batch)
     record_serving_schema(reg)
     record_gateway_schema(reg)
     record_tracing_schema(reg)
+    record_perf_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from ..framework import functional as _fm
 from ..framework.core import Tensor, no_grad_guard
 from ..monitor import tracing as _tracing
+from ..monitor.perf import CompileWatchdog, StepTimeline
+from ..monitor.perf import costmodel as _costmodel
 from ..text.models.gpt import GPTSlotCache
 from .kv_cache import SlotAllocator, build_slot_caches
 from .metrics import ServingMetrics
@@ -120,6 +122,20 @@ class _EngineBase:
             '(flat == zero retrace)', ('program',))
         self._m_trace = {k: trace_gauge.labels(k)
                          for k in self.trace_counts}
+        # performance introspection (monitor/perf): the watchdog turns
+        # the "exactly one program per key" invariant from a test
+        # assertion into a production watch — once every program this
+        # engine will run has traced, step() declares the warmup
+        # barrier and any further compile on THIS engine's stack is a
+        # counted, attributed recompile (hard-fail under
+        # PADDLE_TPU_COMPILE_STRICT=1). The timeline splits each decode
+        # burst into host-dispatch vs device-blocked time.
+        self.perf = CompileWatchdog(registry=self.metrics.registry,
+                                    tracer=self._tracer, owner=self,
+                                    name=type(self).__name__)
+        self.timeline = StepTimeline(registry=self.metrics.registry,
+                                     tracer=self._tracer)
+        self._decode_args = None
 
     # ---- front door ---------------------------------------------------
 
@@ -170,6 +186,7 @@ class _EngineBase:
         closes the front door."""
         with self._lock:
             self._closed = True
+            self.perf.close()
 
     def step(self):
         """One scheduler iteration: admit → prefill chunks → decode
@@ -183,6 +200,11 @@ class _EngineBase:
             self._on_step_metrics()
             for prog, child in self._m_trace.items():
                 child.set(self.trace_counts[prog])
+            if not self.perf.armed and all(
+                    self.trace_counts[p] > 0
+                    for p in self._warm_programs()):
+                self.perf.declare_warmup(
+                    '%s steady state' % type(self).__name__)
             return self.scheduler.pending
 
     def run(self):
@@ -221,6 +243,58 @@ class _EngineBase:
     def compiled_sizes(self):
         """Times each program has been traced — the no-retrace metric."""
         return dict(self.trace_counts)
+
+    def _warm_programs(self):
+        """Programs that must trace before the watchdog's warmup
+        barrier can be declared (subclasses drop conditional ones)."""
+        return self._programs
+
+    def rebind_perf(self, registry):
+        """Move the perf instrumentation onto `registry` (the gateway
+        replica pattern: engine metrics live on a private per-replica
+        registry so counters stay per-replica honest). The fresh
+        watchdog starts disarmed; the next step() re-declares warmup
+        once the trace counts check out."""
+        self.perf.close()
+        self.perf = CompileWatchdog(registry=registry,
+                                    tracer=self._tracer, owner=self,
+                                    name=type(self).__name__)
+        self.timeline = StepTimeline(registry=registry,
+                                     tracer=self._tracer)
+        return self
+
+    def _perf_target(self):
+        """(jitted_fn, last-dispatch args) for the steady-state program
+        the cost model should price — the decode program by default
+        (the spec-decode engine overrides with its verify program)."""
+        return self._decode_jit, self._decode_args
+
+    def perf_estimate(self, bursts=None, wall_seconds=None):
+        """Cost-model estimate of the steady-state program (the
+        dollar spender): analytic flops/bytes, roofline bound, warm
+        compile seconds — plus mfu_est when told how many decode bursts
+        ran over a measured wall. None before the first burst dispatch.
+
+        The deliberate lower+compile here is watchdog-suspended (it is
+        a measurement, not a retrace) and reuses the exact arrays of
+        the last dispatch, so the traced avals match and the program's
+        trace count stays flat."""
+        jit_fn, args = self._perf_target()
+        if args is None:
+            return None
+        with self._lock, self.perf.suspended():
+            import time as _time
+            t0 = _time.monotonic()
+            compiled = jit_fn.lower(*args).compile()
+            warm_s = _time.monotonic() - t0
+        step_s = None
+        if bursts and wall_seconds and bursts > 0:
+            step_s = wall_seconds / float(bursts)
+        est = _costmodel.estimate(compiled, step_seconds=step_s)
+        if est is None:
+            return None
+        est['compile_s_warm'] = warm_s
+        return est
 
     @property
     def occupancy(self):
@@ -425,17 +499,24 @@ class ContinuousBatchingEngine(_EngineBase):
         if not slots:
             return
         # span covers dispatch AND the device_get sync — the burst's
-        # actual wall time, not just the async enqueue
+        # actual wall time, not just the async enqueue. The timeline
+        # splits the same window: host_dispatch (enqueue returns) vs
+        # device_block (results ready). Dispatch args are stashed for
+        # perf_estimate's cost-model lowering (same avals, no retrace).
+        args = (self._params, self._bufs, self._caches, self._last,
+                self._gen, self._budgets, self._active, self._keys,
+                self._temps, self._topks, self._sample)
+        self._decode_args = args
         with self._tracer.start_span('serving.decode_burst',
                                      tags={'rows': len(slots),
                                            'block': self.decode_block}):
-            (self._caches, last, gen, keys, toks,
-             actives) = self._decode_jit(
-                self._params, self._bufs, self._caches, self._last,
-                self._gen, self._budgets, self._active, self._keys,
-                self._temps, self._topks, self._sample)
-            last, gen, keys, toks, actives = jax.device_get(
-                (last, gen, keys, toks, actives))
+            with self.timeline.phase('host_dispatch'):
+                (self._caches, last, gen, keys, toks,
+                 actives) = self._decode_jit(*args)
+            with self.timeline.phase('device_block'):
+                last, gen, keys, toks, actives = jax.device_get(
+                    (last, gen, keys, toks, actives))
+        self.timeline.end_step()
         # device_get can hand back read-only views; these three are
         # mutated in place at prefill/retire
         self._last = np.array(last)

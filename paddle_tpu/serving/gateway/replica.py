@@ -63,6 +63,10 @@ class InprocReplica:
         # construction-time trace gauge stays on the old registry, which
         # is fine — it is per-program, not per-replica
         engine.metrics = ServingMetrics(registry=self.registry)
+        # the perf watchdog/timeline follow the metrics registry; the
+        # rebind also re-keys the watchdog's owner filter so replica A's
+        # armed watchdog ignores replica B's first-compile events
+        engine.rebind_perf(self.registry)
         if breaker is None:
             breaker = CircuitBreaker(failure_threshold=1,
                                      reset_timeout=3600.0)

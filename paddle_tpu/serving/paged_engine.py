@@ -138,10 +138,25 @@ class PagedContinuousBatchingEngine(_EngineBase):
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=dn)
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=dn)
         self._verify_jit = jax.jit(self._verify_fn, donate_argnums=dn)
+        self._verify_args = None
 
     @property
     def num_seqs(self):
         return self.num_slots
+
+    def _warm_programs(self):
+        # the verify program only ever traces when speculation is on;
+        # without spec_k the watchdog must not wait for it forever
+        if self.spec_k:
+            return self._programs
+        return ('prefill', 'decode')
+
+    def _perf_target(self):
+        # under speculation the verify forward is the steady-state
+        # spender (the plain decode program never dispatches)
+        if self.spec_k and self._verify_args is not None:
+            return self._verify_jit, self._verify_args
+        return self._decode_jit, self._decode_args
 
     def _validate(self, req):
         if self.spec_k and req.do_sample:
@@ -281,18 +296,25 @@ class PagedContinuousBatchingEngine(_EngineBase):
         if self.spec_k:
             return self._spec_step(slots)
         # span covers dispatch AND the device_get sync — the burst's
-        # actual wall time, not just the async enqueue
-        with self._tracer.start_span('serving.decode_burst',
-                                     tags={'rows': len(slots),
-                                           'block': self.decode_block}):
-            (self._pools, lens, last, gen, keys, toks,
-             actives) = self._decode_jit(
-                self._params, self._bufs, self._pools,
+        # actual wall time, not just the async enqueue. The timeline
+        # splits the same window (host_dispatch vs device_block) and the
+        # dispatch args are stashed for perf_estimate's cost-model
+        # lowering (identical avals, so no retrace).
+        args = (self._params, self._bufs, self._pools,
                 self.scheduler.block_tables, self._lens, self._last,
                 self._gen, self._budgets, self._active, self._keys,
                 self._temps, self._topks, self._sample)
-            lens, last, gen, keys, toks, actives = jax.device_get(
-                (lens, last, gen, keys, toks, actives))
+        self._decode_args = args
+        with self._tracer.start_span('serving.decode_burst',
+                                     tags={'rows': len(slots),
+                                           'block': self.decode_block}):
+            with self.timeline.phase('host_dispatch'):
+                (self._pools, lens, last, gen, keys, toks,
+                 actives) = self._decode_jit(*args)
+            with self.timeline.phase('device_block'):
+                lens, last, gen, keys, toks, actives = jax.device_get(
+                    (lens, last, gen, keys, toks, actives))
+        self.timeline.end_step()
         self._lens = np.array(lens)
         self._last = np.array(last)
         self._gen = np.array(gen)
@@ -321,13 +343,17 @@ class PagedContinuousBatchingEngine(_EngineBase):
             drafts[slot] = d
             toks[slot, 0] = self._last[slot, 0]
             toks[slot, 1:] = d
+        args = (self._params, self._bufs, self._pools,
+                self.scheduler.block_tables, self._lens, toks)
+        self._verify_args = args
         with self._tracer.start_span('serving.decode_burst',
                                      tags={'rows': len(slots),
                                            'spec_k': K}):
-            self._pools, picks = self._verify_jit(
-                self._params, self._bufs, self._pools,
-                self.scheduler.block_tables, self._lens, toks)
-            picks = np.asarray(jax.device_get(picks))
+            with self.timeline.phase('host_dispatch'):
+                self._pools, picks = self._verify_jit(*args)
+            with self.timeline.phase('device_block'):
+                picks = np.asarray(jax.device_get(picks))
+        self.timeline.end_step()
         for slot in slots:
             req = self._requests[slot]
             d, g = drafts[slot], picks[slot]

@@ -104,12 +104,24 @@ class GPTStaticCache:
 # decode step takes and returns them); `fresh` is static aux data — a
 # fresh (prefill) cache and a decode cache intentionally trace differently
 def _cache_flatten(c):
-    return (c.k._data, c.v._data, c.length), c.fresh
+    return (_raw_leaf(c.k), _raw_leaf(c.v), c.length), c.fresh
+
+
+def _tensor_leaf(x):
+    # flatten/unflatten must round-trip jax's internal placeholder
+    # leaves (e.g. ArgInfo during lower()/AOT) untouched; only real
+    # arrays and tracers get the Tensor wrapper back
+    return Tensor(x) if isinstance(x, jnp.ndarray) else x
+
+
+def _raw_leaf(x):
+    return getattr(x, '_data', x)
 
 
 def _cache_unflatten(fresh, children):
     k, v, length = children
-    return GPTStaticCache(Tensor(k), Tensor(v), length, fresh=fresh)
+    return GPTStaticCache(_tensor_leaf(k), _tensor_leaf(v), length,
+                          fresh=fresh)
 
 
 jax.tree_util.register_pytree_node(GPTStaticCache, _cache_flatten,
@@ -153,12 +165,12 @@ class GPTSlotCache:
 
 
 def _slot_cache_flatten(c):
-    return (c.k._data, c.v._data, c.lengths), None
+    return (_raw_leaf(c.k), _raw_leaf(c.v), c.lengths), None
 
 
 def _slot_cache_unflatten(_, children):
     k, v, lengths = children
-    return GPTSlotCache(Tensor(k), Tensor(v), lengths)
+    return GPTSlotCache(_tensor_leaf(k), _tensor_leaf(v), lengths)
 
 
 jax.tree_util.register_pytree_node(GPTSlotCache, _slot_cache_flatten,
@@ -213,12 +225,12 @@ class GPTPagedCache:
 
 
 def _paged_cache_flatten(c):
-    return (c.k._data, c.v._data, c.block_tables, c.lengths), None
+    return (_raw_leaf(c.k), _raw_leaf(c.v), c.block_tables, c.lengths), None
 
 
 def _paged_cache_unflatten(_, children):
     k, v, bt, lengths = children
-    return GPTPagedCache(Tensor(k), Tensor(v), bt, lengths)
+    return GPTPagedCache(_tensor_leaf(k), _tensor_leaf(v), bt, lengths)
 
 
 jax.tree_util.register_pytree_node(GPTPagedCache, _paged_cache_flatten,

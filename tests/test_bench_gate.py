@@ -88,6 +88,32 @@ def test_latency_metrics_regress_upward():
                             unit='ms')], best) == []   # faster is fine
 
 
+def test_compile_seconds_gate_as_derived_rows():
+    """A row carrying compile_s_cold/compile_s_warm spawns pseudo-rows
+    ('<metric>_compile_s_cold', unit 's') that regress UPWARD, without
+    bucket-splitting the carrier row's own config."""
+    best = [_row(1000.0, compile_s_cold=8.0, compile_s_warm=0.5)]
+    derived = gate.expand_derived(best)
+    metrics = sorted(r['metric'] for r in derived)
+    assert 'train_tokens_per_sec_compile_s_cold' in metrics
+    assert 'train_tokens_per_sec_compile_s_warm' in metrics
+    cold = next(r for r in derived
+                if r['metric'].endswith('_compile_s_cold'))
+    assert cold['value'] == 8.0 and cold['unit'] == 's'
+    assert not gate.higher_is_better(cold)             # time regresses UP
+    # same throughput, 50% slower cold compile -> exactly one finding,
+    # and it is the derived compile row, not the carrier
+    slow = [_row(1000.0, compile_s_cold=12.0, compile_s_warm=0.5)]
+    findings = gate.check(slow, best)
+    assert len(findings) == 1
+    assert findings[0]['metric'] == 'train_tokens_per_sec_compile_s_cold'
+    assert findings[0]['direction'] == 'up'
+    # faster compiles and mfu_est passengers never trip the gate
+    fast = [_row(1000.0, compile_s_cold=4.0, compile_s_warm=0.4,
+                 mfu_est=0.31, roofline_bound='compute')]
+    assert gate.check(fast, best) == []
+
+
 def test_aux_workload_fields_split_configs():
     """Serving-rung rows at different slot counts are different configs
     even though their knob env is identical."""

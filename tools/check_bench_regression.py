@@ -42,7 +42,14 @@ _AUX_CONFIG = ('replicas', 'kill_at', 'policy',
                'trace', 'model', 'scan_steps', 'page_size', 'spec_k',
                'workload')
 
-__all__ = ['eligible', 'config_key', 'higher_is_better', 'check', 'main']
+__all__ = ['eligible', 'config_key', 'higher_is_better', 'expand_derived',
+           'check', 'main']
+
+# row fields that gate as first-class metrics of their own. Synthesized
+# as pseudo-rows ('<metric>_compile_s_cold', unit 's') rather than added
+# to _AUX_CONFIG: an aux field would bucket-split every existing config
+# and orphan the stored bests.
+_DERIVED_KEYS = ('compile_s_cold', 'compile_s_warm')
 
 
 def eligible(row):
@@ -64,10 +71,31 @@ def config_key(row):
 
 
 def higher_is_better(row):
-    """Throughput-style metrics regress DOWN, latency-style regress UP."""
+    """Throughput-style metrics regress DOWN; latency-style and
+    compile-time metrics regress UP."""
     text = '%s %s' % (row.get('metric', ''), row.get('unit', ''))
     return not ('ms' in text.split() or 'latency' in text
-                or text.endswith('_ms'))
+                or text.endswith('_ms') or 'compile' in text)
+
+
+def expand_derived(rows):
+    """rows + pseudo-rows for the derived gate keys: a row carrying
+    compile_s_cold/compile_s_warm also gates those values under
+    '<metric>_compile_s_cold' (unit 's'). mfu_est and the roofline
+    fields stay informational — analytic estimates, not measurements."""
+    out = list(rows)
+    for row in rows:
+        if not row.get('metric') or 'error' in row:
+            continue
+        for key in _DERIVED_KEYS:
+            val = row.get(key)
+            if isinstance(val, (int, float)):
+                derived = dict(row)
+                derived['metric'] = '%s_%s' % (row['metric'], key)
+                derived['value'] = float(val)
+                derived['unit'] = 's'
+                out.append(derived)
+    return out
 
 
 def check(new_rows, baseline_rows, threshold=0.10):
@@ -91,8 +119,8 @@ def check(new_rows, baseline_rows, threshold=0.10):
                 best[key] = row
         return best
 
-    stored = best_by_config(baseline_rows)
-    fresh = best_by_config(new_rows)
+    stored = best_by_config(expand_derived(baseline_rows))
+    fresh = best_by_config(expand_derived(new_rows))
     findings = []
     for key, old in sorted(stored.items()):
         new = fresh.get(key)

@@ -1,0 +1,320 @@
+"""Offline per-step performance report.
+
+Joins the three perf-introspection artifacts a run leaves behind into
+one text report, per config:
+
+  * dryrun/driver telemetry snapshots (`telemetry_snapshot(N)[tag]:`
+    lines, or a plain snapshot JSON) -> compile counts by stage,
+    recompiles after warmup, step-phase means, straggler counts, and
+    the cost-model gauges (MFU / intensity / roofline bound);
+  * flight-recorder dumps (flight_*.json from FlightRecorder.dump) ->
+    the top recompile events with their callsite + shape-signature
+    attribution, and any straggler spans the ring caught;
+  * bench capture JSONL (bench.py / bench_extra.py rows) -> the
+    MFU / roofline / cold-vs-warm compile table;
+  * a Chrome trace (profiler *.trace.json.gz or a host-span trace from
+    monitor.tracing.spans_to_chrome) -> the device roofline summary via
+    tools/profile_analysis when device ops are present, else a
+    host-span time breakdown.
+
+Every section is optional: pass what the run produced.
+
+Usage:
+    python tools/perf_report.py [--snapshot FILE|-] [--flight-dir DIR]
+        [--bench CAPTURE.jsonl ...] [--trace PATH] [--top N]
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# monitor/ is stdlib-only but the package __init__ pulls in jax — load
+# the subpackage without the parent (the check_metrics_snapshot pattern)
+if 'paddle_tpu' not in sys.modules:
+    _pkg = types.ModuleType('paddle_tpu')
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, 'paddle_tpu')]
+    sys.modules['paddle_tpu'] = _pkg
+
+from paddle_tpu.monitor.telemetry import parse_snapshot_lines  # noqa: E402
+
+__all__ = ['snapshot_perf', 'flight_recompiles', 'bench_perf_rows',
+           'report', 'main']
+
+# bench row fields that form the perf table (satellite keys first)
+_BENCH_COLS = ('compile_s_cold', 'compile_s_warm', 'recompiles',
+               'mfu_est', 'arithmetic_intensity', 'roofline_bound')
+
+
+def _sample_value(fam, **labels):
+    """Scalar value of the child matching `labels` (or the unlabeled
+    child) in an export.to_dict family; None when absent."""
+    for s in fam.get('samples', ()):
+        if dict(s.get('labels') or {}) == labels:
+            return s.get('value')
+    return None
+
+
+def _hist_stats(fam, **labels):
+    """(count, mean) of the matching histogram child; None when empty."""
+    for s in fam.get('samples', ()):
+        if dict(s.get('labels') or {}) == labels:
+            n = int(s.get('count') or 0)
+            if not n:
+                return None
+            return n, float(s.get('sum') or 0.0) / n
+    return None
+
+
+def snapshot_perf(snap):
+    """The perf block of one telemetry snapshot dict: {'compiles':
+    {kind: (count, mean_s)}, 'recompiles', 'steps', 'stragglers',
+    'phases': {phase: (count, mean_s)}, 'mfu_est', ...} — only the keys
+    the snapshot actually carries."""
+    out = {}
+    fam = snap.get('perf_compiles_total')
+    hist = snap.get('perf_compile_seconds')
+    if fam:
+        compiles = {}
+        for s in fam.get('samples', ()):
+            kind = (s.get('labels') or {}).get('kind')
+            if kind is None or not s.get('value'):
+                continue
+            stats = _hist_stats(hist, kind=kind) if hist else None
+            compiles[kind] = (int(s['value']),
+                              stats[1] if stats else None)
+        if compiles:
+            out['compiles'] = compiles
+    fam = snap.get('perf_recompiles_total')
+    if fam is not None:
+        out['recompiles'] = int(_sample_value(fam) or 0)
+    for key, name in (('steps', 'perf_steps_total'),
+                      ('stragglers', 'perf_stragglers_total')):
+        fam = snap.get(name)
+        if fam is not None:
+            out[key] = int(_sample_value(fam) or 0)
+    hist = snap.get('perf_step_phase_seconds')
+    if hist:
+        phases = {}
+        for s in hist.get('samples', ()):
+            phase = (s.get('labels') or {}).get('phase')
+            n = int(s.get('count') or 0)
+            if phase and n:
+                phases[phase] = (n, float(s.get('sum') or 0.0) / n)
+        if phases:
+            out['phases'] = phases
+    for key, name in (('mfu_est', 'perf_mfu_est'),
+                      ('arithmetic_intensity',
+                       'perf_arithmetic_intensity'),
+                      ('roofline_bound', 'perf_roofline_bound')):
+        fam = snap.get(name)
+        val = _sample_value(fam) if fam else None
+        if val:
+            out[key] = val
+    return out
+
+
+def flight_recompiles(flight_dir):
+    """All perf.recompile / perf.straggler spans across the dir's
+    flight_*.json dumps, newest dump first."""
+    events = []
+    for path in sorted(glob.glob(os.path.join(flight_dir,
+                                              'flight_*.json')),
+                       reverse=True):
+        try:
+            with open(path, errors='replace') as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for span in payload.get('spans', ()):
+            if span.get('name') in ('perf.recompile', 'perf.straggler'):
+                events.append({'file': os.path.basename(path),
+                               'reason': payload.get('reason'),
+                               'name': span['name'],
+                               'tags': span.get('tags') or {}})
+    return events
+
+
+def bench_perf_rows(paths):
+    """Bench capture rows carrying at least one perf field."""
+    rows = []
+    for path in paths:
+        try:
+            with open(path, errors='replace') as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and row.get('metric') and \
+                    any(k in row for k in _BENCH_COLS):
+                rows.append(row)
+    return rows
+
+
+def _trace_section(path, top, out):
+    """Device roofline via profile_analysis when the trace has XLA ops;
+    host-span breakdown otherwise."""
+    from tools import profile_analysis as pa
+    trace, src = pa.load_trace(path)
+    ops, _ = pa.device_ops(trace)
+    out.append('trace: %s' % src)
+    if ops:
+        rows = pa.aggregate(ops)
+        busy_ms = pa.busy_us(ops) / 1e3
+        out.append('  device XLA ops: %d distinct, %.1f ms busy '
+                   '(interval union)' % (len(rows), busy_ms))
+        ranked = sorted(rows.items(), key=lambda kv: -kv[1]['dur_us'])
+        for name, r in ranked[:top]:
+            out.append('  %-44s %8.2f ms  %s'
+                       % (name[:44], r['dur_us'] / 1e3, r['cat'][:20]))
+        return
+    # host-span trace (spans_to_chrome output): group X events by name
+    agg = {}
+    for e in trace.get('traceEvents', ()):
+        if e.get('ph') != 'X':
+            continue
+        cur = agg.setdefault(e.get('name', '?'), [0, 0.0])
+        cur[0] += 1
+        cur[1] += float(e.get('dur') or 0.0)
+    if not agg:
+        out.append('  (no X events in trace)')
+        return
+    out.append('  host spans (no device lanes in this trace):')
+    for name, (n, dur_us) in sorted(agg.items(),
+                                    key=lambda kv: -kv[1][1])[:top]:
+        out.append('  %-44s %6d x %10.2f ms total'
+                   % (name[:44], n, dur_us / 1e3))
+
+
+def report(snap_text=None, flight_dir=None, bench_paths=(), trace=None,
+           top=10):
+    """Assemble the full text report (list of lines)."""
+    out = []
+    if snap_text:
+        snaps = parse_snapshot_lines(snap_text)
+        if not snaps:
+            # a bare snapshot JSON (export.to_dict) instead of lines
+            try:
+                snaps = {'': json.loads(snap_text)}
+            except ValueError:
+                snaps = {}
+        for tag in sorted(snaps):
+            perf = snapshot_perf(snaps[tag])
+            out.append('config %s:' % (tag or '(unlabeled)'))
+            if not perf:
+                out.append('  no perf families in snapshot')
+                continue
+            for kind, (n, mean) in sorted(
+                    perf.get('compiles', {}).items()):
+                out.append('  compiles[%s]: %d%s'
+                           % (kind, n, '' if mean is None
+                              else ' (mean %.3fs)' % mean))
+            if 'recompiles' in perf:
+                flag = '  <-- steady state violated' \
+                    if perf['recompiles'] else ''
+                out.append('  recompiles after warmup: %d%s'
+                           % (perf['recompiles'], flag))
+            if 'steps' in perf:
+                out.append('  steps: %d  stragglers: %d'
+                           % (perf['steps'], perf.get('stragglers', 0)))
+            for phase, (n, mean) in sorted(
+                    perf.get('phases', {}).items()):
+                out.append('  phase %-14s mean %.6fs over %d steps'
+                           % (phase, mean, n))
+            if 'mfu_est' in perf:
+                out.append('  mfu_est: %.4f' % perf['mfu_est'])
+            if 'arithmetic_intensity' in perf:
+                out.append('  arithmetic_intensity: %.2f flops/byte'
+                           % perf['arithmetic_intensity'])
+            if 'roofline_bound' in perf:
+                out.append('  roofline_bound: %s'
+                           % ('compute' if perf['roofline_bound'] >= 1.0
+                              else 'bandwidth'))
+    if flight_dir:
+        events = flight_recompiles(flight_dir)
+        out.append('flight dumps (%s): %d perf events'
+                   % (flight_dir, len(events)))
+        for ev in events[:top]:
+            tags = ev['tags']
+            if ev['name'] == 'perf.recompile':
+                out.append('  recompile %.3fs at %s'
+                           % (float(tags.get('duration_s') or 0.0),
+                              tags.get('callsite', '?')))
+                if tags.get('signature'):
+                    out.append('    signature: %s'
+                               % str(tags['signature'])[:120])
+            else:
+                out.append('  straggler total=%ss median=%ss (step %s)'
+                           % (tags.get('total_s'), tags.get('median_s'),
+                              tags.get('step')))
+    rows = bench_perf_rows(bench_paths)
+    if rows:
+        out.append('bench perf table (%d rows):' % len(rows))
+        hdr = ('metric',) + _BENCH_COLS
+        out.append('  ' + '  '.join('%-14s' % h for h in hdr))
+        for row in rows:
+            cells = ['%-14s' % str(row['metric'])[:40]]
+            for k in _BENCH_COLS:
+                cells.append('%-14s' % ('' if row.get(k) is None
+                                        else row[k]))
+            out.append('  ' + '  '.join(cells).rstrip())
+    if trace:
+        _trace_section(trace, top, out)
+    if not out:
+        out.append('nothing to report: pass --snapshot, --flight-dir, '
+                   '--bench and/or --trace')
+    return out
+
+
+def _load_snapshot_text(arg):
+    if arg == '-':
+        return sys.stdin.read()
+    with open(arg, errors='replace') as f:
+        text = f.read()
+    # driver captures are JSON with the raw output under 'tail'
+    if arg.endswith('.json') and '"tail"' in text[:200000]:
+        try:
+            return json.loads(text).get('tail', text)
+        except ValueError:
+            pass
+    return text
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--snapshot',
+                    help="telemetry_snapshot text / driver capture "
+                         "JSON / plain snapshot JSON, or '-' (stdin)")
+    ap.add_argument('--flight-dir',
+                    help='directory of FlightRecorder flight_*.json')
+    ap.add_argument('--bench', action='append', default=[],
+                    help='bench capture JSONL (repeatable)')
+    ap.add_argument('--trace',
+                    help='Chrome trace: profiler dir/file or a '
+                         'spans_to_chrome JSON')
+    ap.add_argument('--top', type=int, default=10)
+    args = ap.parse_args(argv)
+
+    snap_text = _load_snapshot_text(args.snapshot) if args.snapshot \
+        else None
+    for line in report(snap_text=snap_text, flight_dir=args.flight_dir,
+                       bench_paths=args.bench, trace=args.trace,
+                       top=args.top):
+        print(line)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

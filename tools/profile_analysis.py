@@ -32,13 +32,32 @@ import os
 import sys
 
 
+def _read_json(path):
+    """Chrome-trace JSON, gzip or plain, judged by content not suffix."""
+    with open(path, 'rb') as f:
+        magic = f.read(2)
+    opener = gzip.open if magic == b'\x1f\x8b' else open
+    with opener(path, 'rb') as f:
+        return json.load(f)
+
+
 def load_trace(profile_dir):
+    """Newest trace under a profile dir — or a trace file given
+    directly. Accepts the profiler's *.trace.json.gz and plain *.json
+    Chrome traces (monitor.tracing.spans_to_chrome output), so the
+    offline tools can join host-span dumps with device profiles."""
+    if os.path.isfile(profile_dir):
+        return _read_json(profile_dir), profile_dir
     paths = sorted(glob.glob(os.path.join(
         profile_dir, '**', '*.trace.json.gz'), recursive=True))
     if not paths:
-        raise SystemExit('no *.trace.json.gz under %s' % profile_dir)
-    with gzip.open(paths[-1]) as f:
-        return json.load(f), paths[-1]
+        paths = sorted(p for p in glob.glob(os.path.join(
+            profile_dir, '**', '*.json'), recursive=True)
+            if p.endswith('.json') and 'trace' in os.path.basename(p))
+    if not paths:
+        raise SystemExit('no *.trace.json.gz (or *trace*.json) under %s'
+                         % profile_dir)
+    return _read_json(paths[-1]), paths[-1]
 
 
 def device_ops(trace):

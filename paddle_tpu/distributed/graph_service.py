@@ -22,11 +22,34 @@ from .resilience import Deadline, ResilientChannel, RetryPolicy
 
 __all__ = ['GraphPyService', 'GraphPyServer', 'GraphPyClient']
 
-_M_GRAPH_CALLS = _monitor_registry().counter(
-    'graph_client_calls_total', 'graph-service client RPCs by op', ('op',))
-_M_GRAPH_ERRORS = _monitor_registry().counter(
-    'graph_client_call_errors_total',
-    'graph-service client RPCs that raised', ('op',))
+# registered through the single-source schema table
+# (monitor/telemetry.py CLIENT_OP_FAMILIES) so the committed metrics
+# baseline and this module cannot drift
+from ..monitor.telemetry import record_client_op_schema \
+    as _record_client_op_schema
+
+_CLIENT_FAMS = _record_client_op_schema(_monitor_registry())
+_M_GRAPH_CALLS = _CLIENT_FAMS['graph_client_calls_total']
+_M_GRAPH_ERRORS = _CLIENT_FAMS['graph_client_call_errors_total']
+
+# Retry semantics of every op _GraphHandler dispatches, declared at the
+# server and enforced against client send sites by graftlint's
+# idempotency checker (tools/graftlint). Same vocabulary as the
+# embedding service's OP_SEMANTICS.
+OP_SEMANTICS = {
+    'stop': 'non_idempotent',           # second delivery hits a dead server
+    'add_edges': 'non_idempotent',      # store appends: resend duplicates
+    'add_nodes': 'idempotent',          # no-op on an existing node
+    'remove_nodes': 'idempotent',       # tombstone: resend re-tombstones
+    'load_edge_file': 'non_idempotent',  # bulk append of the same file
+    'sample_neighbors': 'idempotent',   # pure read
+    'random_sample_nodes': 'idempotent',  # pure read
+    'pull_graph_list': 'idempotent',    # pure read
+    'degree': 'idempotent',             # pure read
+    'set_node_feat': 'idempotent',      # re-writes the same values
+    'get_node_feat': 'idempotent',      # pure read
+    'stats': 'idempotent',              # pure read
+}
 
 
 class _GraphHandler(socketserver.BaseRequestHandler):

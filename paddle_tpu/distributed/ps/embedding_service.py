@@ -28,13 +28,36 @@ __all__ = ['EmbeddingTable', 'EmbeddingServer', 'EmbeddingClient',
            'CountFilterEntry', 'ProbabilityEntry']
 
 # per-op RPC counters (label set is the closed op vocabulary — bounded
-# cardinality; see docs/observability.md)
-_M_PS_CALLS = _monitor_registry().counter(
-    'ps_client_calls_total', 'embedding-service client RPCs by op',
-    ('op',))
-_M_PS_ERRORS = _monitor_registry().counter(
-    'ps_client_call_errors_total',
-    'embedding-service client RPCs that raised', ('op',))
+# cardinality; see docs/observability.md). Registered through the
+# single-source schema table (monitor/telemetry.py CLIENT_OP_FAMILIES)
+# so the committed metrics baseline and this module cannot drift.
+from ...monitor.telemetry import record_client_op_schema \
+    as _record_client_op_schema
+
+_CLIENT_FAMS = _record_client_op_schema(_monitor_registry())
+_M_PS_CALLS = _CLIENT_FAMS['ps_client_calls_total']
+_M_PS_ERRORS = _CLIENT_FAMS['ps_client_call_errors_total']
+
+# Retry semantics of every op _Handler dispatches, declared where the
+# server registers them and enforced against client send sites by
+# graftlint's idempotency checker (tools/graftlint). Vocabulary:
+# idempotent (safe to resend), accumulating (grad-style accumulation —
+# clients must send idempotent=False), conditional (depends on the
+# payload — clients must compute the kwarg), non_idempotent (never
+# blind-resent).
+OP_SEMANTICS = {
+    'pull': 'idempotent',            # pure read
+    'push': 'accumulating',          # optimizer apply accumulates
+    'push_delta': 'accumulating',    # delta merge accumulates
+    'pull_dense': 'idempotent',      # pure read
+    'push_dense': 'accumulating',    # grad apply accumulates
+    'set_dense': 'idempotent',       # last-writer set of the same value
+    'barrier': 'non_idempotent',     # a resend double-arrives a worker
+    'tensor': 'conditional',         # set/get resend safely; increment not
+    'save': 'idempotent',            # rewrites the same shard file
+    'load': 'idempotent',            # reloads the same shard file
+    'stop': 'non_idempotent',        # second delivery hits a dead server
+}
 
 
 class _SparseOptimizer:

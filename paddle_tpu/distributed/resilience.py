@@ -38,32 +38,22 @@ DEFAULT_CONNECT_TIMEOUT = 5.0
 
 
 # -- observability (paddle_tpu/monitor) -------------------------------------
-# Families bind once at import; channels/breakers cache their labeled
-# children at construction, so the per-call cost is one enabled-flag
-# check per event (and nothing at all for events that don't happen).
-_REG = _monitor_registry()
-_M_ATTEMPTS = _REG.counter(
-    'rpc_attempts_total', 'RPC attempts begun (first tries + retries)',
-    ('endpoint',))
-_M_FAILURES = _REG.counter(
-    'rpc_attempt_failures_total',
-    'retryable transport failures (each feeds the circuit breaker)',
-    ('endpoint',))
-_M_BACKOFF = _REG.counter(
-    'rpc_backoff_seconds_total', 'seconds slept between retries',
-    ('endpoint',))
-_M_DEADLINE = _REG.counter(
-    'rpc_deadline_expired_total', 'calls that died on their deadline',
-    ('endpoint',))
-_M_CIRCUIT_REJECT = _REG.counter(
-    'rpc_circuit_open_total', 'calls fast-failed by an open breaker',
-    ('endpoint',))
-_M_TRANSITIONS = _REG.counter(
-    'rpc_breaker_transitions_total', 'circuit-breaker state transitions',
-    ('endpoint', 'to'))
-_M_BREAKER_STATE = _REG.gauge(
-    'rpc_breaker_state', 'current breaker state: 0 closed, 1 open, '
-    '2 half-open', ('endpoint',))
+# Families bind once at import via the single-source schema table
+# (monitor/telemetry.py RPC_FAMILIES — the same table dryrun_registry
+# and the committed schema baseline register); channels/breakers cache
+# their labeled children at construction, so the per-call cost is one
+# enabled-flag check per event (and nothing at all for events that
+# don't happen).
+from ..monitor.telemetry import record_rpc_schema as _record_rpc_schema
+
+_FAMS = _record_rpc_schema(_monitor_registry())
+_M_ATTEMPTS = _FAMS['rpc_attempts_total']
+_M_FAILURES = _FAMS['rpc_attempt_failures_total']
+_M_BACKOFF = _FAMS['rpc_backoff_seconds_total']
+_M_DEADLINE = _FAMS['rpc_deadline_expired_total']
+_M_CIRCUIT_REJECT = _FAMS['rpc_circuit_open_total']
+_M_TRANSITIONS = _FAMS['rpc_breaker_transitions_total']
+_M_BREAKER_STATE = _FAMS['rpc_breaker_state']
 _STATE_CODES = {'closed': 0, 'open': 1, 'half_open': 2}
 
 

@@ -263,7 +263,6 @@ class TelemetryCallback(Callback):
     def __init__(self, registry=None, sample_every=50, clock=None):
         super().__init__()
         from ..monitor import RuntimeSampler, default_registry
-        from ..monitor.registry import exponential_buckets
         r = registry if registry is not None else default_registry()
         self.registry = r
         self.sample_every = int(sample_every)
@@ -274,13 +273,16 @@ class TelemetryCallback(Callback):
         self._m_steps = r.counter('train_steps_total', 'train steps run')
         self._m_examples = r.counter('train_examples_total',
                                      'examples consumed')
-        self._m_step_time = r.histogram(
-            'train_step_duration_seconds', 'train step wall time',
-            buckets=exponential_buckets(0.001, 2.0, 16))
+        # callback-only families come from the single-source schema
+        # table (monitor/telemetry.py TRAIN_LOOP_FAMILIES) so the
+        # committed metrics baseline covers them
+        from ..monitor.telemetry import record_train_loop_schema
+        loop = record_train_loop_schema(r)
+        self._m_step_time = loop['train_step_duration_seconds']
         self._m_eps = r.gauge('train_examples_per_second',
                               'examples/s of the last step')
         self._m_loss = r.gauge('train_loss', 'loss of the last step')
-        self._m_epoch = r.gauge('train_epoch', 'current epoch index')
+        self._m_epoch = loop['train_epoch']
         from ..monitor import tracing as _tracing
         self._tracer = _tracing.default_tracer()
         self._epoch_span = None

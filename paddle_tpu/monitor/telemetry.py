@@ -12,9 +12,11 @@ from .registry import MetricRegistry
 from .runtime import RuntimeSampler
 
 __all__ = ['record_dryrun_step', 'record_serving_schema',
-           'record_gateway_schema', 'record_tracing_schema',
-           'record_perf_schema', 'snapshot_line', 'parse_snapshot_lines',
-           'LINE_RE']
+           'record_serving_request_schema', 'record_gateway_schema',
+           'record_tracing_schema', 'record_perf_schema',
+           'record_rpc_schema', 'record_client_op_schema',
+           'record_train_loop_schema', 'snapshot_line',
+           'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
                      r'\[(?P<tag>[^\]]*)\]:\s*(?P<json>\{.*\})\s*$')
@@ -178,6 +180,135 @@ def record_tracing_schema(registry):
     return tracing.register_metrics(registry)
 
 
+# the per-request serving families (serving/metrics.py + the engines'
+# retrace canary). Same single-source rule: ServingMetrics and the
+# schema baseline both register through record_serving_request_schema.
+# Label budget: program is the engine's closed program set (prefill/
+# decode/verify).
+SERVING_REQUEST_FAMILIES = (
+    ('counter', 'serving_requests_total',
+     'requests submitted to the engine', ()),
+    ('counter', 'serving_requests_admitted_total',
+     'requests bound to a KV slot', ()),
+    ('counter', 'serving_requests_retired_total',
+     'requests finished and released', ()),
+    ('counter', 'serving_tokens_total',
+     'tokens emitted to consumers', ()),
+    ('histogram', 'serving_ttft_seconds',
+     'arrival to first visible token', ()),
+    ('histogram', 'serving_inter_token_seconds',
+     'per-token gap (burst spread over its tokens)', ()),
+    ('gauge', 'serving_queue_depth',
+     'requests waiting for a slot', ()),
+    ('gauge', 'serving_occupancy',
+     'occupied-slot fraction, last step', ()),
+    ('counter', 'serving_prefill_tokens_total',
+     'prompt tokens actually prefilled (prefix-cache hits excluded)', ()),
+    ('gauge', 'serving_trace_count',
+     'times each serving program has been traced '
+     '(flat == zero retrace)', ('program',)),
+)
+
+
+def record_serving_request_schema(registry):
+    """Register the per-request serving families on `registry` and
+    return {name: family}. Used by ServingMetrics at construction and by
+    dryrun_registry so the committed baseline covers the request path."""
+    from .registry import exponential_buckets
+    buckets = {
+        # inter-token gaps live around 1-100 ms on hardware, seconds on
+        # CPU CI; TTFT adds prefill, so its ladder starts higher
+        'serving_ttft_seconds': exponential_buckets(0.002, 2.0, 16),
+        'serving_inter_token_seconds': exponential_buckets(0.0005, 2.0,
+                                                           16),
+    }
+    out = {}
+    for kind, name, doc, labels in SERVING_REQUEST_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            kw['buckets'] = buckets[name]
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
+# the RPC resilience families (distributed/resilience.py). Single-source
+# rule again: ResilientChannel/CircuitBreaker and the schema baseline
+# both register through record_rpc_schema. Label budgets: endpoint is
+# the bounded server set; `to` is the three breaker states.
+RPC_FAMILIES = (
+    ('counter', 'rpc_attempts_total',
+     'RPC attempts begun (first tries + retries)', ('endpoint',)),
+    ('counter', 'rpc_attempt_failures_total',
+     'retryable transport failures (each feeds the circuit breaker)',
+     ('endpoint',)),
+    ('counter', 'rpc_backoff_seconds_total',
+     'seconds slept between retries', ('endpoint',)),
+    ('counter', 'rpc_deadline_expired_total',
+     'calls that died on their deadline', ('endpoint',)),
+    ('counter', 'rpc_circuit_open_total',
+     'calls fast-failed by an open breaker', ('endpoint',)),
+    ('counter', 'rpc_breaker_transitions_total',
+     'circuit-breaker state transitions', ('endpoint', 'to')),
+    ('gauge', 'rpc_breaker_state',
+     'current breaker state: 0 closed, 1 open, 2 half-open',
+     ('endpoint',)),
+)
+
+
+def record_rpc_schema(registry):
+    """Register the RPC resilience families on `registry` and return
+    {name: family}."""
+    out = {}
+    for kind, name, doc, labels in RPC_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc, labels)
+    return out
+
+
+# the per-op client counters of the two socket services. Label budget:
+# op is each service's closed OP_SEMANTICS vocabulary.
+CLIENT_OP_FAMILIES = (
+    ('counter', 'ps_client_calls_total',
+     'embedding-service client RPCs by op', ('op',)),
+    ('counter', 'ps_client_call_errors_total',
+     'embedding-service client RPCs that raised', ('op',)),
+    ('counter', 'graph_client_calls_total',
+     'graph-service client RPCs by op', ('op',)),
+    ('counter', 'graph_client_call_errors_total',
+     'graph-service client RPCs that raised', ('op',)),
+)
+
+
+def record_client_op_schema(registry):
+    """Register the service-client per-op counters on `registry` and
+    return {name: family}."""
+    out = {}
+    for kind, name, doc, labels in CLIENT_OP_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc, labels)
+    return out
+
+
+# the training-loop families hapi.callbacks adds beyond the dryrun step
+# gauges (record_dryrun_step covers the shared names via get-or-create).
+TRAIN_LOOP_FAMILIES = (
+    ('histogram', 'train_step_duration_seconds',
+     'train step wall time', ()),
+    ('gauge', 'train_epoch', 'current epoch index', ()),
+)
+
+
+def record_train_loop_schema(registry):
+    """Register the TelemetryCallback-only training families on
+    `registry` and return {name: family}."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in TRAIN_LOOP_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            kw['buckets'] = exponential_buckets(0.001, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -188,9 +319,13 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     reg = registry if registry is not None else MetricRegistry()
     record_dryrun_step(reg, step_seconds, loss, batch=batch)
     record_serving_schema(reg)
+    record_serving_request_schema(reg)
     record_gateway_schema(reg)
     record_tracing_schema(reg)
     record_perf_schema(reg)
+    record_rpc_schema(reg)
+    record_client_op_schema(reg)
+    record_train_loop_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -157,17 +157,22 @@ class InprocReplica:
 
     def drain(self):
         """Stop admissions, let in-flight decode finish."""
-        self.state = DRAINING
+        self._transition(DRAINING)
         self.engine.shutdown()
-        self.wake()
 
     def mark_dead(self):
-        self.state = DEAD
-        self.wake()
+        self._transition(DEAD)
 
     def mark_stopped(self):
-        self.state = STOPPED
-        self.wake()
+        self._transition(STOPPED)
+
+    def _transition(self, state):
+        """All writes of `state` go through the condvar: the driver
+        thread check-and-sets DRAINING -> STOPPED under _cv, so a bare
+        write here could race it and overwrite DEAD with STOPPED."""
+        with self._cv:
+            self.state = state
+            self._cv.notify_all()
 
     def wake(self):
         with self._cv:

@@ -17,10 +17,10 @@ Two outputs from the same events:
 """
 import time
 
-from ..monitor import exponential_buckets
 from ..monitor import tracing as _tracing
 from ..monitor.registry import default_registry
-from ..monitor.telemetry import record_serving_schema
+from ..monitor.telemetry import (record_serving_schema,
+                                 record_serving_request_schema)
 
 __all__ = ['ServingMetrics', 'percentile']
 
@@ -41,12 +41,6 @@ def percentile(values, q):
     return xs[lo] * (1.0 - frac) + xs[hi] * frac
 
 
-# inter-token gaps live around 1-100 ms on hardware, seconds on CPU CI;
-# TTFT adds prefill, so its ladder starts higher and stretches further
-_GAP_BUCKETS = exponential_buckets(0.0005, 2.0, 16)     # 0.5 ms .. ~16 s
-_TTFT_BUCKETS = exponential_buckets(0.002, 2.0, 16)     # 2 ms .. ~65 s
-
-
 class ServingMetrics:
     def __init__(self, clock=None, registry=None):
         self._clock = clock or time.monotonic
@@ -61,27 +55,19 @@ class ServingMetrics:
         self._tokens = 0
         self._occupancy = []      # per-step occupied-slot fractions
         r = self.registry
-        self._m_requests = r.counter('serving_requests_total',
-                                     'requests submitted to the engine')
-        self._m_admitted = r.counter('serving_requests_admitted_total',
-                                     'requests bound to a KV slot')
-        self._m_retired = r.counter('serving_requests_retired_total',
-                                    'requests finished and released')
-        self._m_tokens = r.counter('serving_tokens_total',
-                                   'tokens emitted to consumers')
-        self._m_ttft = r.histogram('serving_ttft_seconds',
-                                   'arrival to first visible token',
-                                   buckets=_TTFT_BUCKETS)
-        self._m_gap = r.histogram('serving_inter_token_seconds',
-                                  'per-token gap (burst spread over its '
-                                  'tokens)', buckets=_GAP_BUCKETS)
-        self._m_queue = r.gauge('serving_queue_depth',
-                                'requests waiting for a slot')
-        self._m_occupancy = r.gauge('serving_occupancy',
-                                    'occupied-slot fraction, last step')
-        self._m_prefill = r.counter('serving_prefill_tokens_total',
-                                    'prompt tokens actually prefilled '
-                                    '(prefix-cache hits excluded)')
+        # per-request families come from the single-source schema table
+        # (monitor/telemetry.py SERVING_REQUEST_FAMILIES) — the same
+        # table dryrun_registry and the committed baseline register
+        req = record_serving_request_schema(r)
+        self._m_requests = req['serving_requests_total']
+        self._m_admitted = req['serving_requests_admitted_total']
+        self._m_retired = req['serving_requests_retired_total']
+        self._m_tokens = req['serving_tokens_total']
+        self._m_ttft = req['serving_ttft_seconds']
+        self._m_gap = req['serving_inter_token_seconds']
+        self._m_queue = req['serving_queue_depth']
+        self._m_occupancy = req['serving_occupancy']
+        self._m_prefill = req['serving_prefill_tokens_total']
         # paged-engine families; registered unconditionally (zeros for
         # the slot engine) so the scrape schema does not depend on which
         # engine a process happens to run

@@ -1,2 +1,4 @@
-"""Test-support utilities (fault injection lives in testing.chaos)."""
+"""Test-support utilities (fault injection lives in testing.chaos,
+runtime lock-order witnessing in testing.lockwatch)."""
 from . import chaos  # noqa: F401
+from . import lockwatch  # noqa: F401

@@ -1,0 +1,3 @@
+# Known-bad fixture corpus for graftlint: one minimal trigger file per
+# rule, asserted rule-by-rule in tests/test_lint.py. These files are
+# intentionally wrong — never import them from product code.

@@ -1,0 +1,33 @@
+"""Trigger: idem-retry-unsafe + idem-conditional-literal.
+
+The OP_SEMANTICS table here stands in for the server module; the sends
+below violate each declared semantic. The handler dispatch keeps the
+two-way idem-unknown-op rule quiet for these ops.
+"""
+
+OP_SEMANTICS = {
+    'accumulate': 'accumulating',
+    'maybe': 'conditional',
+}
+
+
+def handle(msg):
+    op = msg['op']
+    if op == 'accumulate':
+        return 1
+    elif op == 'maybe':
+        return 2
+
+
+class Client:
+    def __init__(self, channel):
+        self._channel = channel
+
+    def accumulate(self, delta):
+        # accumulating op sent with the retrying default: double-apply
+        return self._channel.call({'op': 'accumulate', 'delta': delta})
+
+    def maybe(self, payload):
+        # conditional op with a constant idempotent=: a lie waiting
+        return self._channel.call({'op': 'maybe', 'p': payload},
+                                  idempotent=True)

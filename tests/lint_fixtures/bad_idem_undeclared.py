@@ -1,0 +1,10 @@
+"""Trigger: idem-undeclared-op (retried op with no OP_SEMANTICS entry)."""
+
+
+class Client:
+    def __init__(self, channel):
+        self._channel = channel
+
+    def mystery(self, key):
+        # retried by default, declared nowhere in this project
+        return self._channel.call({'op': 'mystery_op', 'key': key})

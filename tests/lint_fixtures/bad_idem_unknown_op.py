@@ -1,0 +1,14 @@
+"""Trigger: idem-unknown-op, both directions — the handler dispatches
+an op the table misses, and the table declares an op the handler never
+dispatches."""
+
+OP_SEMANTICS = {
+    'declared_only': 'idempotent',     # stale: never dispatched
+}
+
+
+def handle(msg):
+    op = msg['op']
+    if op == 'dispatched_only':        # handled but undeclared
+        return 1
+    return None

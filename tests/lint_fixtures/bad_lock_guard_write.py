@@ -1,0 +1,22 @@
+"""Trigger: lock-guard-write (guarded attribute written bare).
+
+Also exercises the conventions the checker must honour: writes in
+``__init__`` and in ``*_locked`` methods are fine.
+"""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0          # construction: no finding
+
+    def add(self, n):
+        with self._lock:
+            self.total += n     # establishes: total is lock-guarded
+
+    def _bump_locked(self):
+        self.total += 1         # caller holds the lock: no finding
+
+    def reset(self):
+        self.total = 0          # BARE write of a guarded attribute

@@ -1,0 +1,18 @@
+"""Trigger: lock-order-cycle (same pair of locks, opposite orders)."""
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table_lock = threading.Lock()
+
+    def route(self):
+        with self._lock:
+            with self._table_lock:       # order: _lock -> _table_lock
+                return 1
+
+    def rebuild(self):
+        with self._table_lock:
+            with self._lock:             # order: _table_lock -> _lock
+                return 2

@@ -1,0 +1,13 @@
+"""Trigger: metric-unknown-family + metric-label-arity."""
+
+
+class Worker:
+    def __init__(self, registry):
+        # not in tools/metrics_schema_baseline.json
+        self._m_bogus = registry.counter(
+            'lintfix_bogus_total', 'a family the schema never heard of',
+            ('shard',))
+
+    def tick(self, shard, kind):
+        # family declares one label, call passes two
+        self._m_bogus.labels(shard, kind).inc()

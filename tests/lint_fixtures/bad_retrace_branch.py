@@ -1,0 +1,11 @@
+"""Trigger: retrace-branch (python control flow on a traced value)."""
+import jax
+
+
+@jax.jit
+def decode_step(x, limit):
+    if x > limit:          # traced comparison -> ConcretizationTypeError
+        return x - limit
+    while x < limit:       # traced loop condition
+        x = x + 1
+    return x if x > 0 else -x   # traced ternary

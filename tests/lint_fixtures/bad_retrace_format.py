@@ -1,0 +1,9 @@
+"""Trigger: retrace-format (f-string / str() of a traced value)."""
+import jax
+
+
+@jax.jit
+def step(x):
+    msg = f"x is now {x}"      # implicit host sync to render
+    label = str(x)             # and explicitly
+    return x, msg, label

@@ -1,0 +1,18 @@
+"""Trigger: retrace-host-sync (coercions that pull a traced value to
+host), including taint through an assignment and a same-module helper."""
+import jax
+import numpy as np
+
+
+def _helper(v):
+    return float(v)        # tainted via the call below
+
+
+@jax.jit
+def loss_fn(logits, target):
+    err = logits - target
+    scale = float(err)     # direct coercion
+    n = int(target)        # and again
+    host = np.asarray(err)     # device -> host copy
+    item = err.item()          # forces a sync
+    return _helper(err) + scale + n + host + item

@@ -1,0 +1,24 @@
+"""Trigger: retrace-set-iter (set iteration feeding a trace).
+
+Also exercises the exemptions: `shapes` is static (static_argnames), so
+branching on it is fine, and dict iteration is insertion-ordered so
+`table.items()` must stay quiet — only the set iterations fire.
+"""
+import jax
+
+
+def build(table, shapes):
+    total = 0
+    for _, v in table.items():     # dict views are insertion-ordered: OK
+        total = total + v
+    names = set(shapes)
+    for name in names:             # set order is process-dependent
+        total = total + name
+    for item in {3, 4}:            # set literal iterated directly
+        total = total + item
+    if shapes:                     # static arg: no finding
+        total = total + 1
+    return total
+
+
+build_jit = jax.jit(build, static_argnames='shapes')

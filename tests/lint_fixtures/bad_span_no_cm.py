@@ -1,0 +1,23 @@
+"""Trigger: span-no-cm (leakable tracer spans).
+
+``good`` shows the three accepted shapes: context manager, explicit
+finish, and escape (stored on the request).
+"""
+
+
+def leak_discarded(tracer):
+    tracer.start_span('decode')          # result dropped: leaks open
+
+
+def leak_bound(tracer):
+    span = tracer.start_span('prefill')  # bound but never finished
+    return 1
+
+
+def good(tracer, req):
+    with tracer.start_span('route'):
+        pass
+    s = tracer.server_span('handle', {})
+    s.finish()
+    req._span = tracer.start_span('stream')
+    return req

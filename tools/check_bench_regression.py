@@ -33,6 +33,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:
     sys.path.insert(0, _REPO_ROOT)
 
+from tools import gate_common  # noqa: E402
+
 # auxiliary config fields that distinguish otherwise same-env rows
 # (bench_extra rungs vary these, not the knob env). The paged-serving
 # rung adds page_size/spec_k/workload: a spec-on row must never land in
@@ -176,19 +178,12 @@ def main(argv=None):
     new_rows = _load_jsonl(args.new)
     base_rows = [r for p in baselines for r in _load_jsonl(p)]
     if not new_rows or not base_rows:
-        print(json.dumps({'checked': 0,
-                          'note': 'nothing to compare (new=%d baseline=%d '
-                                  'eligible rows pre-filter)'
-                                  % (len(new_rows), len(base_rows))}))
-        return 2
+        return gate_common.nothing_to_check(
+            'nothing to compare (new=%d baseline=%d eligible rows '
+            'pre-filter)' % (len(new_rows), len(base_rows)))
     findings = check(new_rows, base_rows, threshold=args.threshold)
-    for f in findings:
-        print(json.dumps(dict(f, regression=True)))
-    if not findings:
-        print(json.dumps({'regressions': 0, 'threshold': args.threshold,
-                          'ok': True}))
-        return 0
-    return 1
+    return gate_common.finish(
+        findings, {'regressions': 0, 'threshold': args.threshold})
 
 
 if __name__ == '__main__':

@@ -43,6 +43,7 @@ if 'paddle_tpu' not in sys.modules:
 
 from paddle_tpu.monitor import schema_of  # noqa: E402
 from paddle_tpu.monitor.telemetry import parse_snapshot_lines  # noqa: E402
+from tools import gate_common  # noqa: E402
 
 __all__ = ['union_schema', 'check', 'main']
 
@@ -103,37 +104,31 @@ def main(argv=None):
     text = _load_text(args)
     union, per_tag = union_schema(text)
     if not union:
-        print(json.dumps({'checked': 0,
-                          'note': 'no telemetry_snapshot lines found'}))
-        return 2
+        return gate_common.nothing_to_check(
+            'no telemetry_snapshot lines found')
 
     if args.write_baseline:
         with open(args.baseline, 'w') as f:
             json.dump(union, f, indent=2, sort_keys=True)
             f.write('\n')
-        print(json.dumps({'wrote': args.baseline, 'metrics': len(union)}))
-        return 0
+        gate_common.emit({'wrote': args.baseline, 'metrics': len(union)})
+        return gate_common.OK
 
     if not os.path.exists(args.baseline):
-        print(json.dumps({'checked': 0, 'note': 'no baseline schema'}))
-        return 2
+        return gate_common.nothing_to_check('no baseline schema')
     with open(args.baseline) as f:
         baseline = json.load(f)
 
     findings = check(text, baseline)
-    for f_ in findings:
-        print(json.dumps(dict(f_, regression=True)))
     extra = sorted(set(union) - set(baseline))
-    if not findings:
-        print(json.dumps({'regressions': 0, 'metrics_seen': len(union),
-                          'configs': sorted(per_tag),
-                          'tracing_families': sum(
-                              1 for n in union if n.startswith('trace_')),
-                          'gateway_families': sum(
-                              1 for n in union if n.startswith('gateway_')),
-                          'new_unbaselined': extra, 'ok': True}))
-        return 0
-    return 1
+    return gate_common.finish(findings, {
+        'regressions': 0, 'metrics_seen': len(union),
+        'configs': sorted(per_tag),
+        'tracing_families': sum(
+            1 for n in union if n.startswith('trace_')),
+        'gateway_families': sum(
+            1 for n in union if n.startswith('gateway_')),
+        'new_unbaselined': extra})
 
 
 if __name__ == '__main__':

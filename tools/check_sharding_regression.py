@@ -44,6 +44,7 @@ if _REPO_ROOT not in sys.path:
 
 from paddle_tpu.distributed.auto_parallel import (  # noqa: E402
     ShardingAuditReport, parse_spmd_warnings)
+from tools import gate_common  # noqa: E402
 
 __all__ = ['extract_events', 'check', 'main']
 
@@ -115,20 +116,14 @@ def main(argv=None):
                  if os.path.abspath(p) != os.path.abspath(args.new)]
         baseline = cands[-1] if cands else None
     if baseline is None or not os.path.exists(baseline):
-        print(json.dumps({'checked': 0, 'note': 'no baseline capture'}))
-        return 2
+        return gate_common.nothing_to_check('no baseline capture')
     new_tail = _load_tail(args.new)
     base_tail = _load_tail(baseline)
     n_new = sum(len(v) for v in extract_events(new_tail).values())
     findings = check(new_tail, base_tail)
-    for f in findings:
-        print(json.dumps(dict(f, regression=True)))
-    if not findings:
-        print(json.dumps({'regressions': 0, 'events_seen': n_new,
-                          'baseline': os.path.basename(baseline),
-                          'ok': True}))
-        return 0
-    return 1
+    return gate_common.finish(findings, {
+        'regressions': 0, 'events_seen': n_new,
+        'baseline': os.path.basename(baseline)})
 
 
 if __name__ == '__main__':

@@ -1,0 +1,47 @@
+"""Shared exit-code / JSON-output convention for the repo's CI gates.
+
+Every gate in tools/ (check_bench_regression, check_sharding_regression,
+check_metrics_snapshot, graftlint) speaks the same protocol so CI wires
+them identically:
+
+  exit 0  (OK)      — checked something, no findings; last stdout line is
+                      a JSON summary with ``"ok": true``;
+  exit 1  (FAIL)    — findings; one JSON line per finding, each carrying
+                      ``"regression": true`` (the grep-able marker);
+  exit 2  (NOTHING) — nothing to compare/analyze (missing baseline, empty
+                      input); a JSON note with ``"checked": 0``.
+
+``finish()`` is the whole protocol: hand it the findings and the summary
+fields and return its result from main(). Gates stay pure (their check()
+functions return finding lists) and the I/O convention lives here once.
+"""
+import json
+import sys
+
+__all__ = ['OK', 'FAIL', 'NOTHING', 'emit', 'nothing_to_check', 'finish']
+
+OK = 0
+FAIL = 1
+NOTHING = 2
+
+
+def emit(obj, stream=None):
+    """One JSON object per line on stdout (machine-parseable, append-safe)."""
+    print(json.dumps(obj), file=stream if stream is not None else sys.stdout)
+
+
+def nothing_to_check(note, stream=None, **extra):
+    """Report an empty comparison and return the NOTHING exit code."""
+    emit(dict({'checked': 0, 'note': note}, **extra), stream=stream)
+    return NOTHING
+
+
+def finish(findings, summary=None, stream=None):
+    """Print findings (each marked ``regression: true``) or the ok-summary,
+    and return the exit code for main()."""
+    for f in findings:
+        emit(dict(f, regression=True), stream=stream)
+    if not findings:
+        emit(dict(summary or {}, ok=True), stream=stream)
+        return OK
+    return FAIL

@@ -1,0 +1,30 @@
+"""graftlint: repo-native static analysis (stdlib ``ast``, no new deps).
+
+The runtime guardrails (CompileWatchdog, chaos injection, the schema
+gate) catch invariant violations after they execute; graftlint rejects
+the same bug classes at lint time, the way the reference framework's
+operator registry and IR verification reject bad programs before they
+run. Four checkers, one shared visitor/finding/suppression core:
+
+- ``retrace``     — host-sync and retrace hazards inside jit-reachable
+                    functions (the static complement to the watchdog);
+- ``locks``       — lock-acquisition-order cycles and lock-guarded
+                    attributes written outside any ``with`` block;
+- ``idempotency`` — every op retried through ResilientChannel.call must
+                    be declared retry-safe at its server registration
+                    (whole-program, resolved across modules);
+- ``metrics``     — metric families two-way against the committed schema
+                    baseline, label arity at ``.labels()`` sites, and
+                    tracer spans that can leak.
+
+Run: ``python -m tools.graftlint paddle_tpu tools``; see
+docs/static_analysis.md for the rule catalog and suppression format.
+"""
+from .core import (Finding, Module, Project, Checker, load_baseline,
+                   write_baseline, apply_baseline, run_checkers,
+                   DEFAULT_BASELINE)
+from .checkers import all_checkers
+
+__all__ = ['Finding', 'Module', 'Project', 'Checker', 'load_baseline',
+           'write_baseline', 'apply_baseline', 'run_checkers',
+           'all_checkers', 'DEFAULT_BASELINE']

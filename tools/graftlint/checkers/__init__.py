@@ -1,0 +1,13 @@
+from .retrace import RetraceChecker
+from .locks import LockChecker
+from .idempotency import IdempotencyChecker
+from .metrics import MetricsChecker
+
+__all__ = ['RetraceChecker', 'LockChecker', 'IdempotencyChecker',
+           'MetricsChecker', 'all_checkers']
+
+
+def all_checkers():
+    """Fresh instances of every registered checker."""
+    return [RetraceChecker(), LockChecker(), IdempotencyChecker(),
+            MetricsChecker()]

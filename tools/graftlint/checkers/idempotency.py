@@ -1,0 +1,212 @@
+"""Idempotency checker for retried RPC ops (whole-program).
+
+ResilientChannel.call retries by default (``idempotent=True``): a resend
+of a non-idempotent op double-applies it (``push`` re-accumulates,
+``add_edges`` duplicates edges). The contract this checker enforces:
+every op name a client sends through ``.call``/``._call`` must be
+declared in an ``OP_SEMANTICS`` table at its server module, with one of
+
+- ``idempotent``     — safe to retry (pure reads, set-style writes);
+- ``accumulating``   — an accumulating push; the CLIENT must disable
+                       retries (``idempotent=False``) at every send;
+- ``conditional``    — retry safety depends on the payload; the client
+                       must compute the ``idempotent=`` kwarg (a literal
+                       ``True`` is a lie waiting to happen);
+- ``non_idempotent`` — never retried; client must send with
+                       ``idempotent=False`` or use ``call_once``.
+
+The join is cross-module: tables live in embedding_service.py /
+graph_service.py, send sites live wherever clients are written. Rules:
+
+- idem-undeclared-op      — op sent through a retrying channel but
+                            declared in no OP_SEMANTICS table;
+- idem-retry-unsafe       — op declared accumulating/non_idempotent but
+                            sent with retries enabled;
+- idem-conditional-literal — op declared conditional but the send passes
+                            a constant ``idempotent=``;
+- idem-unknown-op         — server dispatch handles an op missing from
+                            its module's OP_SEMANTICS table, or the
+                            table declares an op the handler never
+                            dispatches (stale entry).
+"""
+import ast
+
+from ..core import Checker
+
+SEMANTICS = ('idempotent', 'accumulating', 'conditional', 'non_idempotent')
+
+
+def _dict_op_literal(node):
+    """The 'op' value when node is a dict literal with a constant op."""
+    if not isinstance(node, ast.Dict):
+        return None
+    for k, v in zip(node.keys, node.values):
+        if (isinstance(k, ast.Constant) and k.value == 'op'
+                and isinstance(v, ast.Constant)
+                and isinstance(v.value, str)):
+            return v.value
+    return None
+
+
+def _op_semantics_tables(project):
+    """{op: (semantics, modname)} merged across every module's
+    OP_SEMANTICS dict, plus per-module tables for the two-way check."""
+    merged, per_module = {}, {}
+    for module in project.modules:
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if 'OP_SEMANTICS' not in names:
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            table = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(v, ast.Constant)
+                        and isinstance(k.value, str)):
+                    table[k.value] = str(v.value)
+            per_module[module.modname] = (module, node, table)
+            for op, sem in table.items():
+                merged.setdefault(op, (sem, module.modname))
+    return merged, per_module
+
+
+def _dispatched_ops(module):
+    """Op literals the module's server handler dispatches on: string
+    constants compared against a name/subscript called 'op'."""
+    ops = {}
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        sides = [node.left] + list(node.comparators)
+        if not any(_is_op_ref(s) for s in sides):
+            continue
+        for s in sides:
+            if isinstance(s, ast.Constant) and isinstance(s.value, str):
+                ops.setdefault(s.value, node)
+            elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                for e in s.elts:
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)):
+                        ops.setdefault(e.value, node)
+    return ops
+
+
+def _is_op_ref(node):
+    if isinstance(node, ast.Name) and node.id == 'op':
+        return True
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and node.slice.value == 'op'):
+        return True
+    return False
+
+
+class IdempotencyChecker(Checker):
+    name = 'idempotency'
+    RULES = {
+        'idem-undeclared-op': 'op sent through a retrying channel but not '
+                              'declared in any OP_SEMANTICS table',
+        'idem-retry-unsafe': 'op declared accumulating/non_idempotent sent '
+                             'with retries enabled',
+        'idem-conditional-literal': 'op declared conditional sent with a '
+                                    'constant idempotent= kwarg',
+        'idem-unknown-op': 'server dispatch and OP_SEMANTICS table '
+                           'disagree (two-way)',
+    }
+
+    def check(self, project):
+        out = []
+        declared, per_module = _op_semantics_tables(project)
+
+        # -- server side: table <-> dispatch, both directions ---------------
+        for modname, (module, table_node, table) in per_module.items():
+            dispatched = _dispatched_ops(module)
+            for op, sem in sorted(table.items()):
+                if sem not in SEMANTICS:
+                    self.finding(
+                        module, table_node, 'idem-unknown-op',
+                        "OP_SEMANTICS['%s'] = '%s' is not one of %s"
+                        % (op, sem, '/'.join(SEMANTICS)), out)
+                if op not in dispatched:
+                    self.finding(
+                        module, table_node, 'idem-unknown-op',
+                        "OP_SEMANTICS declares '%s' but the handler never "
+                        'dispatches it (stale entry)' % op, out)
+            for op, node in sorted(dispatched.items()):
+                if op not in table:
+                    self.finding(
+                        module, node, 'idem-unknown-op',
+                        "handler dispatches op '%s' but OP_SEMANTICS does "
+                        'not declare its retry semantics' % op, out)
+
+        # -- client side: every retried send joins against the tables -------
+        for module in project.modules:
+            self._scan_sends(module, declared, out)
+        return out
+
+    def _scan_sends(self, module, declared, out):
+        for fn in [n for n in ast.walk(module.tree)
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            # local `msg = {'op': ...}` bindings visible to later sends
+            msg_ops = {}
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    op = _dict_op_literal(node.value)
+                    if op:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                msg_ops[tgt.id] = op
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (isinstance(f, ast.Attribute)
+                        and f.attr in ('call', '_call')):
+                    continue
+                op = None
+                for arg in node.args:
+                    op = _dict_op_literal(arg)
+                    if op is None and isinstance(arg, ast.Name):
+                        op = msg_ops.get(arg.id)
+                    if op:
+                        break
+                if op is None:
+                    continue
+                self._judge_send(module, node, op, declared, out)
+
+    def _judge_send(self, module, node, op, declared, out):
+        idem_kw = None
+        for kw in node.keywords:
+            if kw.arg == 'idempotent':
+                idem_kw = kw.value
+        if isinstance(idem_kw, ast.Constant):
+            retries = bool(idem_kw.value)
+            literal = True
+        elif idem_kw is None:
+            retries = True      # channel default
+            literal = True
+        else:
+            retries = True      # computed: assume it can be True
+            literal = False
+        if op not in declared:
+            self.finding(
+                module, node, 'idem-undeclared-op',
+                "op '%s' is sent through a retrying channel but no "
+                'OP_SEMANTICS table declares its retry semantics' % op,
+                out)
+            return
+        sem = declared[op][0]
+        if sem in ('accumulating', 'non_idempotent') and retries and literal:
+            self.finding(
+                module, node, 'idem-retry-unsafe',
+                "op '%s' is declared %s in %s but sent with retries "
+                'enabled; pass idempotent=False or use call_once'
+                % (op, sem, declared[op][1]), out)
+        elif sem == 'conditional' and literal:
+            self.finding(
+                module, node, 'idem-conditional-literal',
+                "op '%s' is declared conditional in %s but sent with a "
+                'constant (or defaulted) idempotent=; compute it from '
+                'the payload' % (op, declared[op][1]), out)

@@ -1,0 +1,314 @@
+"""Lock-discipline checker: acquisition-order cycles and unguarded writes.
+
+Lock discovery is per class (``self._lock = threading.Lock()/RLock()/
+Condition()``) plus module-level locks (``_TRACE_LOCK = threading.Lock()``).
+Within each method a lexical walk tracks the ordered set of locks held
+(``with self._lock:`` nesting); the repo's ``*_locked`` method-name
+convention (caller holds the class's primary lock) is honoured, and
+private methods whose every same-class call site holds lock L are
+treated as entered with L held (small fixpoint).
+
+Rules:
+
+- lock-order-cycle — ``with A: ... with B:`` here and ``with B: ...
+  with A:`` elsewhere; deadlock when both paths race;
+- lock-guard-write — an attribute written under the class lock in one
+  method and written bare in another (the classic lost-update /
+  torn-read race).
+
+``acquisition_order(project)`` exposes the derived edges so the runtime
+witness (paddle_tpu/testing/lockwatch.py) can assert the same order at
+execution time.
+"""
+import ast
+
+from ..core import Checker
+
+_LOCK_CTORS = {'Lock', 'RLock', 'Condition', 'Semaphore', 'BoundedSemaphore'}
+_LOCKISH_ATTRS = ('_lock', '_cv', '_mu', '_mutex', '_cond')
+
+
+def _is_lock_ctor(node):
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _LOCK_CTORS
+    if isinstance(f, ast.Attribute):
+        return f.attr in _LOCK_CTORS
+    return False
+
+
+def _self_attr(node):
+    """'attr' when node is ``self.attr`` else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, module, node):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods = {m.name: m for m in node.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.locks = set()          # attr names holding lock objects
+        for m in self.methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign) and _is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            self.locks.add(attr)
+        # fallback: `with self.X:` on a lock-ish name counts as a lock
+        # even when the assignment lives in a helper we didn't see
+        for m in self.methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr and (attr in _LOCKISH_ATTRS
+                                     or attr.endswith('lock')):
+                            self.locks.add(attr)
+
+    def primary_lock(self):
+        if '_lock' in self.locks:
+            return '_lock'
+        return sorted(self.locks)[0] if self.locks else None
+
+    def lock_id(self, attr):
+        return '%s:%s.%s' % (self.module.modname, self.name, attr)
+
+
+class _MethodWalk:
+    """One lexical pass over a method with an ordered held-lock list."""
+
+    def __init__(self, cls, method, entry_held, module_locks, collect):
+        self.cls = cls
+        self.method = method
+        self.module_locks = module_locks   # {name: lock_id}
+        self.collect = collect             # final pass sink or None
+        self.calls = []                    # (callee_name, frozenset(held))
+        self.entry_held = list(entry_held)
+
+    def lock_id_of(self, expr):
+        attr = _self_attr(expr)
+        if attr is not None and attr in self.cls.locks:
+            return self.cls.lock_id(attr)
+        if isinstance(expr, ast.Name) and expr.id in self.module_locks:
+            return self.module_locks[expr.id]
+        return None
+
+    def run(self):
+        self._walk(self.method.body, self.entry_held)
+
+    def _walk(self, stmts, held):
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                acquired = []
+                for item in stmt.items:
+                    lid = self.lock_id_of(item.context_expr)
+                    if lid is None:
+                        continue
+                    if self.collect is not None:
+                        for h in held + acquired:
+                            if h != lid:
+                                self.collect.edge(h, lid, self.cls.module,
+                                                  item.context_expr)
+                    acquired.append(lid)
+                self._scan_exprs([i.context_expr for i in stmt.items], held)
+                self._walk(stmt.body, held + acquired)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs (thread targets, callbacks) run on their
+                # own schedule: walk them as a separate bare-entry
+                # context, not under the lexically-held locks
+                sub = _MethodWalk(self.cls, stmt, [], self.module_locks,
+                                  self.collect)
+                sub.run()
+                self.calls.extend(sub.calls)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)):
+                self._scan_exprs([stmt.test], held)
+            elif isinstance(stmt, ast.For):
+                self._scan_exprs([stmt.iter], held)
+            elif isinstance(stmt, ast.Try):
+                pass
+            else:
+                self._scan_exprs([stmt], held)
+            for field in ('body', 'orelse', 'finalbody'):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk(sub, held)
+            for handler in getattr(stmt, 'handlers', None) or []:
+                self._walk(handler.body, held)
+
+    def _scan_exprs(self, nodes, held):
+        held_fs = frozenset(held)
+        for root in nodes:
+            for sub in ast.walk(root):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue
+                if isinstance(sub, (ast.Assign, ast.AugAssign,
+                                    ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for tgt in targets:
+                        attr = _self_attr(tgt)
+                        if attr and attr not in self.cls.locks:
+                            if self.collect is not None:
+                                self.collect.write(
+                                    self.cls, self.method.name, attr,
+                                    held_fs, tgt)
+                elif isinstance(sub, ast.Call):
+                    callee = _self_attr(sub.func)
+                    if callee and callee in self.cls.methods:
+                        self.calls.append((callee, held_fs))
+
+
+class _Collector:
+    def __init__(self):
+        self.edges = {}    # (a, b) -> (module, node)
+        self.writes = []   # (cls, method_name, attr, held_fs, node)
+
+    def edge(self, a, b, module, node):
+        self.edges.setdefault((a, b), (module, node))
+
+    def write(self, cls, method_name, attr, held_fs, node):
+        self.writes.append((cls, method_name, attr, held_fs, node))
+
+
+def _entry_held_map(cls, module_locks):
+    """Fixpoint: {method_name: set(lock_ids)} held on entry."""
+    entry = {}
+    primary = cls.primary_lock()
+    for name in cls.methods:
+        if name.endswith('_locked') and primary:
+            entry[name] = {cls.lock_id(primary)}
+        else:
+            entry[name] = set()
+    for _ in range(3):
+        call_held = {}   # callee -> list of frozensets
+        for name, method in cls.methods.items():
+            walk = _MethodWalk(cls, method, entry[name], module_locks, None)
+            walk.run()
+            for callee, held in walk.calls:
+                call_held.setdefault(callee, []).append(held)
+        changed = False
+        for name in cls.methods:
+            if not name.startswith('_') or name.startswith('__'):
+                continue   # public API: assume bare entry
+            sites = call_held.get(name)
+            if not sites:
+                continue
+            common = set(sites[0])
+            for s in sites[1:]:
+                common &= s
+            new = entry[name] | common
+            if new != entry[name]:
+                entry[name] = new
+                changed = True
+        if not changed:
+            break
+    return entry
+
+
+def acquisition_order(project):
+    """[(lock_a, lock_b, relpath, lineno)] derived acquisition edges."""
+    collect = _run(project)
+    return sorted((a, b, mod.relpath, node.lineno)
+                  for (a, b), (mod, node) in collect.edges.items())
+
+
+def _run(project):
+    collect = _Collector()
+    for module in project.modules:
+        module_locks = {}
+        for node in module.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        module_locks[tgt.id] = '%s:%s' % (module.modname,
+                                                          tgt.id)
+        for node in module.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassInfo(module, node)
+            if not cls.locks:
+                continue
+            entry = _entry_held_map(cls, module_locks)
+            for name, method in cls.methods.items():
+                _MethodWalk(cls, method, entry[name], module_locks,
+                            collect).run()
+    return collect
+
+
+class LockChecker(Checker):
+    name = 'locks'
+    RULES = {
+        'lock-order-cycle': 'two code paths acquire the same pair of locks '
+                            'in opposite orders',
+        'lock-guard-write': 'attribute written under the class lock in one '
+                            'method and bare in another',
+    }
+
+    def check(self, project):
+        collect = _run(project)
+        self.order_edges = sorted(collect.edges)
+        out = []
+
+        # -- cycles ---------------------------------------------------------
+        adj = {}
+        for a, b in collect.edges:
+            adj.setdefault(a, set()).add(b)
+
+        def reachable(src, dst):
+            seen, stack = set(), [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(adj.get(n, ()))
+            return False
+
+        reported = set()
+        for (a, b), (module, node) in sorted(collect.edges.items()):
+            if a != b and reachable(b, a):
+                key = frozenset((a, b))
+                if key in reported:
+                    continue
+                reported.add(key)
+                self.finding(
+                    module, node, 'lock-order-cycle',
+                    'acquisition-order cycle: %s held while acquiring %s '
+                    'here, but a path also orders %s before %s' % (a, b,
+                                                                   b, a),
+                    out)
+
+        # -- guarded writes -------------------------------------------------
+        guarded = {}   # (module, class, attr) -> set(lock_ids)
+        for cls, method_name, attr, held, node in collect.writes:
+            if method_name == '__init__' or not held:
+                continue
+            guarded.setdefault((cls.module.modname, cls.name, attr),
+                               set()).update(held)
+        for cls, method_name, attr, held, node in collect.writes:
+            if method_name == '__init__' or held:
+                continue
+            locks = guarded.get((cls.module.modname, cls.name, attr))
+            if not locks:
+                continue
+            self.finding(
+                cls.module, node, 'lock-guard-write',
+                'self.%s is written under %s elsewhere but written here '
+                'without it (in %s.%s)' % (attr, '/'.join(sorted(locks)),
+                                           cls.name, method_name),
+                out)
+        return out

@@ -1,0 +1,274 @@
+"""Metric-schema and span-hygiene checker.
+
+The committed schema (tools/metrics_schema_baseline.json) is the
+contract consumers scrape against; code and schema must agree BOTH
+ways:
+
+- metric-unknown-family — code registers a family (``registry.counter/
+  gauge/histogram('name', ...)`` or a ``*_FAMILIES`` table entry) whose
+  name is not in the schema baseline;
+- metric-stale-family   — the baseline lists a family no code registers
+  any more (only checked when the project includes the telemetry module,
+  so fixture runs don't drown in repo-wide noise);
+- metric-label-arity    — a ``fam.labels(...)`` call passes a different
+  number of label values than the family declared (registry raises at
+  runtime; this catches it at lint time);
+- span-no-cm            — ``tracer.start_span()/server_span()`` result
+  discarded or bound to a local that is never entered/finished/escaped
+  (the span leaks open and poisons the flight recorder).
+"""
+import ast
+import json
+import os
+import re
+
+from ..core import Checker, Finding, REPO_ROOT
+
+DEFAULT_SCHEMA = os.path.join(REPO_ROOT, 'tools',
+                              'metrics_schema_baseline.json')
+ANCHOR_MODULE = 'paddle_tpu.monitor.telemetry'
+
+_NAME_RE = re.compile(r'^[a-z][a-z0-9_]*_[a-z0-9_]*$')
+_REG_METHODS = ('counter', 'gauge', 'histogram')
+_SPAN_OPENERS = ('start_span', 'server_span')
+
+
+def _str_tuple(node):
+    """('a', 'b') when node is a tuple/list of str constants, else None."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        elts = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant) and isinstance(e.value, str)):
+                return None
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _registration_sites(module):
+    """[(name, labels_or_None, node)] family registrations in a module:
+    registry method calls plus *_FAMILIES table entries."""
+    sites = []
+    for node in ast.walk(module.tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _REG_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and _NAME_RE.match(node.args[0].value)):
+            labels = ()
+            for kw in node.keywords:
+                if kw.arg in ('labels', 'labelnames'):
+                    labels = _str_tuple(kw.value)
+            for arg in node.args[1:]:
+                got = _str_tuple(arg)
+                if got is not None:
+                    labels = got
+            sites.append((node.args[0].value, labels, node))
+        elif isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not any(n.endswith('_FAMILIES') for n in names):
+                continue
+            if not isinstance(node.value, (ast.Tuple, ast.List)):
+                continue
+            for entry in node.value.elts:
+                if not isinstance(entry, (ast.Tuple, ast.List)):
+                    continue
+                # entries are (kind, name, help[, labels]): the family
+                # name is the first metric-shaped string that is not a
+                # registry kind keyword
+                fam, at = None, 0
+                for i, e in enumerate(entry.elts):
+                    if (isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)
+                            and e.value not in _REG_METHODS
+                            and _NAME_RE.match(e.value)):
+                        fam, at = e.value, i
+                        break
+                if fam is None:
+                    continue
+                labels = ()
+                for e in entry.elts[at + 1:]:
+                    got = _str_tuple(e)
+                    if got is not None:
+                        labels = got
+                sites.append((fam, labels, entry))
+    return sites
+
+
+def _parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+class MetricsChecker(Checker):
+    name = 'metrics'
+    RULES = {
+        'metric-unknown-family': 'code registers a metric family missing '
+                                 'from the schema baseline',
+        'metric-stale-family': 'the schema baseline lists a family no code '
+                               'registers',
+        'metric-label-arity': '.labels(...) call disagrees with the '
+                              'declared label set',
+        'span-no-cm': 'tracer span opened without context manager, finish, '
+                      'or escape',
+    }
+
+    def __init__(self, schema_path=DEFAULT_SCHEMA):
+        self.schema_path = schema_path
+
+    def _load_schema(self):
+        if not os.path.exists(self.schema_path):
+            return {}
+        with open(self.schema_path) as fh:
+            data = json.load(fh)
+        fams = data.get('families', data)
+        out = {}
+        for name, entry in fams.items():
+            labels = tuple(entry.get('labels', ())) \
+                if isinstance(entry, dict) else ()
+            out[name] = labels
+        return out
+
+    def check(self, project):
+        out = []
+        schema = self._load_schema()
+        registered = {}                  # name -> (labels, module, node)
+        for module in project.modules:
+            for name, labels, node in _registration_sites(module):
+                registered.setdefault(name, (labels, module, node))
+                if name not in schema:
+                    self.finding(
+                        module, node, 'metric-unknown-family',
+                        "metric family '%s' is not in %s; add it via "
+                        'tools/check_metrics_snapshot.py --write-baseline '
+                        'after registering it in the dryrun schema'
+                        % (name, os.path.relpath(self.schema_path,
+                                                 REPO_ROOT)), out)
+                elif labels is not None and tuple(labels) != schema[name]:
+                    self.finding(
+                        module, node, 'metric-label-arity',
+                        "metric family '%s' declares labels %r but the "
+                        'schema baseline says %r'
+                        % (name, tuple(labels), schema[name]), out)
+            self._check_label_calls(module, registered, schema, out)
+            self._check_spans(module, out)
+
+        if ANCHOR_MODULE in project.by_modname:
+            rel = os.path.relpath(self.schema_path, REPO_ROOT)
+            for name in sorted(set(schema) - set(registered)):
+                out.append(Finding(
+                    'metric-stale-family', rel.replace(os.sep, '/'), 1,
+                    "schema baseline lists '%s' but no code registers it"
+                    % name, symbol=name))
+        return out
+
+    # -- label arity at .labels() sites -------------------------------------
+
+    def _check_label_calls(self, module, registered, schema, out):
+        # map local/self names to family names within this module
+        fam_of = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            fam = None
+            v = node.value
+            if (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in _REG_METHODS
+                    and v.args and isinstance(v.args[0], ast.Constant)
+                    and isinstance(v.args[0].value, str)):
+                fam = v.args[0].value
+            elif (isinstance(v, ast.Subscript)
+                  and isinstance(v.slice, ast.Constant)
+                  and isinstance(v.slice.value, str)
+                  and _NAME_RE.match(str(v.slice.value))):
+                fam = v.slice.value
+            if fam is None:
+                continue
+            for tgt in node.targets:
+                key = self._ref_key(tgt)
+                if key:
+                    fam_of[key] = fam
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == 'labels'):
+                continue
+            key = self._ref_key(node.func.value)
+            fam = fam_of.get(key)
+            if fam is None:
+                continue
+            declared = schema.get(fam)
+            if declared is None and fam in registered:
+                declared = registered[fam][0]
+            if declared is None:
+                continue
+            got = len(node.args) + len(node.keywords)
+            if got != len(declared):
+                self.finding(
+                    module, node, 'metric-label-arity',
+                    ".labels() on '%s' passes %d value(s) but the family "
+                    'declares %d label(s) %r'
+                    % (fam, got, len(declared), tuple(declared)), out)
+
+    def _ref_key(self, node):
+        if isinstance(node, ast.Name):
+            return node.id
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == 'self'):
+            return 'self.' + node.attr
+        return None
+
+    # -- span hygiene --------------------------------------------------------
+
+    def _check_spans(self, module, out):
+        parents = _parent_map(module.tree)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SPAN_OPENERS):
+                continue
+            parent = parents.get(node)
+            if isinstance(parent, ast.withitem):
+                continue
+            if isinstance(parent, ast.Expr):
+                self.finding(
+                    module, node, 'span-no-cm',
+                    '%s() result discarded: the span is opened and can '
+                    'never be finished; use `with` or keep a handle'
+                    % node.func.attr, out)
+                continue
+            if (isinstance(parent, ast.Assign) and len(parent.targets) == 1
+                    and isinstance(parent.targets[0], ast.Name)):
+                name = parent.targets[0].id
+                fn = self._enclosing_fn(parents, node)
+                if fn is not None and not self._name_escapes(fn, name,
+                                                             parent):
+                    self.finding(
+                        module, node, 'span-no-cm',
+                        "span bound to '%s' is never entered, finished, "
+                        'or handed off; it leaks open' % name, out)
+
+    def _enclosing_fn(self, parents, node):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    def _name_escapes(self, fn, name, binding):
+        """True when `name` is used anywhere beyond its binding statement
+        (entered, finished, returned, passed along, re-stored...)."""
+        binding_names = {id(n) for t in binding.targets
+                         for n in ast.walk(t)}
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Name) and node.id == name
+                    and id(node) not in binding_names):
+                return True
+        return False

@@ -1,0 +1,407 @@
+"""Retrace / host-sync hazard checker.
+
+Static complement to the CompileWatchdog (PR 9): the watchdog notices a
+steady-state recompile *after* it burned a compile; this checker flags
+the code shapes that cause them before the test suite ever runs.
+
+Entry points are jit-reachable functions, discovered three ways:
+
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs,
+- ``jax.jit(fn, ...)`` call sites where ``fn`` resolves to a local def
+  or a ``self._method`` of the enclosing class,
+- inner defs of a jit function (``lax.scan`` bodies and friends), whose
+  parameters are traced by construction.
+
+Within an entry point the non-static parameters are the taint roots
+(``static_argnums``/``static_argnames`` are honoured); taint propagates
+through simple assignments and same-module calls whose arguments carry
+taint. Rules:
+
+- retrace-branch     — Python ``if``/``while``/ternary/``assert``/loop
+                       bound on a traced value (concretization error or
+                       per-value retrace);
+- retrace-host-sync  — ``float()``/``int()``/``bool()``/``np.asarray()``
+                       /``.item()``/``.tolist()`` on a traced value
+                       (blocks dispatch, syncs the device);
+- retrace-format     — f-string / ``format()`` / ``str()`` of a traced
+                       value (implicit host sync for logging);
+- retrace-set-iter   — iterating a ``set``/``dict`` where order feeds
+                       shapes or argument order (nondeterministic cache
+                       keys across processes).
+"""
+import ast
+
+from ..core import Checker
+
+_COERCIONS = {'float', 'int', 'bool'}
+_NP_COERCIONS = {'asarray', 'array', 'asanyarray'}
+_SYNC_METHODS = {'item', 'tolist', 'numpy'}
+_ORDER_SINKS = {'reshape', 'stack', 'concatenate', 'zip'}
+
+
+def _is_jit_expr(node):
+    """True for ``jax.jit`` / ``jit`` / ``pjit`` expression nodes."""
+    if isinstance(node, ast.Attribute):
+        return node.attr in ('jit', 'pjit')
+    if isinstance(node, ast.Name):
+        return node.id in ('jit', 'pjit')
+    return False
+
+
+def _jit_static_names(call, func_node):
+    """Parameter names excluded from tracing by static_argnums/argnames
+    of a ``jax.jit(...)`` Call (or None when not a Call)."""
+    static = set()
+    if not isinstance(call, ast.Call):
+        return static
+    args = [a.arg for a in func_node.args.posonlyargs + func_node.args.args]
+    for kw in call.keywords:
+        val = kw.value
+        if kw.arg == 'static_argnums':
+            nums = []
+            if isinstance(val, ast.Constant) and isinstance(val.value, int):
+                nums = [val.value]
+            elif isinstance(val, (ast.Tuple, ast.List)):
+                nums = [e.value for e in val.elts
+                        if isinstance(e, ast.Constant)]
+            for n in nums:
+                if isinstance(n, int) and 0 <= n < len(args):
+                    static.add(args[n])
+        elif kw.arg == 'static_argnames':
+            if isinstance(val, ast.Constant) and isinstance(val.value, str):
+                static.add(val.value)
+            elif isinstance(val, (ast.Tuple, ast.List)):
+                static.update(e.value for e in val.elts
+                              if isinstance(e, ast.Constant))
+    return static
+
+
+def _local_defs(module):
+    """{name: FunctionDef} for defs visible by bare name anywhere in the
+    module (module level AND nested — jit entry points are commonly
+    `jax.jit(pure_step)` on a closure-local def), plus
+    {('ClassName', name): FunctionDef} for methods."""
+    flat, methods = {}, {}
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods[(node.name, sub.name)] = sub
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            flat.setdefault(node.name, node)
+    return flat, methods
+
+
+def _find_entries(module, flat, methods):
+    """[(func_node, static_param_names)] jit-entry functions."""
+    entries = []
+    seen = set()
+
+    def add(fn, static):
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            entries.append((fn, static))
+
+    class_of = {}
+    for (cls, name), fn in methods.items():
+        class_of[id(fn)] = cls
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _is_jit_expr(target):
+                    add(node, _jit_static_names(dec, node))
+                elif (isinstance(dec, ast.Call)
+                      and isinstance(dec.func, (ast.Name, ast.Attribute))
+                      and getattr(dec.func, 'id',
+                                  getattr(dec.func, 'attr', '')) == 'partial'
+                      and dec.args and _is_jit_expr(dec.args[0])):
+                    add(node, _jit_static_names(dec, node))
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            if not node.args:
+                continue
+            fn_expr = node.args[0]
+            target = None
+            if isinstance(fn_expr, ast.Name):
+                target = flat.get(fn_expr.id)
+            elif (isinstance(fn_expr, ast.Attribute)
+                  and isinstance(fn_expr.value, ast.Name)
+                  and fn_expr.value.id == 'self'):
+                # jax.jit(self._decode_fn): resolve within any class that
+                # defines the method — module-local, best effort
+                for (cls, name), fn in methods.items():
+                    if name == fn_expr.attr:
+                        target = fn
+                        break
+            if target is not None:
+                add(target, _jit_static_names(node, target))
+    return entries
+
+
+def _expr_names(node):
+    names = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            names.add(n.id)
+    return names
+
+
+class _FnScan(ast.NodeVisitor):
+    """Walk one jit-reachable function with a tainted-name set."""
+
+    def __init__(self, checker, module, fn, tainted, out, queue):
+        self.checker = checker
+        self.module = module
+        self.fn = fn
+        self.tainted = set(tainted)
+        self.setish = set()        # names bound to set()/dict.keys() etc.
+        self.out = out
+        self.queue = queue         # callee worklist: (fn_node, tainted)
+
+    def hot(self, node):
+        return bool(_expr_names(node) & self.tainted)
+
+    def hot_test(self, node):
+        """Like hot(), but ignores trace-STABLE uses of traced values:
+        identity/membership comparisons (`x is not None`, `k in ref`)
+        and introspection calls (isinstance/hasattr/len...) never
+        concretize a tracer, so branching on them is fine."""
+        stable = set()
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Compare)
+                    and all(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                            ast.NotIn))
+                            for op in sub.ops)):
+                stable.update(id(n) for n in ast.walk(sub))
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Name)
+                  and sub.func.id in ('isinstance', 'hasattr', 'callable',
+                                      'len', 'getattr', 'type', 'id')):
+                stable.update(id(n) for n in ast.walk(sub))
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.tainted and id(sub) not in stable):
+                return True
+        return False
+
+    def run(self):
+        for stmt in self.fn.body:
+            self.visit(stmt)
+
+    # -- taint propagation --------------------------------------------------
+
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        hot = self.hot(node.value)
+        setish = self._is_setish(node.value)
+        for tgt in node.targets:
+            for n in ast.walk(tgt):
+                if isinstance(n, ast.Name):
+                    if hot:
+                        self.tainted.add(n.id)
+                    else:
+                        self.tainted.discard(n.id)
+                    if setish:
+                        self.setish.add(n.id)
+                    else:
+                        self.setish.discard(n.id)
+
+    def _is_setish(self, node):
+        # dict views are NOT here: python dicts iterate in insertion
+        # order, which is trace-stable — only set hash order varies
+        # across processes
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in ('set', 'frozenset'):
+                return True
+        return False
+
+    # -- rules --------------------------------------------------------------
+
+    def _branch(self, test, what):
+        if self.hot_test(test):
+            self.checker.finding(
+                self.module, test, 'retrace-branch',
+                'python %s on traced value (%s) inside jit-reachable '
+                '%s; use lax.cond/lax.select or hoist to host'
+                % (what, ', '.join(sorted(_expr_names(test)
+                                          & self.tainted)), self.fn.name),
+                self.out)
+
+    def visit_If(self, node):
+        self._branch(node.test, 'branch')
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._branch(node.test, 'loop condition')
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._branch(node.test, 'ternary')
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        self._branch(node.test, 'assert')
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == 'range' and self.hot_test(it)):
+            self._branch(it, 'loop bound')
+        self._check_set_iter(it)
+        # loop variable inherits iterable's taint — but dict KEYS are
+        # static strings in a pytree, only the values are tracers
+        if self.hot(it):
+            view = (it.func.attr
+                    if isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Attribute) else None)
+            tgts = [n for n in ast.walk(node.target)
+                    if isinstance(n, ast.Name)]
+            if view == 'keys':
+                tgts = []
+            elif view == 'items' and isinstance(node.target, ast.Tuple) \
+                    and len(node.target.elts) == 2:
+                tgts = [n for n in ast.walk(node.target.elts[1])
+                        if isinstance(n, ast.Name)]
+            for n in tgts:
+                self.tainted.add(n.id)
+        self.generic_visit(node)
+
+    def _check_set_iter(self, it):
+        setish = self._is_setish(it) or (isinstance(it, ast.Name)
+                                         and it.id in self.setish)
+        if setish:
+            self.checker.finding(
+                self.module, it, 'retrace-set-iter',
+                'iteration over a set inside jit-reachable %s: hash '
+                'order is process-dependent and feeds the trace; sort it '
+                'first' % self.fn.name, self.out)
+
+    def visit_Call(self, node):
+        f = node.func
+        # float(x) / int(x) / bool(x) on a traced value
+        if (isinstance(f, ast.Name) and f.id in _COERCIONS
+                and node.args and self.hot(node.args[0])):
+            self.checker.finding(
+                self.module, node, 'retrace-host-sync',
+                '%s() on traced value inside jit-reachable %s forces a '
+                'host sync / concretization' % (f.id, self.fn.name),
+                self.out)
+        # np.asarray(x) and friends
+        elif (isinstance(f, ast.Attribute) and f.attr in _NP_COERCIONS
+              and isinstance(f.value, ast.Name)
+              and f.value.id in ('np', 'numpy')
+              and node.args and self.hot(node.args[0])):
+            self.checker.finding(
+                self.module, node, 'retrace-host-sync',
+                'np.%s() on traced value inside jit-reachable %s pulls '
+                'the array to host' % (f.attr, self.fn.name), self.out)
+        # x.item() / x.tolist() / x.numpy()
+        elif (isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS
+              and self.hot(f.value)):
+            self.checker.finding(
+                self.module, node, 'retrace-host-sync',
+                '.%s() on traced value inside jit-reachable %s forces a '
+                'host sync' % (f.attr, self.fn.name), self.out)
+        # str(x) / format(x) of traced value
+        elif (isinstance(f, ast.Name) and f.id in ('str', 'format', 'repr')
+              and node.args and self.hot(node.args[0])):
+            self.checker.finding(
+                self.module, node, 'retrace-format',
+                '%s() of traced value inside jit-reachable %s implies a '
+                'host sync' % (f.id, self.fn.name), self.out)
+        else:
+            self._propagate_call(node)
+        self.generic_visit(node)
+
+    def visit_JoinedStr(self, node):
+        for v in node.values:
+            if isinstance(v, ast.FormattedValue) and self.hot(v.value):
+                self.checker.finding(
+                    self.module, v.value, 'retrace-format',
+                    'f-string formats traced value inside jit-reachable '
+                    '%s (implicit host sync)' % self.fn.name, self.out)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # inner def (lax.scan body etc.): params are traced by construction
+        params = {a.arg for a in node.args.posonlyargs + node.args.args
+                  if a.arg not in ('self', 'cls')}
+        self.queue.append((node, params))
+        # don't descend — the queued scan covers it
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _propagate_call(self, node):
+        """Queue same-module callees whose arguments carry taint."""
+        f = node.func
+        callee = None
+        if isinstance(f, ast.Name):
+            callee = self.checker._flat.get(f.id)
+        elif (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+              and f.value.id == 'self'):
+            for (cls, name), fn in self.checker._methods.items():
+                if name == f.attr:
+                    callee = fn
+                    break
+        if callee is None:
+            return
+        params = [a.arg for a in callee.args.posonlyargs + callee.args.args]
+        if params and params[0] in ('self', 'cls'):
+            params = params[1:]
+        hot_params = set()
+        for i, arg in enumerate(node.args):
+            if i < len(params) and self.hot(arg):
+                hot_params.add(params[i])
+        for kw in node.keywords:
+            if kw.arg in params and self.hot(kw.value):
+                hot_params.add(kw.arg)
+        if hot_params:
+            self.queue.append((callee, hot_params))
+
+
+class RetraceChecker(Checker):
+    name = 'retrace'
+    RULES = {
+        'retrace-branch': 'python control flow on a traced value inside a '
+                          'jit-reachable function',
+        'retrace-host-sync': 'float()/int()/np.asarray()/.item() coercion '
+                             'of a traced value',
+        'retrace-format': 'f-string/str()/format() of a traced value',
+        'retrace-set-iter': 'set (hash-order) iteration feeding a trace',
+    }
+
+    def check(self, project):
+        out = []
+        for module in project.modules:
+            self._flat, self._methods = _local_defs(module)
+            entries = _find_entries(module, self._flat, self._methods)
+            queue = []
+            for fn, static in entries:
+                params = {a.arg for a in
+                          fn.args.posonlyargs + fn.args.args
+                          if a.arg not in ('self', 'cls')} - set(static)
+                queue.append((fn, params))
+            scanned = {}
+            while queue:
+                fn, tainted = queue.pop()
+                key = id(fn)
+                prev = scanned.get(key)
+                if prev is not None and tainted <= prev:
+                    continue
+                scanned[key] = (prev or set()) | set(tainted)
+                _FnScan(self, module, fn, scanned[key], out, queue).run()
+        # a re-scan of the same function with a larger taint set repeats
+        # its findings; collapse exact duplicates
+        uniq, seen = [], set()
+        for f in out:
+            k = (f.rule, f.path, f.line, f.col, f.message)
+            if k not in seen:
+                seen.add(k)
+                uniq.append(f)
+        return uniq

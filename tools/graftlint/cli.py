@@ -1,0 +1,89 @@
+"""graftlint command line: the lint gate, speaking the gate_common
+protocol (exit 0 = clean, 1 = unsuppressed findings, 2 = nothing to
+lint). Usage:
+
+    python -m tools.graftlint paddle_tpu tools
+    python -m tools.graftlint --rules lock-guard-write serving/
+    python -m tools.graftlint --fix-baseline paddle_tpu tools
+    python -m tools.graftlint --list-rules
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools import gate_common
+from tools.graftlint.core import (Project, load_baseline, write_baseline,
+                                  apply_baseline, run_checkers,
+                                  DEFAULT_BASELINE, REPO_ROOT)
+from tools.graftlint.checkers import all_checkers
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog='graftlint',
+        description='repo-native static analysis (retrace hazards, lock '
+                    'discipline, RPC idempotency, metric/span hygiene)')
+    p.add_argument('paths', nargs='*', default=[],
+                   help='files or directories to lint (repo-relative)')
+    p.add_argument('--baseline', default=DEFAULT_BASELINE,
+                   help='baseline file pinning accepted pre-existing '
+                        'findings')
+    p.add_argument('--no-baseline', action='store_true',
+                   help='report every finding, pinned or not')
+    p.add_argument('--fix-baseline', action='store_true',
+                   help='rewrite the baseline to pin all current findings')
+    p.add_argument('--rules', default='',
+                   help='comma-separated rule ids to run (default: all)')
+    p.add_argument('--list-rules', action='store_true',
+                   help='print the rule catalog and exit')
+    p.add_argument('--json', action='store_true',
+                   help='machine output only (suppress human lines)')
+    return p
+
+
+def main(argv=None, stream=None):
+    args = build_parser().parse_args(argv)
+    stream = stream if stream is not None else sys.stdout
+    checkers = all_checkers()
+
+    if args.list_rules:
+        for checker in checkers:
+            for rule, doc in sorted(checker.RULES.items()):
+                print('%-26s %s' % (rule, doc), file=stream)
+        return gate_common.OK
+
+    if not args.paths:
+        return gate_common.nothing_to_check('no paths given', stream=stream)
+    project = Project.load(args.paths, root=REPO_ROOT)
+    if not project.modules:
+        return gate_common.nothing_to_check(
+            'no python modules under %s' % ' '.join(args.paths),
+            stream=stream)
+
+    rules = [r for r in args.rules.split(',') if r] or None
+    findings = run_checkers(project, checkers, rules=rules)
+
+    if args.fix_baseline:
+        path = write_baseline(findings, args.baseline)
+        gate_common.emit({'ok': True, 'baseline': os.path.relpath(
+            path, REPO_ROOT), 'pinned': len(findings)}, stream=stream)
+        return gate_common.OK
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, pinned = apply_baseline(findings, baseline)
+
+    if not args.json:
+        for f in new:
+            print(str(f), file=sys.stderr)
+    return gate_common.finish(
+        [f.to_dict() for f in new],
+        summary={'modules': len(project.modules),
+                 'findings': len(findings), 'pinned': len(pinned)},
+        stream=stream)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""Shared graftlint core: modules, findings, suppressions, baseline.
+
+A ``Project`` is the parsed universe the checkers see — every module's
+AST plus raw source, loaded once, so whole-program checkers (the
+idempotency table join, the cross-module lock graph) are cheap. A
+``Finding`` fingerprints on (rule, path, symbol, message) — NOT the line
+number — so the committed baseline survives unrelated edits that shift
+code up or down a file.
+
+Suppression forms (see docs/static_analysis.md):
+
+  x = float(t)   # graftlint: disable=retrace-host-sync  <reason>
+  # graftlint: disable-file=lock-guard-write  <reason>        (anywhere)
+
+Rule ``all`` matches every rule. Suppressions are deliberate, local and
+reviewable; the baseline exists only to pin pre-existing findings when a
+new rule lands (``--fix-baseline``), never to wave through new code.
+"""
+import ast
+import hashlib
+import json
+import os
+import re
+
+__all__ = ['Finding', 'Module', 'Project', 'Checker', 'load_baseline',
+           'write_baseline', 'apply_baseline', 'run_checkers',
+           'DEFAULT_BASELINE', 'REPO_ROOT']
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, 'tools', 'graftlint_baseline.json')
+
+_SUPPRESS_RE = re.compile(
+    r'#\s*graftlint:\s*(?P<scope>disable|disable-file)='
+    r'(?P<rules>[a-z0-9,\-]+|all)')
+
+
+class Finding:
+    """One rule violation at one site."""
+
+    __slots__ = ('rule', 'path', 'line', 'col', 'message', 'symbol')
+
+    def __init__(self, rule, path, line, message, symbol='', col=0):
+        self.rule = rule
+        self.path = path          # repo-relative, '/'-separated
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.symbol = symbol      # enclosing qualname ('Class.method')
+
+    def fingerprint(self):
+        """Line-number-free identity for the baseline."""
+        key = '%s|%s|%s|%s' % (self.rule, self.path, self.symbol,
+                               self.message)
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def to_dict(self):
+        return {'rule': self.rule, 'path': self.path, 'line': self.line,
+                'symbol': self.symbol, 'message': self.message,
+                'fingerprint': self.fingerprint()}
+
+    def __repr__(self):
+        return '%s:%d: [%s] %s' % (self.path, self.line, self.rule,
+                                   self.message)
+
+
+class Module:
+    """One parsed source file plus its suppression tables."""
+
+    def __init__(self, path, relpath, source):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, '/')
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.modname = self.relpath[:-3].replace('/', '.') \
+            if self.relpath.endswith('.py') else self.relpath
+        if self.modname.endswith('.__init__'):
+            self.modname = self.modname[:-len('.__init__')]
+        self._line_suppress = {}   # lineno -> set of rules
+        self._file_suppress = set()
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = set(m.group('rules').split(','))
+            if m.group('scope') == 'disable-file':
+                self._file_suppress |= rules
+            else:
+                self._line_suppress.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule, line):
+        rules = self._line_suppress.get(line, ())
+        return ('all' in self._file_suppress or rule in self._file_suppress
+                or 'all' in rules or rule in rules)
+
+    def qualname_at(self, node):
+        """Enclosing Class.method qualname of `node` (best effort via a
+        parent walk — cheap because modules are small)."""
+        chain = []
+        self._qual_walk(self.tree, node, chain)
+        return '.'.join(chain)
+
+    def _qual_walk(self, root, target, chain):
+        for child in ast.iter_child_nodes(root):
+            if child is target or any(n is target
+                                      for n in ast.walk(child)):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    chain.append(child.name)
+                self._qual_walk(child, target, chain)
+                return
+
+
+class Project:
+    """Every module the checkers see, loaded and parsed once."""
+
+    def __init__(self, modules):
+        self.modules = list(modules)
+        self.by_modname = {m.modname: m for m in self.modules}
+
+    @classmethod
+    def load(cls, paths, root=None, exclude=('__pycache__',)):
+        root = root or REPO_ROOT
+        files = []
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(root, p)
+            if os.path.isfile(ap):
+                files.append(ap)
+                continue
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames if d not in exclude]
+                for fn in sorted(filenames):
+                    if fn.endswith('.py'):
+                        files.append(os.path.join(dirpath, fn))
+        modules = []
+        for f in sorted(set(files)):
+            rel = os.path.relpath(f, root)
+            with open(f, errors='replace') as fh:
+                src = fh.read()
+            try:
+                modules.append(Module(f, rel, src))
+            except SyntaxError:
+                # non-importable scraps (fixtures for other tools) are
+                # not lintable; skip rather than crash the whole run
+                continue
+        return cls(modules)
+
+
+class Checker:
+    """Base checker: subclasses set `name`, `RULES` ({rule: doc}) and
+    implement check(project) -> [Finding]. Helpers stamp suppression-
+    aware findings."""
+
+    name = None
+    RULES = {}
+
+    def check(self, project):
+        raise NotImplementedError
+
+    def finding(self, module, node, rule, message, out):
+        """Append a Finding for `node` unless suppressed at its line."""
+        line = getattr(node, 'lineno', 0)
+        if module.suppressed(rule, line):
+            return
+        out.append(Finding(rule, module.relpath, line, message,
+                           symbol=module.qualname_at(node),
+                           col=getattr(node, 'col_offset', 0)))
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path=DEFAULT_BASELINE):
+    """{fingerprint: count} plus the context entries for humans."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    return {fp: entry for fp, entry in data.get('findings', {}).items()}
+
+
+def write_baseline(findings, path=DEFAULT_BASELINE):
+    """Pin `findings` as the accepted pre-existing set."""
+    table = {}
+    for f in findings:
+        fp = f.fingerprint()
+        entry = table.get(fp)
+        if entry is None:
+            entry = table[fp] = dict(f.to_dict(), count=0)
+            del entry['fingerprint']
+        entry['count'] += 1
+    payload = {'comment': 'graftlint accepted pre-existing findings; '
+                          'regenerate with --fix-baseline',
+               'findings': {fp: table[fp] for fp in sorted(table)}}
+    with open(path, 'w') as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write('\n')
+    return path
+
+
+def apply_baseline(findings, baseline):
+    """Split into (new, pinned): each fingerprint absorbs up to its
+    baselined count; anything beyond is new."""
+    remaining = {fp: int(entry.get('count', 1))
+                 for fp, entry in baseline.items()}
+    new, pinned = [], []
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            pinned.append(f)
+        else:
+            new.append(f)
+    return new, pinned
+
+
+def run_checkers(project, checkers, rules=None):
+    """All findings from `checkers` over `project`, sorted by site.
+    `rules`: optional iterable restricting which rule ids may fire."""
+    allowed = set(rules) if rules else None
+    out = []
+    for checker in checkers:
+        for f in checker.check(project):
+            if allowed is None or f.rule in allowed:
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out

@@ -151,6 +151,23 @@ class GraphPyServer:
         self._srv.shutdown()
         self._srv.server_close()
 
+    # ---- fleet telemetry ------------------------------------------
+
+    def metrics_server(self, **kwargs):
+        """A MetricsServer over this process's registry — start it in a
+        graph shard process and add `.url` to a FleetCollector as an
+        HTTP target for the federated fleet view."""
+        from ..monitor.server import MetricsServer
+        return MetricsServer(registry=_monitor_registry(), **kwargs)
+
+    def fleet_register(self, collector, instance=None):
+        """Register this shard on an in-process FleetCollector. Server
+        metrics live on the PROCESS registry: register each process
+        once (in-proc shards share the registry; registering every
+        shard would double-count the merge)."""
+        return collector.add_target(instance or 'graph-%d' % self.rank,
+                                    registry=_monitor_registry())
+
 
 class GraphPyClient:
     """Key-sharded client (graph_brpc_client parity): node id % n_servers

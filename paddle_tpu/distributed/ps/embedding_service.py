@@ -383,6 +383,24 @@ class EmbeddingServer:
         self._srv.shutdown()
         self._srv.server_close()
 
+    # ---- fleet telemetry ------------------------------------------
+
+    def metrics_server(self, **kwargs):
+        """A MetricsServer over this process's registry — start it in a
+        PS shard process and add `.url` to a FleetCollector as an HTTP
+        target; the shard's ps_server_* families then show up in the
+        federated view with the shard's instance label."""
+        from ...monitor.server import MetricsServer
+        return MetricsServer(registry=_monitor_registry(), **kwargs)
+
+    def fleet_register(self, collector, instance=None):
+        """Register this shard on an in-process FleetCollector (same
+        process, no HTTP hop). Server metrics live on the PROCESS
+        registry, so register each process once — two in-proc shards
+        share one registry and registering both would double-count."""
+        return collector.add_target(instance or 'ps-%d' % self.port,
+                                    registry=_monitor_registry())
+
 
 class EmbeddingClient:
     """Key-sharded client over N servers (BrpcPsClient parity): shard by

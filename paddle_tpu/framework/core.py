@@ -18,6 +18,7 @@ There is deliberately NO per-op kernel registry / ExecutionContext: XLA is the
 kernel library, dispatch is jnp/lax. The "op table" the reference needs for
 its registry (op name -> impl) lives in tensor/* as plain python functions.
 """
+import threading
 import weakref
 import numpy as np
 import jax
@@ -34,8 +35,13 @@ __all__ = [
 # global tracer state
 # ---------------------------------------------------------------------------
 
-class _TracerState:
-    __slots__ = ('has_grad', 'inside_functional')
+class _TracerState(threading.local):
+    """Per-THREAD grad mode. A process-global flag races: two threads
+    interleaving no_grad_guard enter/exit (serving replica drivers wrap
+    every step in one) restore each other's saved value and can leave
+    has_grad=False behind for the whole process. threading.local runs
+    __init__ on first touch from each new thread, so every thread
+    starts at the defaults below."""
 
     def __init__(self):
         self.has_grad = True

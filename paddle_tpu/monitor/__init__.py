@@ -16,7 +16,14 @@ Four layers, each usable alone:
   Chrome-trace JSON for profiler.merge_traces;
 - ``perf``      — performance introspection: CompileWatchdog (recompile
   attribution + warmup barrier), StepTimeline (step phase split +
-  straggler detection), and the cost-model roofline/MFU estimator.
+  straggler detection), and the cost-model roofline/MFU estimator;
+- ``federation``— FleetCollector: pull-based cross-process metric
+  federation (in-proc registries + HTTP /metrics.json targets, merged
+  counters/gauges/histograms, staleness + fleet_target_up liveness)
+  served at /fleet;
+- ``alerts``    — AlertManager: declarative threshold + multi-window
+  SLO burn-rate rules with a pending→firing→resolved lifecycle,
+  flight dumps on firing edges, served at /alerts.
 
 Built-in instrumentation (resilient RPC, the serving engine, PS/graph
 clients, hapi TelemetryCallback, the dryrun telemetry line) feeds
@@ -32,6 +39,11 @@ from .server import MetricsServer
 from .runtime import RuntimeSampler
 from .tracing import (FlightRecorder, Span, Tracer, default_tracer,
                       set_default_tracer, spans_to_chrome)
+from .federation import FleetCollector, ScrapeTarget, merge_snapshots
+from .alerts import (AlertManager, AlertRule, BurnRateRule,
+                     ThresholdRule)
+from . import alerts
+from . import federation
 from . import perf
 from . import telemetry
 from . import tracing
@@ -43,4 +55,7 @@ __all__ = ['MetricRegistry', 'Counter', 'Gauge', 'Histogram',
            'schema_of', 'MetricsServer', 'RuntimeSampler', 'telemetry',
            'Tracer', 'Span', 'FlightRecorder', 'default_tracer',
            'set_default_tracer', 'spans_to_chrome', 'tracing', 'perf',
-           'CompileWatchdog', 'RecompileError', 'StepTimeline']
+           'CompileWatchdog', 'RecompileError', 'StepTimeline',
+           'FleetCollector', 'ScrapeTarget', 'merge_snapshots',
+           'AlertManager', 'AlertRule', 'ThresholdRule', 'BurnRateRule',
+           'federation', 'alerts']

@@ -9,7 +9,8 @@ metric names + label keys, the part a silent de-instrumentation breaks).
 import json
 import math
 
-__all__ = ['to_prometheus', 'to_dict', 'to_json', 'schema_of']
+__all__ = ['to_prometheus', 'to_dict', 'to_json', 'schema_of',
+           'snapshot_to_prometheus']
 
 
 def _esc_help(s):
@@ -44,24 +45,20 @@ def to_prometheus(registry):
         out.append('# TYPE %s %s' % (fam.name, fam.kind))
         for values, child in fam.samples():
             if fam.kind == 'histogram':
-                snap = child.snapshot()
-                acc = 0
-                for bound, n in zip(fam.buckets, snap['buckets']):
-                    acc += n
+                # the child's mergeable cumulative view IS the `le`
+                # semantics of the _bucket lines: one shared source for
+                # scrapes and federation merges
+                cum = child.cumulative()
+                for bound, n in zip(cum['bounds'], cum['cumulative']):
                     out.append('%s_bucket%s %s' % (
                         fam.name,
                         _labels_text(fam.labelnames, values,
                                      [('le', _fmt_value(float(bound)))]),
-                        acc))
-                acc += snap['buckets'][-1]
-                out.append('%s_bucket%s %s' % (
-                    fam.name,
-                    _labels_text(fam.labelnames, values,
-                                 [('le', '+Inf')]), acc))
+                        n))
                 lbl = _labels_text(fam.labelnames, values)
                 out.append('%s_sum%s %s' % (fam.name, lbl,
-                                            _fmt_value(snap['sum'])))
-                out.append('%s_count%s %d' % (fam.name, lbl, snap['count']))
+                                            _fmt_value(cum['sum'])))
+                out.append('%s_count%s %d' % (fam.name, lbl, cum['count']))
             else:
                 out.append('%s%s %s' % (
                     fam.name, _labels_text(fam.labelnames, values),
@@ -114,6 +111,54 @@ def to_dict(registry, buckets=True):
 def to_json(registry, **kw):
     return json.dumps(to_dict(registry, **kw), sort_keys=True,
                       separators=(',', ':'))
+
+
+def _bound_key(text):
+    """Sort key for formatted bucket bounds ('+Inf' sorts last)."""
+    return math.inf if text == '+Inf' else float(text)
+
+
+def snapshot_to_prometheus(snapshot):
+    """Render a to_dict()-shaped snapshot dict as text exposition.
+
+    Registries render through to_prometheus directly; this path exists
+    for snapshots that no longer have a live registry behind them — the
+    federation merge (monitor/federation.py) and archived dryrun lines —
+    so `/fleet?format=prom` can serve the merged fleet view to a
+    standard scraper. Histogram samples without per-bucket detail
+    (buckets=False snapshots) emit sum/count only.
+    """
+    out = []
+    for name in sorted(snapshot):
+        fam = snapshot[name]
+        kind = fam.get('type', 'gauge')
+        names = list(fam.get('labels') or ())
+        out.append('# TYPE %s %s' % (name, kind))
+        for s in fam.get('samples', ()):
+            labels = dict(s.get('labels') or {})
+            ordered = [n for n in names if n in labels] + \
+                [n for n in sorted(labels) if n not in names]
+            pairs = [(n, labels[n]) for n in ordered]
+            if kind == 'histogram':
+                lbl = _labels_text((), (), pairs)
+                buckets = s.get('buckets')
+                if buckets:
+                    acc = 0
+                    for b in sorted(buckets, key=_bound_key):
+                        acc += int(buckets[b])
+                        out.append('%s_bucket%s %d' % (
+                            name, _labels_text((), (),
+                                               pairs + [('le', b)]), acc))
+                out.append('%s_sum%s %s'
+                           % (name, lbl, _fmt_value(float(s.get('sum')
+                                                          or 0.0))))
+                out.append('%s_count%s %d' % (name, lbl,
+                                              int(s.get('count') or 0)))
+            else:
+                out.append('%s%s %s' % (
+                    name, _labels_text((), (), pairs),
+                    _fmt_value(s.get('value') or 0.0)))
+    return '\n'.join(out) + '\n'
 
 
 def schema_of(snapshot):

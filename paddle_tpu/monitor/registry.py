@@ -136,6 +136,22 @@ class _HistogramChild:
                     'buckets': list(self._counts),
                     'exemplars': dict(self._exemplars)}
 
+    def cumulative(self):
+        """Mergeable fixed-boundary view: Prometheus `le` semantics,
+        one cumulative count per upper bound with +Inf last, so
+        cumulative[-1] == count always. Two children with the same
+        bounds merge by element-wise sum (monitor/federation.py); the
+        `_bucket` exposition lines print exactly these numbers."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, acc = [], 0
+        for n in counts:
+            acc += n
+            cum.append(acc)
+        return {'bounds': list(self._bounds) + [float('inf')],
+                'cumulative': cum, 'count': total, 'sum': s}
+
 
 class _Family:
     """One metric family: a name, a type, label names, and children."""
@@ -176,8 +192,8 @@ class _Family:
 
     # unlabeled convenience: fam.inc() == fam.labels().inc()
     def __getattr__(self, attr):
-        if attr in ('inc', 'dec', 'set', 'observe', 'value') \
-                and not self.labelnames:
+        if attr in ('inc', 'dec', 'set', 'observe', 'value',
+                    'cumulative') and not self.labelnames:
             return getattr(self._children[()], attr)
         raise AttributeError(attr)
 

@@ -3,11 +3,14 @@
 Prometheus-compatible without the prometheus_client dependency (the
 image bakes nothing in): text exposition 0.0.4 on /metrics, a tiny JSON
 liveness body on /healthz, the tracer's flight-recorder ring on
-/debug/traces (?format=chrome for a Perfetto-loadable body), 404
-elsewhere. HEAD is answered on every route (load-balancer probes use it
-and must not see http.server's default 501). Ephemeral-port by default
-so tests and multi-engine processes never collide; `.port`/`.url`
-report the bound address.
+/debug/traces (?format=chrome for a Perfetto-loadable body), the
+federated fleet view on /fleet (?scrape=1 to force a cycle, ?format=prom
+for text exposition of the merge) and alert state on /alerts when a
+FleetCollector / AlertManager is attached, 404 elsewhere. HEAD is
+answered on every route (load-balancer probes use it and must not see
+http.server's default 501). Ephemeral-port by default so tests and
+multi-engine processes never collide; `.port`/`.url` report the bound
+address.
 """
 import http.server
 import json
@@ -51,6 +54,32 @@ class _Handler(http.server.BaseHTTPRequestHandler):
         if path == '/metrics.json':
             return (200, 'application/json',
                     export.to_json(self.server.registry).encode())
+        if path == '/fleet':
+            coll = getattr(self.server, 'collector', None)
+            if coll is None:
+                return (404, 'text/plain; charset=utf-8',
+                        b'no fleet collector attached\n')
+            # pull-based federation: ?scrape=1 forces a cycle before
+            # answering (the offline CLI's freshness knob); the default
+            # serves the collector's last merged view
+            if 'scrape=1' in query:
+                coll.scrape()
+            if 'format=prom' in query:
+                return (200, CONTENT_TYPE,
+                        export.snapshot_to_prometheus(
+                            coll.merged()).encode())
+            return (200, 'application/json',
+                    json.dumps(coll.fleet_status()).encode())
+        if path == '/alerts':
+            mgr = getattr(self.server, 'alerts', None)
+            if mgr is None:
+                return (404, 'text/plain; charset=utf-8',
+                        b'no alert manager attached\n')
+            if 'evaluate=1' in query:
+                mgr.evaluate()
+            return (200, 'application/json',
+                    json.dumps({'firing': mgr.firing(),
+                                'alerts': mgr.state()}).encode())
         if path == '/debug/traces':
             tracer = getattr(self.server, 'tracer', None)
             if tracer is None:
@@ -103,7 +132,8 @@ class MetricsServer:
     """
 
     def __init__(self, registry=None, host='127.0.0.1', port=0,
-                 tracer=None, readiness=None):
+                 tracer=None, readiness=None, collector=None,
+                 alerts=None):
         self.registry = registry if registry is not None \
             else default_registry()
         if tracer is None:
@@ -115,6 +145,12 @@ class MetricsServer:
         # callable — e.g. a gateway replica's `.ready` — evaluated per
         # probe so a drain flips the route to 503 without a restart.
         self.readiness = readiness
+        # /fleet: a monitor.federation.FleetCollector (merged fleet
+        # snapshot + per-target liveness); /alerts: a
+        # monitor.alerts.AlertManager. Both optional — unattached
+        # routes answer 404 like any unknown path.
+        self.collector = collector
+        self.alerts = alerts
         self._host = host
         self._port = int(port)
         self._srv = None
@@ -127,6 +163,8 @@ class MetricsServer:
         self._srv.registry = self.registry
         self._srv.tracer = self.tracer
         self._srv.readiness = self.readiness
+        self._srv.collector = self.collector
+        self._srv.alerts = self.alerts
         self._srv.started = time.monotonic()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name='metrics-server', daemon=True)

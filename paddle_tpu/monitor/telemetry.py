@@ -15,7 +15,8 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_serving_request_schema', 'record_gateway_schema',
            'record_tracing_schema', 'record_perf_schema',
            'record_rpc_schema', 'record_client_op_schema',
-           'record_train_loop_schema', 'snapshot_line',
+           'record_train_loop_schema', 'record_fleet_schema',
+           'record_alert_schema', 'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -309,6 +310,77 @@ def record_train_loop_schema(registry):
     return out
 
 
+# the fleet-federation collector's health families (monitor/
+# federation.py). Single-source rule: FleetCollector and the schema
+# baseline both register through record_fleet_schema. Label budget
+# (docs/observability.md): instance is the bounded set of registered
+# scrape targets (replica indices / shard endpoints) — never
+# per-request, never per-scrape.
+FLEET_FAMILIES = (
+    ('gauge', 'fleet_target_up',
+     '1 when the last scrape of the target succeeded, else 0',
+     ('instance',)),
+    ('gauge', 'fleet_target_staleness_seconds',
+     'seconds since the target last scraped successfully '
+     '(-1 = never scraped)', ('instance',)),
+    ('gauge', 'fleet_targets',
+     'scrape targets registered with the collector', ()),
+    ('counter', 'fleet_scrapes_total',
+     'federation scrape cycles completed', ()),
+    ('counter', 'fleet_scrape_errors_total',
+     'failed target scrapes (target kept stale, never dropped)',
+     ('instance',)),
+    ('histogram', 'fleet_scrape_seconds',
+     'wall time of one federation scrape cycle', ()),
+    ('counter', 'fleet_merge_conflicts_total',
+     'families dropped from a merge for type/label/bucket mismatch',
+     ()),
+)
+
+
+def record_fleet_schema(registry):
+    """Register the federation families on `registry` and return
+    {name: family}. Used by FleetCollector at construction and by
+    dryrun_registry so the committed baseline covers federation."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in FLEET_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # a cycle spans sub-ms (in-proc) to seconds (slow HTTP peer)
+            kw['buckets'] = exponential_buckets(0.0005, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
+# the SLO alerting families (monitor/alerts.py). Single-source rule:
+# AlertManager and the schema baseline both register through
+# record_alert_schema. Label budgets: rule is the declared rule set;
+# `to` is the closed lifecycle vocabulary {pending, firing, resolved,
+# inactive}.
+ALERT_FAMILIES = (
+    ('gauge', 'alerts_firing',
+     '1 while the rule is firing', ('rule',)),
+    ('gauge', 'alerts_pending',
+     '1 while the rule is pending (condition true, for_duration not '
+     'yet met)', ('rule',)),
+    ('counter', 'alerts_transitions_total',
+     'alert lifecycle transitions taken', ('rule', 'to')),
+    ('counter', 'alerts_evaluations_total',
+     'alert evaluation passes', ()),
+)
+
+
+def record_alert_schema(registry):
+    """Register the alerting families on `registry` and return
+    {name: family}. Used by AlertManager at construction and by
+    dryrun_registry so the committed baseline covers alerting."""
+    out = {}
+    for kind, name, doc, labels in ALERT_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc, labels)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -326,6 +398,8 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_rpc_schema(reg)
     record_client_op_schema(reg)
     record_train_loop_schema(reg)
+    record_fleet_schema(reg)
+    record_alert_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

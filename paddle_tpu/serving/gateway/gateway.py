@@ -132,6 +132,14 @@ class ServingGateway:
         self._ttfts = collections.deque(maxlen=4096)   # (t, ttft_s)
         self.failover_log = []
         self._started = False
+        # fleet telemetry (attach_fleet): replicas self-register as
+        # in-proc scrape targets; burn_source, when set, replaces the
+        # local TTFT window in autoscale_tick so the policy can act on
+        # the FEDERATED burn (e.g. alerts.federated_burn_source) —
+        # a gateway that only sees its own TTFTs under-scales when the
+        # SLO is burning elsewhere in the fleet.
+        self._fleet = None
+        self.burn_source = None
         with self._lock:
             for _ in range(int(replicas)):
                 self._add_replica_locked()
@@ -381,8 +389,12 @@ class ServingGateway:
             return Decision(0, 'no autoscaler policy configured')
         now = self._clock() if now is None else now
         with self._lock:
-            burn = slo_burn_rate(self._ttfts, now, self.policy.slo_ttft_s,
-                                 self.policy.window_s)
+            if self.burn_source is not None:
+                burn = float(self.burn_source(now))
+            else:
+                burn = slo_burn_rate(self._ttfts, now,
+                                     self.policy.slo_ttft_s,
+                                     self.policy.window_s)
             self._m_burn.set(burn)
             ready = [r for r in self.pool if r.state == READY]
             occ = (sum(r.occupancy() for r in ready) / len(ready)
@@ -401,6 +413,29 @@ class ServingGateway:
                 self._refresh_gauges_locked()
             return decision
 
+    # ---- fleet telemetry ----------------------------------------------
+
+    def attach_fleet(self, collector):
+        """Register every replica's private registry as an in-proc
+        scrape target on `collector` (a monitor.federation
+        FleetCollector); replicas added later by the autoscaler
+        self-register. The collector's merged view then carries every
+        replica's serving_* families with an `instance` label — the
+        cross-replica occupancy/queue picture one registry per replica
+        was built to preserve (see replica.py)."""
+        with self._lock:
+            self._fleet = collector
+            for rep in self.pool:
+                self._fleet_register_locked(rep)
+        return collector
+
+    def _fleet_register_locked(self, rep):
+        if self._fleet is None:
+            return
+        # idempotent: re-attach / re-add keeps the same instance name
+        self._fleet.add_target('gw-replica-%d' % rep.index,
+                               registry=rep.registry)
+
     # ---- pool management ----------------------------------------------
 
     def _add_replica_locked(self):
@@ -408,6 +443,7 @@ class ServingGateway:
         self.pool.append(rep)
         if self._started:
             rep.start_driver(self._collect, self._on_lost)
+        self._fleet_register_locked(rep)
         self._refresh_gauges_locked()
         return rep
 

@@ -22,6 +22,8 @@ import re
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.distributed import fleet
 from paddle_tpu.text.models import GPTConfig, GPTForCausalLM

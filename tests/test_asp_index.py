@@ -32,6 +32,7 @@ def test_create_mask_2d():
     assert 0.3 <= asp.calculate_density(mask) <= 0.5
 
 
+@pytest.mark.slow
 def test_prune_model_and_decorated_step_preserves_sparsity():
     paddle.seed(5)
     model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))

@@ -122,3 +122,42 @@ def test_double_use_deep_graph():
     d.backward()
     # d = (2x+1)(6x); dd/dx = 2*6x + (2x+1)*6 = 12x + 12x + 6 = 24x+6 = 30
     np.testing.assert_allclose(x.grad.numpy(), [30.])
+
+
+def test_no_grad_guard_is_thread_local():
+    """Interleaved no_grad_guard enter/exit across threads must not
+    corrupt another thread's grad mode. With a process-global flag the
+    save/restore pairs race (T1 enter, T2 enter, T1 exit, T2 exit
+    restores T1's False) and the whole process loses its tape — the
+    serving gateway runs one guard-wrapped driver thread per replica,
+    so a full test run used to come out of test_serving_gateway with
+    has_grad=False and every later .backward() silently recording
+    nothing."""
+    import threading
+
+    stop = threading.Event()
+    seen_disabled = []
+
+    def churn():
+        while not stop.is_set():
+            with paddle.no_grad():
+                pass
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            if not paddle.is_grad_enabled():
+                seen_disabled.append(True)
+                break
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not seen_disabled
+    assert paddle.is_grad_enabled()
+    # and the tape still records after the churn
+    x = paddle.to_tensor([2.], stop_gradient=False)
+    (x * x).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.])

@@ -13,6 +13,7 @@ Reference analog: the vision-zoo train smoke tests
 family) — which the reference runs in fp32/amp, and this repo must also
 hold under pure-bf16 params (the TPU bench configuration).
 """
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -56,6 +57,7 @@ def test_bf16_convnet_trainstep():
             (p.name if hasattr(p, 'name') else '?', p.dtype)
 
 
+@pytest.mark.slow
 def test_bf16_resnet18_trainstep():
     """The verbatim VERDICT repro: resnet18().bfloat16() + TrainStep +
     bf16 input — r4's code crashed in the VJP before this test existed."""

@@ -95,6 +95,7 @@ def test_attn_impl_env_routes_blockwise(monkeypatch):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_multi_step_under_dp_sharding():
     """multi_step under a fleet dp strategy: the K-leading stacked batch
     must shard its BATCH dim (dim 1) over dp, not the scan axis — and

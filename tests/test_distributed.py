@@ -330,6 +330,7 @@ def test_fleet_wrapper_behaviors(tmp_path):
         DataParallel('not a layer')
 
 
+@pytest.mark.slow
 def test_ring_attention_long_context_8k():
     """Long-context evidence: seq 8192 sharded sp=8 (1024 tokens/device)
     through ring attention, fwd + grads, against a blocked numpy

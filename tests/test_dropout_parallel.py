@@ -259,6 +259,7 @@ def test_ring_attention_dropout_unbiased():
     assert not np.allclose(one, np.asarray(ref), atol=1e-3)
 
 
+@pytest.mark.slow
 def test_sp_dropout_trains():
     """sp=4 ring attention with dropout (attention-prob + residual):
     builds (the r3 ValueError is gone) and trains with finite losses."""

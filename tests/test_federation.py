@@ -1,0 +1,674 @@
+"""Fleet telemetry plane tests (monitor/federation.py + monitor/alerts.py).
+
+The load-bearing assertions:
+  1. merged counter totals are EXACT — across in-proc registries, a real
+     child process scraped over HTTP, and a target killed mid-scrape
+     (stale data is held, so totals stay monotone and never shrink);
+  2. histogram bucket counts match an independent numpy computation, and
+     the merged exposition renders through the same cumulative-`le`
+     contract as a single registry's /metrics body;
+  3. every alert lifecycle edge lands at an analytically exact tick of
+     an injected clock (pending -> firing -> resolved), a firing edge
+     writes EXACTLY ONE flight dump, and hysteresis keeps a sawtoothing
+     signal from flapping;
+  4. the disabled path is inert: a disabled collector fetches nothing
+     (even from an unreachable target) and alerting off the evaluate()
+     path costs the serving loops nothing.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.monitor import (FleetCollector, MetricRegistry,
+                                MetricsServer, ScrapeTarget, alerts,
+                                merge_snapshots, to_dict, to_prometheus)
+from paddle_tpu.monitor.alerts import (AlertManager, BurnRateRule,
+                                       HistogramWindow, ThresholdRule,
+                                       federated_burn_source)
+from paddle_tpu.monitor.export import snapshot_to_prometheus
+from paddle_tpu.monitor.federation import fleet_snapshot_line, FLEET_LINE_RE
+from paddle_tpu.monitor.tracing import FlightRecorder, Tracer
+from paddle_tpu.testing import chaos
+
+REPO = __file__.rsplit('/tests/', 1)[0]
+
+
+def _reg(counter=0.0, gauge=None, hist=()):
+    """A registry with one family of each kind (fixed shared names)."""
+    r = MetricRegistry()
+    c = r.counter('fed_tokens_total', 'tokens')
+    if counter:
+        c.inc(counter)
+    if gauge is not None:
+        r.gauge('fed_occupancy', 'occ').set(gauge)
+    h = r.histogram('fed_lat_seconds', 'lat', buckets=(0.1, 1.0, 10.0))
+    for v in hist:
+        h.observe(v)
+    return r
+
+
+# -- histogram cumulative view ----------------------------------------------
+
+def test_histogram_cumulative_numpy_parity():
+    """The mergeable cumulative() view agrees with an independent numpy
+    cumsum over the same bounds — the federation merge and the
+    Prometheus `le` lines both stand on this."""
+    rng = np.random.RandomState(7)
+    values = rng.lognormal(mean=-1.0, sigma=1.5, size=500)
+    bounds = (0.05, 0.2, 1.0, 5.0)
+    r = MetricRegistry()
+    h = r.histogram('lat_seconds', 'lat', buckets=bounds)
+    for v in values:
+        h.observe(float(v))
+    cum = h.cumulative()
+    assert cum['bounds'] == list(bounds) + [float('inf')]
+    # numpy oracle: observations <= bound, cumulatively (le semantics)
+    expect = [int(np.sum(values <= b)) for b in bounds] + [len(values)]
+    assert cum['cumulative'] == expect
+    assert cum['count'] == len(values)
+    assert cum['sum'] == pytest.approx(float(np.sum(values)))
+    # the snapshot's buckets are per-bucket increments of the same
+    # distribution: their running sum IS the cumulative view
+    sample = to_dict(r)['lat_seconds']['samples'][0]
+    assert list(np.cumsum(list(sample['buckets'].values()))) == expect
+
+
+def test_snapshot_exposition_matches_registry_exposition():
+    """snapshot_to_prometheus(to_dict(r)) and to_prometheus(r) agree on
+    every sample line — the /fleet?format=prom body speaks the same
+    dialect as a single process's /metrics."""
+    r = _reg(counter=3, gauge=0.5, hist=(0.05, 0.5, 50.0))
+    c = r.counter('fed_ops_total', 'ops', ('kind',))
+    c.labels('read').inc(2)
+    c.labels('write').inc(5)
+    direct = to_prometheus(r)
+    via_snapshot = snapshot_to_prometheus(to_dict(r))
+    # compare as line sets: family ordering may differ, samples may not
+    assert set(l for l in direct.splitlines() if not l.startswith('#')) \
+        == set(l for l in via_snapshot.splitlines()
+               if not l.startswith('#'))
+
+
+# -- pure merge semantics ----------------------------------------------------
+
+def test_merge_counters_exact_per_labelset():
+    a = MetricRegistry()
+    b = MetricRegistry()
+    for r, n in ((a, 3), (b, 39)):
+        fam = r.counter('ops_total', 'ops', ('kind',))
+        fam.labels('read').inc(n)
+    a.get('ops_total').labels('write').inc(7)
+    merged = merge_snapshots([('a', to_dict(a)), ('b', to_dict(b))])
+    by_kind = {s['labels']['kind']: s['value']
+               for s in merged['ops_total']['samples']}
+    assert by_kind == {'read': 42.0, 'write': 7.0}
+    assert merged['ops_total']['labels'] == ['kind']
+
+
+def test_merge_gauges_get_instance_label():
+    a = _reg(gauge=0.25)
+    b = _reg(gauge=0.75)
+    merged = merge_snapshots([('a', to_dict(a)), ('b', to_dict(b))])
+    fam = merged['fed_occupancy']
+    assert fam['labels'] == ['instance']
+    vals = {s['labels']['instance']: s['value'] for s in fam['samples']}
+    assert vals == {'a': 0.25, 'b': 0.75}
+    # federation of federations: a family already carrying `instance`
+    # passes through instead of growing instance twice
+    again = merge_snapshots([('meta', merged)])
+    fam2 = again['fed_occupancy']
+    assert fam2['labels'] == ['instance']
+    assert {s['labels']['instance'] for s in fam2['samples']} == {'a', 'b'}
+
+
+def test_merge_histograms_bucketwise_numpy_parity():
+    rng = np.random.RandomState(3)
+    va = rng.exponential(1.0, size=200)
+    vb = rng.exponential(3.0, size=300)
+    a = _reg(hist=[float(v) for v in va])
+    b = _reg(hist=[float(v) for v in vb])
+    merged = merge_snapshots([('a', to_dict(a)), ('b', to_dict(b))])
+    s = merged['fed_lat_seconds']['samples'][0]
+    both = np.concatenate([va, vb])
+    assert s['count'] == 500
+    assert s['sum'] == pytest.approx(float(np.sum(both)))
+    # per-bucket increments: difference the numpy cumulative counts
+    cum = [int(np.sum(both <= b)) for b in (0.1, 1.0, 10.0, np.inf)]
+    expect = dict(zip(('0.1', '1', '10', '+Inf'),
+                      np.diff([0] + cum).tolist()))
+    assert s['buckets'] == expect
+
+
+def test_merge_conflicting_families_dropped_not_wrong():
+    a = MetricRegistry()
+    a.counter('x_total', 'x').inc(1)
+    b = MetricRegistry()
+    b.gauge('x_total', 'x').set(5)            # same name, other kind
+    c = MetricRegistry()
+    c.counter('ok_total', 'ok').inc(2)
+    conflicts = []
+    merged = merge_snapshots(
+        [('a', to_dict(a)), ('b', to_dict(b)), ('c', to_dict(c))],
+        conflicts=conflicts)
+    assert 'x_total' not in merged            # dropped, never guessed
+    assert merged['ok_total']['samples'][0]['value'] == 2.0
+    assert conflicts and conflicts[0]['family'] == 'x_total'
+
+    # histogram bucket-bound mismatch is the same story
+    ha = MetricRegistry()
+    ha.histogram('h_seconds', 'h', buckets=(0.1, 1.0)).observe(0.5)
+    hb = MetricRegistry()
+    hb.histogram('h_seconds', 'h', buckets=(0.2, 2.0)).observe(0.5)
+    conflicts = []
+    merged = merge_snapshots([('a', to_dict(ha)), ('b', to_dict(hb))],
+                             conflicts=conflicts)
+    assert 'h_seconds' not in merged
+    assert any(c['problem'] == 'bucket_bounds' for c in conflicts)
+
+
+def test_scrape_target_validation():
+    with pytest.raises(ValueError):
+        ScrapeTarget('x')                     # neither registry nor url
+    with pytest.raises(ValueError):
+        ScrapeTarget('x', registry=MetricRegistry(),
+                     url='http://127.0.0.1:1/')
+    t = ScrapeTarget('x', url='http://127.0.0.1:1')
+    assert t.url.endswith('/metrics.json')
+
+
+# -- the federation oracle ---------------------------------------------------
+
+_CHILD = r'''
+import os, sys, types
+sys.path.insert(0, %(repo)r)
+pkg = types.ModuleType('paddle_tpu')
+pkg.__path__ = [os.path.join(%(repo)r, 'paddle_tpu')]
+sys.modules['paddle_tpu'] = pkg        # monitor/ must load without jax
+from paddle_tpu.monitor.registry import MetricRegistry
+from paddle_tpu.monitor.server import MetricsServer
+r = MetricRegistry()
+r.counter('fed_tokens_total', 'tokens').inc(int(sys.argv[1]))
+r.gauge('fed_occupancy', 'occ').set(0.5)
+h = r.histogram('fed_lat_seconds', 'lat', buckets=(0.1, 1.0, 10.0))
+for v in (0.05, 0.5, 50.0):
+    h.observe(v)
+srv = MetricsServer(registry=r).start()
+print(srv.port, flush=True)
+sys.stdin.read()                       # live until the parent kills us
+'''
+
+
+def test_federation_oracle_http_child_process_and_death():
+    """THE acceptance test: three targets — two in-proc registries plus
+    a REAL child process scraped over HTTP — merge to exact totals;
+    killing the child degrades to stale last-known data (totals
+    monotone, never wrong) with fleet_target_up{child}=0."""
+    meta = MetricRegistry()
+    fc = FleetCollector(registry=meta, clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=10, gauge=0.25,
+                                     hist=(0.05, 0.5, 50.0)))
+    fc.add_target('b', registry=_reg(counter=20, gauge=0.75,
+                                     hist=(0.05, 0.5, 50.0)))
+    proc = subprocess.Popen(
+        [sys.executable, '-c', _CHILD % {'repo': REPO}, '12'],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+    try:
+        port = int(proc.stdout.readline())
+        fc.add_target('child', url='http://127.0.0.1:%d' % port)
+        assert fc.scrape() == {'ok': 3, 'down': 0}
+        merged = fc.merged()
+        assert merged['fed_tokens_total']['samples'][0]['value'] == 42.0
+        occ = {s['labels']['instance']: s['value']
+               for s in merged['fed_occupancy']['samples']}
+        assert occ == {'a': 0.25, 'b': 0.75, 'child': 0.5}
+        lat = merged['fed_lat_seconds']['samples'][0]
+        assert lat['count'] == 9
+        assert lat['buckets'] == {'0.1': 3, '1': 3, '10': 0, '+Inf': 3}
+
+        proc.kill()
+        proc.wait(timeout=10)
+        assert fc.scrape() == {'ok': 2, 'down': 1}
+        st = fc.fleet_status()
+        assert st['up'] == 2
+        assert st['targets']['child']['up'] is False
+        assert st['targets']['child']['stale'] is True
+        assert st['targets']['child']['last_error']
+        # monotone: the dead child's counted work is still in the total
+        merged = fc.merged()
+        assert merged['fed_tokens_total']['samples'][0]['value'] == 42.0
+        up = {s['labels']['instance']: s['value']
+              for s in to_dict(meta)['fleet_target_up']['samples']}
+        assert up == {'a': 1.0, 'b': 1.0, 'child': 0.0}
+        errs = to_dict(meta)['fleet_scrape_errors_total']['samples']
+        assert {s['labels']['instance']: s['value']
+                for s in errs} == {'child': 1.0}
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+@pytest.mark.chaos
+def test_chaos_partition_mid_scrape_single_trace():
+    """A chaos partition on one target's endpoint mid-scrape: the cycle
+    completes, the partitioned target goes stale (data held, totals
+    exact), and every span of the cycle shares ONE trace_id."""
+    tracer = Tracer(registry=MetricRegistry())
+    fc = FleetCollector(registry=MetricRegistry(), tracer=tracer,
+                        clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=5))
+    fc.add_target('b', registry=_reg(counter=37))
+    assert fc.scrape() == {'ok': 2, 'down': 0}
+    tracer.recorder.clear()
+
+    endpoint = 'inproc://b'
+    with chaos.partition(endpoint) as fault:
+        res = fc.scrape()
+    assert res == {'ok': 1, 'down': 1}
+    assert fault.fired >= 1
+    assert chaos.active_faults() == 0
+    st = fc.fleet_status()
+    assert st['targets']['b']['up'] is False
+    assert st['targets']['b']['stale'] is True
+    # totals monotone through the partition (stale data held)
+    assert fc.merged()['fed_tokens_total']['samples'][0]['value'] == 42.0
+
+    spans = tracer.recorder.spans()
+    cycle = [s for s in spans if s['name'] == 'fleet.scrape']
+    targets = [s for s in spans if s['name'] == 'fleet.scrape.target']
+    assert len(cycle) == 1 and len(targets) == 2
+    assert {s['trace_id'] for s in spans} \
+        == {cycle[0]['trace_id']}                  # one trace per cycle
+    assert all(s['parent_id'] == cycle[0]['span_id'] for s in targets)
+    by_inst = {s['tags']['instance']: s for s in targets}
+    assert by_inst['b']['status'] == 'error'
+    assert by_inst['a']['status'] == 'ok'
+    assert cycle[0]['tags']['ok'] == 1 and cycle[0]['tags']['down'] == 1
+
+    # partition lifted: next cycle recovers the target
+    assert fc.scrape() == {'ok': 2, 'down': 0}
+    assert fc.fleet_status()['targets']['b']['stale'] is False
+
+
+def test_disabled_collector_fetches_nothing():
+    """Disabled federation is inert: scrape() skips even unreachable
+    targets (nothing to time out on) and merged() serves the last
+    view — the plane costs nothing unless someone pulls."""
+    fc = FleetCollector(registry=MetricRegistry(), enabled=True,
+                        clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=8))
+    fc.scrape()
+    fc.disable()
+    # an unreachable HTTP target would raise/timeout if fetched
+    fc.add_target('dead', url='http://127.0.0.1:9/', timeout=0.05)
+    t0 = time.monotonic()
+    assert fc.scrape() == {'ok': 0, 'down': 0, 'skipped': True}
+    assert time.monotonic() - t0 < 0.05
+    assert fc.merged()['fed_tokens_total']['samples'][0]['value'] == 8.0
+    fc.enable()
+    assert fc.scrape() == {'ok': 1, 'down': 1}
+
+
+def test_fleet_snapshot_line_roundtrip():
+    fc = FleetCollector(registry=MetricRegistry(), clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=6, hist=(0.5,)))
+    fc.scrape()
+    line = fleet_snapshot_line(fc, 8, '[dp/mp]')
+    m = FLEET_LINE_RE.search(line)
+    assert m and m.group('n') == '8' and m.group('tag') == 'dp/mp'
+    status = json.loads(m.group('json'))
+    assert status['up'] == 1
+    fam = status['merged']['fed_tokens_total']
+    assert fam['samples'][0]['value'] == 6.0
+    # bucket detail is trimmed from the one-line form (count/sum stay)
+    lat = status['merged']['fed_lat_seconds']['samples'][0]
+    assert lat['count'] == 1 and 'buckets' not in lat
+
+
+# -- /fleet and /alerts routes -----------------------------------------------
+
+def test_server_fleet_and_alerts_routes():
+    fc = FleetCollector(registry=MetricRegistry(), clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=11, hist=(0.05,)))
+    mgr = AlertManager(
+        [ThresholdRule('hot', 'fed_tokens_total', 10.0)],
+        source=fc.merged, registry=MetricRegistry(),
+        recorder=None, clock=time.monotonic)
+    with MetricsServer(registry=MetricRegistry(), collector=fc,
+                       alerts=mgr) as srv:
+        # ?scrape=1 forces a cycle, so the JSON body is fresh
+        body = json.loads(urllib.request.urlopen(
+            srv.url + '/fleet?scrape=1', timeout=5).read().decode())
+        assert body['up'] == 1
+        assert body['merged']['fed_tokens_total']['samples'][0]['value'] \
+            == 11.0
+        # the merged view renders as Prometheus text exposition too
+        prom = urllib.request.urlopen(
+            srv.url + '/fleet?format=prom', timeout=5).read().decode()
+        assert 'fed_tokens_total 11' in prom
+        assert 'fed_lat_seconds_bucket{le="+Inf"} 1' in prom
+
+        body = json.loads(urllib.request.urlopen(
+            srv.url + '/alerts?evaluate=1', timeout=5).read().decode())
+        assert body['firing'] == ['hot']
+        assert body['alerts'][0]['state'] == 'firing'
+
+        # HEAD parity on the new routes (LB probes must not see 501)
+        for path in ('/fleet', '/alerts'):
+            req = urllib.request.Request(srv.url + path, method='HEAD')
+            resp = urllib.request.urlopen(req, timeout=5)
+            assert resp.status == 200
+            assert int(resp.headers['Content-Length']) > 0
+            assert resp.read() == b''
+
+
+def test_server_routes_404_when_unattached():
+    with MetricsServer(registry=MetricRegistry()) as srv:
+        for path in ('/fleet', '/alerts'):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + path, timeout=5)
+            assert ei.value.code == 404
+            req = urllib.request.Request(srv.url + path, method='HEAD')
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+
+
+def test_server_fleet_route_respects_draining_readyz():
+    """The new routes ride the same server as /readyz: a draining
+    process keeps answering /fleet (debugging a drain needs data) while
+    /readyz 503s — route-level, not server-level, drain semantics."""
+    fc = FleetCollector(registry=MetricRegistry(), clock=time.monotonic)
+    fc.add_target('a', registry=_reg(counter=1))
+    fc.scrape()
+    ready = {'ok': False}
+    with MetricsServer(registry=MetricRegistry(), collector=fc,
+                       readiness=lambda: ready['ok']) as srv:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + '/readyz', timeout=5)
+        assert ei.value.code == 503
+        body = json.loads(urllib.request.urlopen(
+            srv.url + '/fleet', timeout=5).read().decode())
+        assert body['up'] == 1
+
+
+# -- alert lifecycle at analytic ticks ---------------------------------------
+
+def _mgr(rules, source_reg, tmp_path=None, cooldown=1e9):
+    """AlertManager over a fake clock; returns (mgr, tick). The huge
+    recorder cooldown proves firing-edge dumps bypass maybe_dump's
+    throttle (the rule lifecycle IS the throttle)."""
+    clock = {'t': 0.0}
+    rec = None
+    if tmp_path is not None:
+        rec = FlightRecorder(dump_dir=str(tmp_path), cooldown=cooldown,
+                             registry=MetricRegistry(),
+                             clock=lambda: clock['t'])
+        rec.record({'name': 'ctx', 'start': 0.0, 'end': 0.1})
+    mgr = AlertManager(rules, source=lambda: to_dict(source_reg),
+                       registry=MetricRegistry(), recorder=rec,
+                       clock=lambda: clock['t'])
+
+    def tick(t):
+        clock['t'] = t
+        return mgr.evaluate()
+    return mgr, tick
+
+
+def test_threshold_rule_lifecycle_exact_ticks(tmp_path):
+    reg = MetricRegistry()
+    g = reg.gauge('occ', 'occupancy')
+    g.set(0.1)
+    rule = ThresholdRule('hot', 'occ', 0.8, op='>', for_duration=10.0,
+                         resolve_after=5.0)
+    mgr, tick = _mgr([rule], reg, tmp_path)
+    assert tick(0.0) == []                      # below threshold
+    g.set(0.9)
+    assert tick(1.0) == [('hot', 'pending')]
+    assert tick(10.9) == []                     # 9.9s held < 10s
+    assert tick(11.0) == [('hot', 'firing')]    # exactly at for_duration
+    assert mgr.firing() == ['hot']
+    # exactly one dump on the edge, regardless of later evaluations
+    dumps = lambda: glob.glob(  # noqa: E731
+        os.path.join(str(tmp_path), 'flight_alert_firing_*.json'))
+    assert len(dumps()) == 1
+    assert tick(12.0) == []
+    assert len(dumps()) == 1
+    # hysteresis: a brief clear + re-assert does NOT resolve
+    g.set(0.1)
+    assert tick(13.0) == []
+    g.set(0.9)
+    assert tick(14.0) == []                     # clear_since reset
+    g.set(0.1)
+    assert tick(20.0) == []
+    assert tick(24.9) == []                     # 4.9s clear < 5s
+    assert tick(25.0) == [('hot', 'resolved')]
+    assert mgr.firing() == []
+    st = mgr.state()[0]
+    assert st['state'] == 'inactive'
+    assert st['fired_count'] == 1 and st['resolved_count'] == 1
+    # a second incident fires again -> a SECOND dump (one per edge)
+    g.set(0.9)
+    tick(30.0)
+    assert tick(40.0) == [('hot', 'firing')]
+    assert len(dumps()) == 2
+    # pending that clears before for_duration never fires
+    g2 = reg.gauge('occ2', 'occupancy2')
+    g2.set(0.9)
+    rule2 = ThresholdRule('warm', 'occ2', 0.8, for_duration=10.0)
+    mgr2, tick2 = _mgr([rule2], reg)
+    assert tick2(0.0) == [('warm', 'pending')]
+    g2.set(0.1)
+    assert tick2(5.0) == [('warm', 'inactive')]
+    assert mgr2.state()[0]['fired_count'] == 0
+
+
+def test_alert_gauges_and_transition_counters():
+    reg = MetricRegistry()
+    reg.gauge('occ', 'occupancy').set(1.0)
+    rule = ThresholdRule('hot', 'occ', 0.5, for_duration=2.0)
+    mgr, tick = _mgr([rule], reg)
+    areg = mgr.registry
+    tick(0.0)
+    snap = to_dict(areg)
+    assert snap['alerts_pending']['samples'][0]['value'] == 1.0
+    assert snap['alerts_firing']['samples'][0]['value'] == 0.0
+    tick(2.0)
+    snap = to_dict(areg)
+    assert snap['alerts_pending']['samples'][0]['value'] == 0.0
+    assert snap['alerts_firing']['samples'][0]['value'] == 1.0
+    trans = {tuple(sorted(s['labels'].items())): s['value']
+             for s in snap['alerts_transitions_total']['samples']}
+    assert trans[(('rule', 'hot'), ('to', 'pending'))] == 1.0
+    assert trans[(('rule', 'hot'), ('to', 'firing'))] == 1.0
+    assert snap['alerts_evaluations_total']['samples'][0]['value'] == 2.0
+
+
+def test_alert_manager_rejects_duplicate_rule_names():
+    with pytest.raises(ValueError):
+        AlertManager([ThresholdRule('x', 'm', 1.0),
+                      ThresholdRule('x', 'm', 2.0)],
+                     source=dict, registry=MetricRegistry(),
+                     recorder=None)
+
+
+# -- burn-rate rules ---------------------------------------------------------
+
+def test_histogram_window_fraction_differencing():
+    w = HistogramWindow(slo_le=0.1)
+    s = {'count': 10, 'buckets': {'0.1': 10, '1': 10, '+Inf': 10}}
+    w.update(s, now=0.0)
+    assert w.fraction(60.0, now=0.0) == 0.0     # no delta yet
+    s = {'count': 20, 'buckets': {'0.1': 10, '1': 15, '+Inf': 20}}
+    w.update(s, now=30.0)
+    # 10 new observations, 10 of them over 0.1s
+    assert w.fraction(60.0, now=30.0) == 1.0
+    s = {'count': 40, 'buckets': {'0.1': 20, '1': 15, '+Inf': 5}}
+    w.update(s, now=60.0)
+    # window 30: vs the t=30 entry -> 20 new, 10 over
+    assert w.fraction(30.0, now=60.0) == 0.5
+    # full horizon: 30 new since t=0, 20 over
+    assert w.fraction(3600.0, now=60.0) == pytest.approx(2 / 3)
+
+
+def test_histogram_window_rejects_non_bucket_slo():
+    w = HistogramWindow(slo_le=0.15)    # not a bound of this histogram
+    with pytest.raises(ValueError):
+        w.update({'count': 1, 'buckets': {'0.1': 1, '+Inf': 1}}, now=0.0)
+    # and a bucketless sample (snapshot taken with buckets=False) raises
+    # instead of silently alerting on garbage
+    w2 = HistogramWindow(slo_le=0.1)
+    with pytest.raises(ValueError):
+        w2.update({'count': 1}, now=0.0)
+
+
+def test_burn_rate_rule_fires_and_resolves_at_analytic_ticks(tmp_path):
+    """objective=0.9 (budget 0.1), one (60s, 10s, 5.0) window pair:
+    firing requires >= 50% of windowed observations over the SLO in
+    BOTH windows. Drive the histogram to cross exactly that line."""
+    reg = MetricRegistry()
+    h = reg.histogram('lat_seconds', 'lat', buckets=(0.1, 1.0))
+    rule = BurnRateRule('slo-burn', 'lat_seconds', slo_le=0.1,
+                        objective=0.9, windows=((60.0, 10.0, 5.0),),
+                        resolve_after=0.0)
+    mgr, tick = _mgr([rule], reg, tmp_path)
+    for _ in range(10):
+        h.observe(0.05)                          # 10 good
+    assert tick(0.0) == []                       # first sample: no delta
+    for _ in range(10):
+        h.observe(5.0)                           # 10 bad
+    edges = tick(5.0)
+    # long: 10 new / 10 over -> frac 1.0 -> burn 10 >= 5; short: same
+    assert edges == [('slo-burn', 'firing')]
+    st = mgr.state()[0]
+    assert st['value'] == pytest.approx(10.0)    # min(long, short) burn
+    # recovery: a flood of good observations dilutes both windows
+    for _ in range(80):
+        h.observe(0.05)
+    edges = tick(12.0)
+    # short window (10s) covers [2, 12] -> only the t=12 delta: 80 new,
+    # 0 over -> burn 0 < 5 -> resolved (resolve_after=0)
+    assert edges == [('slo-burn', 'resolved')]
+    dumps = glob.glob(os.path.join(str(tmp_path),
+                                   'flight_alert_firing_*.json'))
+    assert len(dumps) == 1
+    payload = json.load(open(dumps[0]))
+    assert payload['reason'] == 'alert_firing'
+
+
+def test_burn_rate_needs_both_windows():
+    """An old burst keeps the long window hot while the short window is
+    clean: must NOT fire (the incident is over — SRE workbook rule)."""
+    reg = MetricRegistry()
+    h = reg.histogram('lat_seconds', 'lat', buckets=(0.1, 1.0))
+    rule = BurnRateRule('slo-burn', 'lat_seconds', slo_le=0.1,
+                        objective=0.9, windows=((600.0, 10.0, 5.0),))
+    mgr, tick = _mgr([rule], reg)
+    for _ in range(10):
+        h.observe(5.0)                           # burst, all bad
+    assert tick(0.0) == []
+    tick(1.0)                                    # ring: burst visible
+    for _ in range(10):
+        h.observe(0.05)                          # recovery, all good
+    edges = tick(100.0)
+    # long (600s): 20 obs, 10 over -> burn 5.0 >= 5;
+    # short (10s): only the recovery delta -> 10 obs, 0 over -> burn 0
+    assert edges == []
+    assert mgr.firing() == []
+
+
+def test_federated_burn_source_reads_merged_view():
+    reg_a, reg_b = MetricRegistry(), MetricRegistry()
+    for r in (reg_a, reg_b):
+        r.histogram('gateway_ttft_seconds', 'ttft', buckets=(0.1, 1.0))
+    fc = FleetCollector(registry=MetricRegistry(), clock=time.monotonic)
+    fc.add_target('gw-a', registry=reg_a)
+    fc.add_target('gw-b', registry=reg_b)
+    burn = federated_burn_source(fc, slo_ttft_s=0.1,
+                                 window_s=30.0)
+    fc.scrape()
+    assert burn(0.0) == 0.0
+    # replica B alone burns the fleet SLO; a local-only autoscaler on A
+    # would never see it
+    for _ in range(10):
+        reg_a.get('gateway_ttft_seconds').observe(0.05)
+        reg_b.get('gateway_ttft_seconds').observe(5.0)
+    fc.scrape()
+    assert burn(10.0) == 0.5                     # 20 new, 10 over
+
+
+# -- gateway wiring ----------------------------------------------------------
+
+class _FakeEngine:
+    """The InprocReplica-facing engine surface, no jax: add_request /
+    step / scheduler.queue / trace_counts — one deterministic token per
+    request per step."""
+    num_slots = 4
+    spec_k = 0
+
+    def __init__(self):
+        self.trace_counts = {}               # nothing left to trace
+        self.scheduler = types.SimpleNamespace(queue=[], pending=[])
+        self._live = []
+        self.metrics = None                  # InprocReplica rebinds
+
+    def rebind_perf(self, registry):
+        pass
+
+    def add_request(self, prompt, max_new_tokens=4, **sampling):
+        req = types.SimpleNamespace(prompt=prompt, tokens=[],
+                                    _n=int(max_new_tokens), done=False)
+        self._live.append(req)
+        self.scheduler.pending.append(req)
+        return req
+
+    def step(self):
+        moved = 0
+        for req in list(self._live):
+            req.tokens.append(len(req.tokens))
+            moved += 1
+            if len(req.tokens) >= req._n:
+                req.done = True
+                self._live.remove(req)
+                self.scheduler.pending.remove(req)
+        return moved
+
+
+def test_gateway_attach_fleet_and_federated_burn_override():
+    from paddle_tpu.serving.gateway import AutoscalePolicy, ServingGateway
+    gw = ServingGateway(_FakeEngine, replicas=2,
+                        registry=MetricRegistry(),
+                        autoscaler=AutoscalePolicy(
+                            slo_ttft_s=0.1, max_replicas=4,
+                            sustain_s=0.0, cooldown_s=0.0))
+    fc = FleetCollector(registry=MetricRegistry(), clock=time.monotonic)
+    gw.attach_fleet(fc)
+    assert sorted(t.instance for t in fc.targets()) \
+        == ['gw-replica-0', 'gw-replica-1']
+    reqs = [gw.submit([1, 2], max_new_tokens=3) for _ in range(4)]
+    gw.run()
+    assert all(r.done and r.tokens == [0, 1, 2] for r in reqs)
+    fc.scrape()
+    merged = fc.merged()
+    # per-replica serving gauges survive the merge under `instance`
+    assert 'serving_queue_depth' in merged
+    insts = {s['labels']['instance']
+             for s in merged['serving_queue_depth']['samples']}
+    assert insts == {'gw-replica-0', 'gw-replica-1'}
+
+    # the autoscaler reads the FEDERATED burn when overridden
+    gw.burn_source = lambda now: 0.9
+    decision = gw.autoscale_tick(now=100.0)
+    assert decision.delta == 1               # burn 0.9 >= threshold 0.5
+    assert gw.registry.get('gateway_slo_burn_rate').value() == 0.9
+    # ...and the scaled-up replica self-registered as a target
+    assert sorted(t.instance for t in fc.targets()) \
+        == ['gw-replica-0', 'gw-replica-1', 'gw-replica-2']

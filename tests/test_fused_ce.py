@@ -327,6 +327,7 @@ def test_fused_step_program_has_no_full_logits(monkeypatch):
     assert re.search(r'f32\[%d,%d\]' % (vocab, hidden), fused_jaxpr)
 
 
+@pytest.mark.slow
 def test_bert_fused_mlm_matches_plain():
     """BertForPretraining(fused_mlm=True): same losses/params as the
     straight MLM path, with ~85% ignore_index labels (the MLM shape)."""

@@ -132,6 +132,7 @@ def test_dp_only_grad_allreduce_present():
     assert shape == (HIDDEN, 3 * HIDDEN), shape
 
 
+@pytest.mark.slow
 def test_fused_loss_dp_mp_memory_and_collectives():
     """fused_loss at BERT-base dims under dp2 x mp4 runs VOCAB-PARALLEL.
 

@@ -57,6 +57,7 @@ def test_switch_moe_capacity_drops_to_residual_zero():
     assert nonzero_rows.sum() == 1
 
 
+@pytest.mark.slow
 def test_moe_gpt_trains_on_ep_mesh():
     """GPT with SwitchMoE blocks under dp2 x ep4: fleet step runs, loss
     decreases, expert params sharded over ep in the step's shardings."""

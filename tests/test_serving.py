@@ -48,6 +48,7 @@ def _sequential(model, prompt, mnt, **kw):
     return [int(t) for t in out.numpy()[0][len(prompt):]]
 
 
+@pytest.mark.slow
 def test_greedy_parity_and_zero_retrace(model, prompts):
     """The acceptance bar: token-identical to generate() for mixed
     lengths with slots << requests (forces admit/retire churn), and the
@@ -285,6 +286,7 @@ def test_percentile_is_linear_interpolation_not_nearest_rank():
     assert percentile([1.0, 2.0, 4.0], 75) == pytest.approx(3.0)  # not 2/4
 
 
+@pytest.mark.slow
 def test_paged_greedy_parity_and_bounded_compilation(model, prompts):
     """The paged acceptance bar: token-identical to generate() with
     sequences << requests (page/slot churn), the program set stays at

@@ -2,6 +2,7 @@
 detector runs eager, decodes through yolo_box, post-processes with
 matrix_nms, and round-trips through the AnalysisPredictor facade.
 """
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -13,6 +14,7 @@ def _model():
     return yolo_mod.ppyolov2(num_classes=6, width=8, img_size=64)
 
 
+@pytest.mark.slow
 def test_ppyolov2_train_mode_shapes():
     model = _model()
     model.train()
@@ -26,6 +28,7 @@ def test_ppyolov2_train_mode_shapes():
     assert outs[2].shape == [1, 33, 2, 2]
 
 
+@pytest.mark.slow
 def test_ppyolov2_eval_decode_and_matrix_nms():
     model = _model()
     model.eval()

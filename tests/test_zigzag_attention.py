@@ -51,6 +51,7 @@ def test_zigzag_matches_reference_fwd(sp, n):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_zigzag_matches_reference_grads():
     rng = np.random.RandomState(1)
     b, n, h, d = 1, 16, 2, 8
@@ -96,6 +97,7 @@ def test_zigzag_flops_are_lower_triangle():
     assert zig == ring * (2 * sp + 1) // (4 * sp), (zig, ring)
 
 
+@pytest.mark.slow
 def test_zigzag_dropout_deterministic_and_varying():
     rng = np.random.RandomState(3)
     b, n, h, d = 1, 16, 2, 8
@@ -180,6 +182,7 @@ def test_ulysses_long_causal_uses_blockwise_skip():
     assert flops_causal < 0.7 * flops_full, (flops_causal, flops_full)
 
 
+@pytest.mark.slow
 def test_ulysses_long_causal_grads_match():
     """The blockwise-skip route swaps the BACKWARD program too — grad
     parity vs the quadratic reference through the composed

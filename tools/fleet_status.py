@@ -1,0 +1,215 @@
+"""Unified fleet status: one offline surface over the telemetry plane.
+
+Joins the three artifacts the fleet leaves behind:
+
+  * fleet snapshots — `fleet_snapshot(N)[tag]: {...}` dryrun lines, or
+    a saved `/fleet` JSON body (curl it off any MetricsServer with a
+    FleetCollector attached) -> per-target liveness table + the merged
+    headline counters;
+  * alert state — a saved `/alerts` JSON body -> per-rule state table
+    (firing rules first) with fire/resolve counts;
+  * per-process flight dumps — one `name=DIR` pair per process ->
+    combined Chrome trace with one process lane per name, so a fleet
+    incident reads as aligned timelines in Perfetto (epoch-based span
+    timestamps need no offset bookkeeping — see tracing.spans_to_chrome).
+
+Every section is optional: pass what the deployment produced.
+
+Usage:
+    python tools/fleet_status.py [--fleet FILE|-] [--alerts FILE]
+        [--flight NAME=DIR ...] [--chrome-out TRACE.json]
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS_DIR not in sys.path:
+    sys.path.insert(0, _TOOLS_DIR)
+
+# monitor/ is stdlib-only but the package __init__ pulls in jax — load
+# the subpackage without the parent (the check_metrics_snapshot pattern)
+if 'paddle_tpu' not in sys.modules:
+    _pkg = types.ModuleType('paddle_tpu')
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, 'paddle_tpu')]
+    sys.modules['paddle_tpu'] = _pkg
+
+from paddle_tpu.monitor.federation import FLEET_LINE_RE  # noqa: E402
+from paddle_tpu.monitor.tracing import spans_to_chrome   # noqa: E402
+from perf_report import flight_spans                     # noqa: E402
+
+__all__ = ['parse_fleet_text', 'fleet_section', 'alerts_section',
+           'flight_section', 'report', 'main']
+
+
+def parse_fleet_text(text):
+    """{tag: fleet status dict}. Accepts either captured dryrun output
+    (fleet_snapshot lines, later duplicates of a tag win) or a single
+    raw /fleet JSON body (keyed under tag '')."""
+    out = {}
+    for line in (text or '').splitlines():
+        m = FLEET_LINE_RE.search(line)
+        if not m:
+            continue
+        try:
+            out[m.group('tag')] = json.loads(m.group('json'))
+        except ValueError:
+            continue
+    if not out:
+        try:
+            body = json.loads(text)
+        except ValueError:
+            return {}
+        if isinstance(body, dict) and 'targets' in body:
+            out[''] = body
+    return out
+
+
+def _fmt_val(v):
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return ('%.6g' % v) if isinstance(v, float) else str(v)
+
+
+def fleet_section(status, tag=''):
+    """Text lines for one fleet status dict: liveness table, then the
+    merged counter totals (the 'how much did the FLEET do' headline)."""
+    out = []
+    targets = status.get('targets') or {}
+    out.append('fleet%s: %d/%d targets up'
+               % ((' %s' % tag) if tag else '',
+                  status.get('up', 0), len(targets)))
+    for inst in sorted(targets):
+        t = targets[inst]
+        state = 'up' if t.get('up') else (
+            'down (stale data held)' if t.get('stale') else 'down (no data)')
+        line = ('  %-20s %-22s scrapes=%d errors=%d'
+                % (inst, state, t.get('scrapes', 0), t.get('errors', 0)))
+        if t.get('staleness_s') is not None:
+            line += ' age=%.1fs' % t['staleness_s']
+        if not t.get('up') and t.get('last_error'):
+            line += '  [%s]' % t['last_error']
+        out.append(line)
+    merged = status.get('merged') or {}
+    counters = []
+    for name in sorted(merged):
+        fam = merged[name]
+        if fam.get('type') != 'counter':
+            continue
+        total = sum(float(s.get('value') or 0.0)
+                    for s in fam.get('samples', ()))
+        if total:
+            counters.append((name, total))
+    if counters:
+        out.append('  merged counters:')
+        for name, total in counters:
+            out.append('    %-40s %s' % (name, _fmt_val(total)))
+    return out
+
+
+def alerts_section(body):
+    """Text lines for an /alerts JSON body, firing rules first."""
+    out = []
+    firing = body.get('firing') or []
+    out.append('alerts: %d firing%s'
+               % (len(firing), (' (%s)' % ', '.join(firing))
+                  if firing else ''))
+    entries = body.get('alerts') or []
+    order = {'firing': 0, 'pending': 1}
+    for e in sorted(entries, key=lambda e: (
+            order.get(e.get('state'), 2), e.get('rule', {}).get('name', ''))):
+        rule = e.get('rule') or {}
+        line = ('  %-24s %-8s fired=%d resolved=%d'
+                % (rule.get('name', '?'), e.get('state', '?'),
+                   e.get('fired_count', 0), e.get('resolved_count', 0)))
+        if e.get('value') is not None:
+            line += ' value=%s' % _fmt_val(e['value'])
+        if rule.get('metric'):
+            line += '  [%s]' % rule['metric']
+        out.append(line)
+    return out
+
+
+def flight_section(named_dirs, chrome_out=None):
+    """Join per-process flight dumps into one Chrome trace with a lane
+    per process. `named_dirs` is [(name, dir)]; pids are assigned by
+    position so lanes are stable across re-runs."""
+    out, events = [], []
+    for pid, (name, d) in enumerate(named_dirs, start=1):
+        spans = [s for s, _meta in flight_spans(d)]
+        out.append('flight %s (%s): %d spans' % (name, d, len(spans)))
+        events.extend(spans_to_chrome(spans, pid=pid,
+                                      process_name=name)['traceEvents'])
+    if chrome_out and events:
+        with open(chrome_out, 'w') as f:
+            json.dump({'traceEvents': events}, f)
+        out.append('chrome trace: %s (%d events)'
+                   % (chrome_out, len(events)))
+    return out
+
+
+def report(fleet_text=None, alerts_text=None, named_dirs=(),
+           chrome_out=None):
+    out = []
+    if fleet_text:
+        snaps = parse_fleet_text(fleet_text)
+        for tag in sorted(snaps):
+            out.extend(fleet_section(snaps[tag], tag=tag))
+    if alerts_text:
+        try:
+            body = json.loads(alerts_text)
+        except ValueError:
+            body = None
+        if isinstance(body, dict):
+            out.extend(alerts_section(body))
+        else:
+            out.append('alerts: unparseable body')
+    if named_dirs:
+        out.extend(flight_section(named_dirs, chrome_out=chrome_out))
+    if not out:
+        out.append('nothing to report: pass --fleet, --alerts, '
+                   'or --flight NAME=DIR')
+    return out
+
+
+def _read(arg):
+    if arg == '-':
+        return sys.stdin.read()
+    with open(arg, errors='replace') as f:
+        return f.read()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--fleet',
+                    help='dryrun output with fleet_snapshot lines, a '
+                         'saved /fleet JSON body, or - for stdin')
+    ap.add_argument('--alerts', help='saved /alerts JSON body')
+    ap.add_argument('--flight', action='append', default=[],
+                    metavar='NAME=DIR',
+                    help='per-process flight-dump dir; repeatable')
+    ap.add_argument('--chrome-out',
+                    help='write the combined multi-lane Chrome trace '
+                         'here')
+    args = ap.parse_args(argv)
+    named = []
+    for spec in args.flight:
+        name, sep, d = spec.partition('=')
+        if not sep:
+            ap.error('--flight wants NAME=DIR, got %r' % spec)
+        named.append((name, d))
+    for line in report(
+            fleet_text=_read(args.fleet) if args.fleet else None,
+            alerts_text=_read(args.alerts) if args.alerts else None,
+            named_dirs=named, chrome_out=args.chrome_out):
+        print(line)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -43,8 +43,8 @@ if 'paddle_tpu' not in sys.modules:
 
 from paddle_tpu.monitor.telemetry import parse_snapshot_lines  # noqa: E402
 
-__all__ = ['snapshot_perf', 'flight_recompiles', 'bench_perf_rows',
-           'report', 'main']
+__all__ = ['snapshot_perf', 'flight_spans', 'flight_recompiles',
+           'bench_perf_rows', 'report', 'main']
 
 # bench row fields that form the perf table (satellite keys first)
 _BENCH_COLS = ('compile_s_cold', 'compile_s_warm', 'recompiles',
@@ -119,10 +119,12 @@ def snapshot_perf(snap):
     return out
 
 
-def flight_recompiles(flight_dir):
-    """All perf.recompile / perf.straggler spans across the dir's
-    flight_*.json dumps, newest dump first."""
-    events = []
+def flight_spans(flight_dir):
+    """Every span across the dir's flight_*.json dumps, deduplicated by
+    span_id (consecutive dumps of one ring overlap heavily), paired
+    with its dump metadata: [(span, {'file', 'reason'})], newest dump
+    first so the dedup keeps the freshest copy."""
+    out, seen = [], set()
     for path in sorted(glob.glob(os.path.join(flight_dir,
                                               'flight_*.json')),
                        reverse=True):
@@ -131,12 +133,27 @@ def flight_recompiles(flight_dir):
                 payload = json.load(f)
         except (OSError, ValueError):
             continue
+        meta = {'file': os.path.basename(path),
+                'reason': payload.get('reason')}
         for span in payload.get('spans', ()):
-            if span.get('name') in ('perf.recompile', 'perf.straggler'):
-                events.append({'file': os.path.basename(path),
-                               'reason': payload.get('reason'),
-                               'name': span['name'],
-                               'tags': span.get('tags') or {}})
+            sid = span.get('span_id')
+            if sid is not None and sid in seen:
+                continue
+            seen.add(sid)
+            out.append((span, meta))
+    return out
+
+
+def flight_recompiles(flight_dir):
+    """All perf.recompile / perf.straggler spans across the dir's
+    flight_*.json dumps, newest dump first."""
+    events = []
+    for span, meta in flight_spans(flight_dir):
+        if span.get('name') in ('perf.recompile', 'perf.straggler'):
+            events.append({'file': meta['file'],
+                           'reason': meta['reason'],
+                           'name': span['name'],
+                           'tags': span.get('tags') or {}})
     return events
 
 

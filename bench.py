@@ -75,20 +75,13 @@ def _enable_persistent_cache():
     The axon pool wedges for hours; when it is up, every compiled
     executable lands here so a later bench run (e.g. the driver's
     end-of-round one) skips XLA compilation entirely — a warm window
-    survives a wedged one. See tools/tpu_warmer.py.
+    survives a wedged one. See tools/tpu_warmer.py. One configuration
+    path repo-wide (framework/compile_cache.py): PADDLE_TPU_CACHE_DIR
+    keeps working, and the module's hit/miss tallies feed the
+    compile_cache_hit_rate bench column.
     """
-    import jax
-    cache_dir = os.environ.get(
-        'PADDLE_TPU_CACHE_DIR',
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     '.jax_cache'))
-    try:
-        jax.config.update('jax_enable_compilation_cache', True)
-        jax.config.update('jax_compilation_cache_dir', cache_dir)
-        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0)
-        jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
-    except Exception:
-        pass  # older jax without some knob: cache is best-effort
+    from paddle_tpu.framework import compile_cache
+    return compile_cache.configure()
 
 
 def _run_measurement():
@@ -236,6 +229,10 @@ def _run_measurement():
         jax.profiler.stop_trace()
     recompiles = wd.recompiles
     wd.close()
+    # persistent-cache effectiveness of THIS process's compiles: 1.0 on
+    # a fully warmed cache (the cold-start rung), ~0 on a fresh one
+    from paddle_tpu.framework import compile_cache
+    cache_hit_rate = compile_cache.hit_rate()
 
     # cost-model block: analytic FLOPs/bytes of the single-step program
     # (per-step numbers even under scan), plus a warm compile time — the
@@ -295,6 +292,8 @@ def _run_measurement():
         **({'compile_s_warm': round(compile_s_warm, 3)}
            if compile_s_warm is not None else {}),
         'recompiles': recompiles,
+        **({'compile_cache_hit_rate': round(cache_hit_rate, 4)}
+           if cache_hit_rate is not None else {}),
         **({'mfu_est': round(perf_est['mfu_est'], 4),
             'arithmetic_intensity':
                 round(perf_est['arithmetic_intensity'], 2),

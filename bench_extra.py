@@ -30,8 +30,9 @@ def _platform():
 
 
 def _enable_cache():
-    # same repo-local persistent XLA cache bench.py children use: every
-    # executable compiled in an up-window is a warm artifact later
+    # same repo-local persistent XLA cache bench.py children use (one
+    # config path: framework/compile_cache.py): every executable
+    # compiled in an up-window is a warm artifact later
     import bench
     bench._enable_persistent_cache()
 
@@ -267,6 +268,13 @@ def _perf_fields(eng, t_cold=None, bursts=None, wall=None):
         out['roofline_bound'] = est['roofline_bound']
         if 'mfu_est' in est:
             out['mfu_est'] = round(est['mfu_est'], 4)
+    try:
+        from paddle_tpu.framework import compile_cache
+        hr = compile_cache.hit_rate()
+        if hr is not None:
+            out['compile_cache_hit_rate'] = round(hr, 4)
+    except Exception:
+        pass
     return out
 
 

@@ -16,6 +16,10 @@ and *constrain* it where propagation guesses wrong. Three parts:
              engines place on their carry / microbatch-slice /
              collective boundaries so producer and consumer shardings
              reach GSPMD already compatible.
+  tuner    — searches candidate specs per boundary (scored by audit
+             reshard bytes + HLO collective bytes + the analytic cost
+             model) and emits content-addressed plan artifacts the
+             engines resolve instead of the hand-derived specs.
 
 CI surface: `assert_no_involuntary_resharding(fn, mesh=..., args=...)`
 from any test, and the MULTICHIP dryrun embeds one report per config
@@ -28,6 +32,10 @@ from .audit import (ShardingAuditReport, capture_compiler_stderr,
                     audit_callable, audit_train_step, audit_from_text,
                     assert_no_involuntary_resharding)
 from .planner import PipelinePlan, plan_pipeline, plan_for_state
+from .tuner import (TunedPlan, PlanKeyError, tune_pipeline, resolve_plan,
+                    resolve_plan_for_state, save_plan, load_plan,
+                    verify_artifact, plan_from_artifact, score_report,
+                    score_key, current_config, key_of_config)
 
 __all__ = [
     'ShardingEvent', 'parse_spmd_warnings', 'parse_hlo_collectives',
@@ -36,4 +44,8 @@ __all__ = [
     'audit_train_step', 'audit_from_text',
     'assert_no_involuntary_resharding',
     'PipelinePlan', 'plan_pipeline', 'plan_for_state',
+    'TunedPlan', 'PlanKeyError', 'tune_pipeline', 'resolve_plan',
+    'resolve_plan_for_state', 'save_plan', 'load_plan',
+    'verify_artifact', 'plan_from_artifact', 'score_report', 'score_key',
+    'current_config', 'key_of_config',
 ]

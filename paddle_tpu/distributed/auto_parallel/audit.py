@@ -9,7 +9,10 @@ testable from stored fixtures without compiling anything.
 Every audit entry point compiles FRESH (a new jax.jit wrapper, or
 TrainStep.compiled_executable which re-lowers each call): XLA only
 emits the warnings while actually partitioning, so auditing a cached
-executable would report a false pass.
+executable would report a false pass. For the same reason the compile
+runs with the PERSISTENT compilation cache suspended
+(framework/compile_cache.py makes it process-wide) — a cache hit skips
+the partitioner entirely and would silently report clean.
 """
 import contextlib
 import os
@@ -116,6 +119,42 @@ def audit_from_text(stderr_text, hlo_text=None, label=''):
 
 
 @contextlib.contextmanager
+def _compile_cache_suspended():
+    """Force the audited compile through XLA even when the process has a
+    persistent compile cache configured (restored on exit). The config
+    flip alone is not enough: jax memoizes cache-in-use at the first
+    compile of the process (compilation_cache._cache_checked), so the
+    latch must be dropped on BOTH transitions for the flip to be seen."""
+    try:
+        was = bool(jax.config.jax_enable_compilation_cache)
+    except Exception:
+        yield
+        return
+    if not was:
+        yield
+        return
+    try:
+        from ...framework.compile_cache import _drop_cache_latch
+    except Exception:
+        def _drop_cache_latch():
+            pass
+    try:
+        jax.config.update('jax_enable_compilation_cache', False)
+    except Exception:
+        yield
+        return
+    _drop_cache_latch()
+    try:
+        yield
+    finally:
+        try:
+            jax.config.update('jax_enable_compilation_cache', True)
+        except Exception:
+            pass
+        _drop_cache_latch()
+
+
+@contextlib.contextmanager
 def _mesh_scope(mesh):
     """Make `mesh` the ambient mesh for PartitionSpec-based constraints
     inside the audited fn, across jax generations."""
@@ -140,7 +179,7 @@ def audit_callable(fn, args=(), kwargs=None, mesh=None, label=''):
     wrapped = jax.jit(lambda *a, **k: fn(*a, **k))
     with _mesh_scope(mesh):
         lowered = wrapped.lower(*args, **kwargs)
-        with capture_compiler_stderr() as cap:
+        with _compile_cache_suspended(), capture_compiler_stderr() as cap:
             compiled = lowered.compile()
     try:
         hlo = compiled.as_text()
@@ -154,7 +193,7 @@ def audit_train_step(step, inputs, labels, label=''):
     """Audit a framework.functional.TrainStep for one batch. Uses
     compiled_executable (which re-lowers+recompiles every call, so the
     partitioner warnings are emitted even for a step that already ran)."""
-    with capture_compiler_stderr() as cap:
+    with _compile_cache_suspended(), capture_compiler_stderr() as cap:
         compiled = step.compiled_executable(inputs, labels)
     try:
         hlo = compiled.as_text()

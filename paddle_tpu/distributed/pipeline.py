@@ -37,7 +37,7 @@ from ..framework import functional as func_mod
 from ..framework import random as rng_mod
 from ..framework.core import Tensor
 from .shard_map_compat import shard_map
-from .auto_parallel import planner as ap_planner
+from .auto_parallel import tuner as ap_tuner
 
 __all__ = ['PipelineEngine', 'make_pp_state', 'pp_scope', 'pipeline_state',
            'pipeline_blocks', 'pipeline_stage_fns']
@@ -249,8 +249,10 @@ def pipeline_blocks(blocks, x, state):
     # pin the Auto-axis shardings at the region boundary (auto_parallel
     # planner): the micro reshape and the stacked stage params are where
     # GSPMD otherwise guesses and falls back to involuntary replication
-    # inside the while body (MULTICHIP r05 cfg5 warnings)
-    plan = ap_planner.plan_for_state(st)
+    # inside the while body (MULTICHIP r05 cfg5 warnings). Specs come
+    # from a tuned plan artifact when PADDLE_TPU_PLAN_DIR has one for
+    # this mesh, else from the analytic planner.
+    plan = ap_tuner.resolve_plan_for_state(st)
     if plan is not None:
         stacked = plan.constrain_stacked(stacked)
         micro = plan.constrain_micro(micro)
@@ -331,7 +333,7 @@ def pipeline_stage_fns(stage_fns, x, state, params=None, rebind=None):
                    in_specs=({n: P() for n in params}, P(), P()),
                    out_specs=P(), axis_names={axis}, check_vma=False)
     micro = _split_micro(x_arr, n_micro).astype(wire)
-    plan = ap_planner.plan_for_state(st)
+    plan = ap_tuner.resolve_plan_for_state(st)
     if plan is not None:  # see pipeline_blocks: pin the micro boundary
         micro = plan.constrain_micro(micro)
     out = fn(boundary, micro, base_key)

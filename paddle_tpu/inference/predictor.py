@@ -213,19 +213,15 @@ class Predictor:
         """Config.set_optim_cache_dir maps onto jax's persistent
         compilation cache (the reference persists its IR-pass/TensorRT
         engine cache there; here the compiled XLA executables persist, so
-        a restarted server skips compilation entirely)."""
+        a restarted server skips compilation entirely). Routed through
+        framework.compile_cache — the one repo-wide configuration path —
+        so several Predictors (or a Predictor plus the bench harness) in
+        one process configure the cache once, idempotently."""
         cache_dir = self._config._cache_dir
         if not cache_dir:
             return
-        try:
-            jax.config.update('jax_enable_compilation_cache', True)
-            jax.config.update('jax_compilation_cache_dir', cache_dir)
-            jax.config.update('jax_persistent_cache_min_compile_time_secs',
-                              0)
-            jax.config.update('jax_persistent_cache_min_entry_size_bytes',
-                              -1)
-        except Exception:
-            pass  # older jax without some knob: cache is best-effort
+        from ..framework import compile_cache
+        compile_cache.configure(cache_dir)
 
     def _load(self):
         from .. import jit as jit_mod

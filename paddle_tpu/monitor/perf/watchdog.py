@@ -22,6 +22,17 @@ honest — a recompile is only charged to a watchdog whose owner object
 appears on the compiling stack (so replica A's warm barrier is not
 tripped by replica B's first compile). With no owner, every post-warmup
 compile counts.
+
+Persistent-cache composition: jax fires the backend-compile duration
+event even when ``compiler.compile_or_get_cached`` was served from the
+persistent compilation cache (the event wraps the whole call), so a
+cache-hit *reload* after ``declare_warmup()`` used to count as a
+recompile. The watchdog now diffs ``framework.compile_cache``'s
+per-thread hit/miss tallies around every compile event: a fresh hit is
+exported as ``perf_persistent_cache_hits_total`` and exempted from the
+recompile path; a miss (or a cache-less compile) stays a violation.
+Each watchdog keeps its own per-thread marks, so several watchdogs on
+one registry classify every compile independently and identically.
 """
 import contextlib
 import os
@@ -124,6 +135,14 @@ class CompileWatchdog:
         self._h_seconds = {k: fams['perf_compile_seconds'].labels(k)
                            for k in _KINDS}
         self._m_recompiles = fams['perf_recompiles_total']
+        self._m_cache_hits = fams['perf_persistent_cache_hits_total']
+        self._m_cache_misses = fams['perf_persistent_cache_misses_total']
+        try:
+            from ...framework import compile_cache as _cc
+        except Exception:
+            _cc = None
+        self._cc = _cc
+        self._cc_marks = threading.local()  # this watchdog's own marks
         self.enabled = True
         self.armed = False
         self.warmup_label = None
@@ -221,8 +240,44 @@ class CompileWatchdog:
             self._h_seconds[kind].observe(float(duration))
         except Exception:
             return              # accounting must never break a compile
-        if kind == 'compile' and self.armed:
+        if kind != 'compile':
+            return
+        cache_hit = False
+        try:
+            cache_hit = self._classify_cache()
+        except Exception:
+            pass                # classification must never break a compile
+        if self.armed and not cache_hit:
             self._on_recompile(float(duration))
+
+    def _classify_cache(self):
+        """Diff compile_cache's per-thread lookup tallies against this
+        watchdog's marks: returns True when the compile event being
+        handled was a persistent-cache HIT (exempt from the recompile
+        rule), publishing the hit/miss counters along the way. The
+        lookup event fires on the compiling thread before the duration
+        event does, so the fresh delta belongs to this compile."""
+        if self._cc is None:
+            return False
+        hits, misses, last = self._cc.thread_state()
+        marks = self._cc_marks
+        prev = getattr(marks, 'state', None)
+        marks.state = (hits, misses)
+        if prev is None:
+            # first compile event this watchdog sees on this thread:
+            # only the lookup belonging to THIS compile is fresh —
+            # earlier lookups predate the watchdog (or its thread) and
+            # must not be charged to it
+            dh = 1 if last == 'hit' else 0
+            dm = 1 if last == 'miss' else 0
+        else:
+            dh = hits - prev[0]
+            dm = misses - prev[1]
+        if dh > 0:
+            self._m_cache_hits.inc(dh)
+        if dm > 0:
+            self._m_cache_misses.inc(dm)
+        return dh > 0 and dm == 0
 
     def _on_recompile(self, duration):
         callsite, signature, owners = _walk_attribution()

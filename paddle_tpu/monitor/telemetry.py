@@ -148,6 +148,11 @@ PERF_FAMILIES = (
     ('gauge', 'perf_roofline_bound',
      'roofline classification of the compiled step '
      '(0=bandwidth 1=compute)', ()),
+    ('counter', 'perf_persistent_cache_hits_total',
+     'backend compiles served from the persistent compile cache '
+     '(framework/compile_cache.py)', ()),
+    ('counter', 'perf_persistent_cache_misses_total',
+     'backend compiles that missed the persistent compile cache', ()),
 )
 
 

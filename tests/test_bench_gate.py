@@ -114,6 +114,60 @@ def test_compile_seconds_gate_as_derived_rows():
     assert gate.check(fast, best) == []
 
 
+def test_cache_hit_rate_gates_as_higher_is_better():
+    """compile_cache_hit_rate contains 'compile' but must NOT inherit
+    the compile-time direction: a warmed persistent cache losing its
+    hits is a downward regression, like throughput."""
+    best = [_row(1000.0, compile_s_cold=8.0, compile_cache_hit_rate=0.9)]
+    derived = gate.expand_derived(best)
+    hr = next(r for r in derived if r['metric'].endswith('_hit_rate'))
+    assert hr['unit'] == 'ratio' and hr['value'] == 0.9
+    assert gate.higher_is_better(hr)
+    dropped = [_row(1000.0, compile_s_cold=8.0,
+                    compile_cache_hit_rate=0.5)]
+    findings = gate.check(dropped, best)
+    assert len(findings) == 1
+    assert findings[0]['metric'] == \
+        'train_tokens_per_sec_compile_cache_hit_rate'
+    assert findings[0]['direction'] == 'down'
+    improved = [_row(1000.0, compile_s_cold=8.0,
+                     compile_cache_hit_rate=0.95)]
+    assert gate.check(improved, best) == []
+
+
+def test_trust_degraded_admits_cpu_rows():
+    """The compile-cache rungs are measured on CPU: invisible to the
+    default gate (they must never displace real-TPU bests), gated
+    against their own baseline under --trust-degraded. Suspect and
+    errored rows stay out even when trusted."""
+    cpu_best = [_row(100.0, platform='cpu', degraded=True)]
+    cpu_new = [_row(80.0, platform='cpu', degraded=True)]
+    assert not gate.eligible(cpu_new[0])
+    assert gate.check(cpu_new, cpu_best) == []
+    findings = gate.check(cpu_new, cpu_best, trust_degraded=True)
+    assert len(findings) == 1 and findings[0]['direction'] == 'down'
+    assert not gate.eligible(_row(10.0, suspect=True), trust_degraded=True)
+    assert not gate.eligible(_row(10.0, error='x'), trust_degraded=True)
+
+
+def test_cli_trust_degraded_flag(tmp_path):
+    best_p = tmp_path / 'best.jsonl'
+    new_p = tmp_path / 'new.jsonl'
+    best_p.write_text(json.dumps(_row(100.0, platform='cpu')) + '\n')
+    new_p.write_text(json.dumps(_row(50.0, platform='cpu')) + '\n')
+    script = os.path.join(_REPO, 'tools', 'check_bench_regression.py')
+    base = [sys.executable, script, '--new', str(new_p),
+            '--baseline', str(best_p)]
+    # default: CPU rows are ineligible on both sides -> no findings
+    assert subprocess.run(base, capture_output=True,
+                          cwd=_REPO).returncode == 0
+    # trusted: the -50% regression is caught
+    r = subprocess.run(base + ['--trust-degraded'], capture_output=True,
+                       text=True, cwd=_REPO)
+    assert r.returncode == 1, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[0])['regression']
+
+
 def test_aux_workload_fields_split_configs():
     """Serving-rung rows at different slot counts are different configs
     even though their knob env is identical."""
@@ -149,6 +203,28 @@ def test_cli_exit_codes(tmp_path):
     empty = tmp_path / 'empty.jsonl'
     empty.write_text('')
     assert run(empty).returncode == 2                  # nothing to check
+
+
+def test_repo_cache_rows_pin_cold_start_win():
+    """The committed CPU cache demonstration (docs/bench_cache_cpu.jsonl,
+    measured cold-process via PADDLE_TPU_BENCH_CHILD=1 with
+    PADDLE_TPU_CACHE_DIR at a fresh dir, then again at the warmed dir):
+    the warm run compiles >=3x faster at full persistent-cache hit rate
+    on both measured configs, the rows are invisible to the default
+    (TPU-only) gate, and the file self-gates under --trust-degraded."""
+    path = os.path.join(_REPO, 'docs', 'bench_cache_cpu.jsonl')
+    rows = gate._load_jsonl(path)
+    assert rows, 'missing committed cache bench rows'
+    assert all(gate.eligible(r, trust_degraded=True) for r in rows)
+    assert not any(gate.eligible(r) for r in rows)
+    by_label = {r['label']: r for r in rows}
+    for cfg in ('plain', 'scan2'):
+        cold = by_label['cache_cold_%s' % cfg]
+        warm = by_label['cache_warm_%s' % cfg]
+        assert warm['compile_cache_hit_rate'] > 0
+        assert warm['recompiles'] == 0
+        assert cold['compile_s_cold'] >= 3 * warm['compile_s_cold']
+    assert gate.check(rows, rows, trust_degraded=True) == []
 
 
 def test_repo_stored_best_passes_gate():

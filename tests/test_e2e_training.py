@@ -14,6 +14,7 @@ from paddle_tpu.io import DataLoader
 from paddle_tpu.framework.functional import TrainStep
 
 
+@pytest.mark.slow
 def test_lenet_eager_convergence():
     paddle.seed(42)
     model = LeNet()

@@ -175,6 +175,7 @@ def test_gpt_fused_loss_trains_identically():
                                     err_msg=k)
 
 
+@pytest.mark.slow
 def test_gpt_fused_loss_adamw_loss_trajectory():
     l0, _ = _train_steps(fused=False, steps=3, optimizer='adamw')
     l1, _ = _train_steps(fused=True, steps=3, optimizer='adamw')
@@ -268,6 +269,7 @@ def test_fused_loss_composes_with_schedules(name, kw):
                                err_msg=name)
 
 
+@pytest.mark.slow
 def test_fused_loss_with_remat_and_grad_merge():
     """jax.checkpoint over the custom_vjp + k-step accumulation."""
     import paddle_tpu as paddle

@@ -22,6 +22,7 @@ def _model(**kw):
     return m
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_full_forward():
     m = _model()
     rng = np.random.RandomState(0)
@@ -37,6 +38,7 @@ def test_greedy_generate_matches_full_forward():
     np.testing.assert_array_equal(out.numpy(), ids)
 
 
+@pytest.mark.slow
 def test_sampling_deterministic_and_in_topk():
     m = _model()
     rng = np.random.RandomState(2)

@@ -116,6 +116,7 @@ def test_zero3_hlo_has_gather_scatter_and_sharded_params():
     assert np.prod(shape) == HIDDEN * 4 * HIDDEN // 8, shape
 
 
+@pytest.mark.slow
 def test_dp_only_grad_allreduce_present():
     """Plain dp8: exactly the gradient all-reduce family, nothing else —
     and batch input is sharded over dp (data really parallel)."""
@@ -173,6 +174,7 @@ def test_fused_loss_dp_mp_memory_and_collectives():
     assert fused_tmp < plain_tmp, (fused_tmp, plain_tmp)
 
 
+@pytest.mark.slow
 def test_fused_loss_multichunk_stays_dp_balanced(monkeypatch):
     """The STRIDED chunk layout (fused_ce chunk i = rows i::n): with the
     row axis dp-sharded and n > 1 chunks, no chunk may concentrate on

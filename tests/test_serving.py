@@ -65,6 +65,7 @@ def test_greedy_parity_and_zero_retrace(model, prompts):
     assert eng.scheduler.pending == 0
 
 
+@pytest.mark.slow
 def test_sampling_stream_parity(model, prompts):
     """Per-request PRNG streams mirror generate(): same seed, same
     temperature/top-k, same sampled tokens."""
@@ -77,6 +78,7 @@ def test_sampling_stream_parity(model, prompts):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_per_request_sampling_params(model, prompts):
     """Requests with DIFFERENT sampling configs share the batch; each
     must match its own sequential run (the vectorized pick must not mix
@@ -96,6 +98,7 @@ def test_per_request_sampling_params(model, prompts):
     assert [r.tokens for r in reqs] == expect
 
 
+@pytest.mark.slow
 def test_slot_reuse_no_crosstalk(model, prompts):
     """A slot's next occupant sees none of the previous one: running the
     same workload at 2 slots (heavy reuse) and at 8 slots (no reuse)
@@ -109,6 +112,7 @@ def test_slot_reuse_no_crosstalk(model, prompts):
     assert outs[0] == outs[1]
 
 
+@pytest.mark.slow
 def test_varied_budgets_and_immediate_finish(model, prompts):
     """max_new_tokens=1 finishes at prefill; longer budgets coexist in
     the same burst and each stops exactly at its own budget."""
@@ -132,6 +136,7 @@ def test_stream_yields_all_tokens(model, prompts):
     assert streamed == _sequential(model, prompts[0], 9)
 
 
+@pytest.mark.slow
 def test_thread_safe_front_door(model, prompts):
     """Several threads submit and drive concurrently; every request
     still matches its sequential run (the lock serializes steps, the
@@ -371,6 +376,7 @@ def test_paged_sampling_stream_parity(model, prompts):
     assert got == expect
 
 
+@pytest.mark.slow
 def test_predictor_decode_engine(model, prompts, tmp_path):
     """The serving front door reached the inference API: a jit.save'd
     causal LM round-trips into an engine whose output matches the live

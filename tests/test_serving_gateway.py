@@ -419,6 +419,7 @@ def test_threaded_concurrent_submitters(model, prompts, reference):
     assert [results[i] for i in range(len(prompts))] == reference
 
 
+@pytest.mark.slow
 def test_predictor_decode_gateway(model, prompts, tmp_path):
     """The fleet front door reached the inference API: a jit.save'd
     causal LM round-trips into a gateway whose pooled output matches

@@ -137,6 +137,7 @@ def test_recompute_plain_model_falls_back_to_global_remat():
     assert np.isfinite(float(step(x, y).numpy()))
 
 
+@pytest.mark.slow
 def test_fp16_amp_dynamic_loss_scaling():
     """pure-fp16 engages loss scaling; finite steps advance the growth
     counter and training proceeds on fp32 master weights."""
@@ -171,6 +172,7 @@ def test_fp16_amp_dynamic_loss_scaling():
         np.testing.assert_array_equal(before, after)
 
 
+@pytest.mark.slow
 def test_sp_with_dropout_builds_and_steps():
     """r3 raised at build time; since r4 sp composes with dropout via
     sp-aware folded keys (full coverage: tests/test_dropout_parallel.py)."""

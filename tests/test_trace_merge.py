@@ -6,6 +6,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 spawn_mod = importlib.import_module('paddle_tpu.distributed.spawn')
 
@@ -26,6 +27,7 @@ def _profiled_worker():
             (x @ x).block_until_ready()
 
 
+@pytest.mark.slow
 def test_two_proc_traces_merge(tmp_path):
     base = tmp_path / 'traces'
     os.environ['PADDLE_TRAINER_TRACE_DIR'] = str(base)

@@ -50,6 +50,7 @@ def test_ppyolov2_eval_decode_and_matrix_nms():
     assert np.all(o[n0:20, 0] == -1)
 
 
+@pytest.mark.slow
 def test_ppyolov2_through_predictor(tmp_path):
     from paddle_tpu import jit
     from paddle_tpu import inference

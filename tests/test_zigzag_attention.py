@@ -148,6 +148,7 @@ def test_zigzag_falls_back_when_not_applicable():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_long_causal_uses_blockwise_skip():
     """Ulysses' local full-sequence attention routes through the causal
     block-skip path at long N: parity with the quadratic reference AND

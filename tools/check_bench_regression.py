@@ -50,18 +50,29 @@ __all__ = ['eligible', 'config_key', 'higher_is_better', 'expand_derived',
 # row fields that gate as first-class metrics of their own. Synthesized
 # as pseudo-rows ('<metric>_compile_s_cold', unit 's') rather than added
 # to _AUX_CONFIG: an aux field would bucket-split every existing config
-# and orphan the stored bests.
-_DERIVED_KEYS = ('compile_s_cold', 'compile_s_warm')
+# and orphan the stored bests. compile_cache_hit_rate (unit 'ratio')
+# regresses DOWNWARD like throughput — a warmed persistent cache losing
+# its hits is exactly the cold-start regression this column exists for.
+_DERIVED_KEYS = ('compile_s_cold', 'compile_s_warm',
+                 'compile_cache_hit_rate')
+_DERIVED_UNITS = {'compile_cache_hit_rate': 'ratio'}
 
 
-def eligible(row):
-    """bench._best_capture's trust rule: real-TPU, clean, measured."""
-    return (row.get('platform', 'tpu') == 'tpu'
-            and not row.get('degraded')
-            and not row.get('suspect')
+def eligible(row, trust_degraded=False):
+    """bench._best_capture's trust rule: real-TPU, clean, measured.
+    `trust_degraded` relaxes the platform/degraded half — the
+    compile-cache rungs are measured on CPU (XLA compile + persistent
+    cache behave identically there) and gate via an explicit
+    --trust-degraded invocation against their own committed baseline,
+    never against the real-TPU bests."""
+    if not (not row.get('suspect')
             and 'error' not in row
             and isinstance(row.get('value'), (int, float))
-            and row.get('metric'))
+            and row.get('metric')):
+        return False
+    if trust_degraded:
+        return True
+    return row.get('platform', 'tpu') == 'tpu' and not row.get('degraded')
 
 
 def config_key(row):
@@ -74,8 +85,12 @@ def config_key(row):
 
 def higher_is_better(row):
     """Throughput-style metrics regress DOWN; latency-style and
-    compile-time metrics regress UP."""
+    compile-time metrics regress UP. hit_rate is checked first: cache
+    hit rates are higher-is-better even though 'compile' is in the
+    metric name."""
     text = '%s %s' % (row.get('metric', ''), row.get('unit', ''))
+    if 'hit_rate' in text:
+        return True
     return not ('ms' in text.split() or 'latency' in text
                 or text.endswith('_ms') or 'compile' in text)
 
@@ -95,12 +110,12 @@ def expand_derived(rows):
                 derived = dict(row)
                 derived['metric'] = '%s_%s' % (row['metric'], key)
                 derived['value'] = float(val)
-                derived['unit'] = 's'
+                derived['unit'] = _DERIVED_UNITS.get(key, 's')
                 out.append(derived)
     return out
 
 
-def check(new_rows, baseline_rows, threshold=0.10):
+def check(new_rows, baseline_rows, threshold=0.10, trust_degraded=False):
     """Pure gate: list of regression findings (empty == pass).
 
     For every config present in BOTH logs, the best new value must not
@@ -111,7 +126,7 @@ def check(new_rows, baseline_rows, threshold=0.10):
     def best_by_config(rows):
         best = {}
         for row in rows:
-            if not eligible(row):
+            if not eligible(row, trust_degraded=trust_degraded):
                 continue
             key = config_key(row)
             cur = best.get(key)
@@ -168,6 +183,9 @@ def main(argv=None):
                          'repo in-window logs)')
     ap.add_argument('--threshold', type=float, default=0.10,
                     help='allowed fractional regression (default 0.10)')
+    ap.add_argument('--trust-degraded', action='store_true',
+                    help='admit non-TPU/degraded rows (compile-cache CPU '
+                         'rungs gating against their own baseline)')
     args = ap.parse_args(argv)
 
     baselines = args.baseline
@@ -181,7 +199,8 @@ def main(argv=None):
         return gate_common.nothing_to_check(
             'nothing to compare (new=%d baseline=%d eligible rows '
             'pre-filter)' % (len(new_rows), len(base_rows)))
-    findings = check(new_rows, base_rows, threshold=args.threshold)
+    findings = check(new_rows, base_rows, threshold=args.threshold,
+                     trust_degraded=args.trust_degraded)
     return gate_common.finish(
         findings, {'regressions': 0, 'threshold': args.threshold})
 

@@ -1,0 +1,122 @@
+"""Offline plan-artifact report + integrity gate.
+
+Renders the sharding autotuner's content-addressed plan artifacts
+(distributed/auto_parallel/tuner.py, `plan_<key>.json`) as a human
+report — per-boundary chosen spec, the full candidate table with the
+score breakdown (involuntary-reshard bytes / HLO collective bytes /
+analytic ideal step time), and the content key with the config it
+derives from — and gates their integrity the way the engines'
+PADDLE_TPU_PLAN_STRICT=1 mode would: a stored key that does not
+re-derive from its stored config, or an unsupported plan version, is a
+finding.
+
+Speaks the gate_common protocol (exit 0 clean, 1 findings, 2 nothing
+to check) so CI can point it at a committed plan directory.
+
+Usage:
+    python tools/plan_report.py PLAN.json [PLAN.json ...]
+    python tools/plan_report.py --plan-dir DIR   # every plan_*.json
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# the tuner imports jax; an offline report must not grab a TPU
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+from tools import gate_common  # noqa: E402
+
+__all__ = ['check_artifact', 'render', 'main']
+
+
+def _fmt_score(score):
+    parts = ['involuntary=%dB' % int(score.get('involuntary_bytes', 0)),
+             'collectives=%dB/%d' % (int(score.get('collective_bytes', 0)),
+                                     int(score.get('collective_count', 0)))]
+    if score.get('ideal_step_s') is not None:
+        parts.append('ideal=%.3gs' % float(score['ideal_step_s']))
+    return ' '.join(parts)
+
+
+def _fmt_spec(spec):
+    if spec is None:
+        return '<planner default>'
+    return 'P(%s)' % ', '.join(
+        '(%s)' % ','.join(e) if isinstance(e, list)
+        else {None: 'None'}.get(e, repr(e)) for e in spec)
+
+
+def check_artifact(art, path, tuner):
+    """Integrity findings for one loaded artifact (empty == sound)."""
+    try:
+        tuner.verify_artifact(art)
+    except tuner.PlanKeyError as e:
+        return [{'path': path, 'key': art.get('key'), 'error': str(e)}]
+    return []
+
+
+def render(art, path, out):
+    cfg = art.get('config') or {}
+    mesh = ' '.join('%s=%s' % kv for kv in sorted(
+        (cfg.get('mesh') or {}).items()))
+    out.write('plan %s  (%s)\n' % (art.get('key'), path))
+    out.write('  config: mesh[%s] axis=%s batch_axes=%s jaxlib=%s '
+              'model=%s\n'
+              % (mesh, cfg.get('axis'),
+                 ','.join(cfg.get('batch_axes') or ()) or '-',
+                 cfg.get('jaxlib'), cfg.get('model')))
+    if art.get('probe_compiles') is not None:
+        out.write('  search: %s probe compiles, final %s\n'
+                  % (art['probe_compiles'],
+                     _fmt_score(art.get('final_score') or {})))
+    for b, d in sorted((art.get('boundaries') or {}).items()):
+        out.write('  %-8s -> %-28s %s\n'
+                  % (b, _fmt_spec(d.get('spec')),
+                     _fmt_score(d.get('score') or {})))
+        for t in d.get('candidates') or ():
+            if not t.get('chosen'):
+                out.write('  %-8s    %-28s %s\n'
+                          % ('', _fmt_spec(t.get('spec')),
+                             _fmt_score(t.get('score') or {})))
+    out.write('\n')
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('plans', nargs='*', help='plan artifact JSON files')
+    ap.add_argument('--plan-dir', default=None,
+                    help='report every plan_*.json in this directory '
+                         '(default: $PADDLE_TPU_PLAN_DIR when set)')
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.distributed.auto_parallel import tuner
+
+    paths = list(args.plans)
+    dirpath = args.plan_dir or (os.environ.get('PADDLE_TPU_PLAN_DIR')
+                                if not paths else None)
+    if dirpath:
+        paths += sorted(glob.glob(os.path.join(dirpath, 'plan_*.json')))
+    if not paths:
+        return gate_common.nothing_to_check('no plan artifacts given')
+
+    findings, reported = [], 0
+    for path in paths:
+        try:
+            art = tuner.load_plan(path)
+        except (ValueError, OSError) as e:
+            findings.append({'path': path, 'error': 'unreadable: %s' % e})
+            continue
+        findings.extend(check_artifact(art, path, tuner))
+        render(art, path, sys.stdout)
+        reported += 1
+    return gate_common.finish(findings, {'plans': reported})
+
+
+if __name__ == '__main__':
+    sys.exit(main())

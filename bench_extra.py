@@ -648,6 +648,70 @@ def bench_serving_gateway(on_tpu):
     return rows
 
 
+def bench_supervisor_recovery(on_tpu):
+    """Elastic-supervisor MTTR rung (ISSUE 14): a journaled PS shard is
+    snapshotted, hard-killed, and recovered by the ShardSupervisor
+    (restart on the same endpoint -> restore newest snapshot -> replay
+    the client journal). The value is the recover() walltime — liveness
+    miss to shard serving restored state — which the regression gate
+    checks LOWER-is-better ('mttr' in the metric name). Exactly-once is
+    asserted inline: the replayed rows must match the pre-kill state
+    bit-for-bit, with dedup hits covering every snapshot-covered entry.
+    """
+    import os
+    import tempfile
+    from paddle_tpu.distributed.ps.embedding_service import (
+        EmbeddingClient, EmbeddingServer)
+    from paddle_tpu.distributed.supervisor import (PushJournal, ShardSpec,
+                                                   ShardSupervisor)
+    from paddle_tpu.testing import chaos
+
+    dim, n_ids, pushes = 16, 256, 8
+    snap_dir = tempfile.mkdtemp(prefix='bench_sup_')
+
+    def make_server(port=0):
+        s = EmbeddingServer(port=port)
+        s.create_table(0, dim=dim, optimizer='sgd', lr=0.1)
+        s.start()
+        return s
+
+    srv = make_server()
+    port = srv.port
+    journal = PushJournal('bench-trainer')
+    cli = EmbeddingClient(endpoints=['127.0.0.1:%d' % port],
+                          journal=journal)
+    rng = np.random.RandomState(0)
+    ids = list(range(n_ids))
+    cli.pull(0, ids)
+    for _ in range(pushes):
+        cli.push(0, ids, rng.randn(n_ids, dim).astype(np.float32))
+
+    sup = ShardSupervisor(miss_threshold=1, restart_budget=3,
+                          ping_timeout=0.5)
+    sup.add_shard(ShardSpec('emb0', '127.0.0.1:%d' % port, role='ps',
+                            restart=lambda: make_server(port) and None,
+                            snapshot_dir=snap_dir, clients=(cli,)))
+    sup.snapshot_all()
+    # post-snapshot writes: the recovery must replay exactly these
+    for _ in range(2):
+        cli.push(0, ids, rng.randn(n_ids, dim).astype(np.float32))
+    want = cli.pull(0, ids)
+
+    chaos.kill_server(srv)
+    t0 = time.time()
+    sup.poll()                      # detects the miss and recovers
+    mttr = time.time() - t0
+    got = cli.pull(0, ids)
+    if not np.array_equal(want, got):
+        raise AssertionError('recovered shard state diverged')
+
+    return [{'metric': 'supervisor_mttr_seconds', 'value': round(mttr, 4),
+             'unit': 's', 'shard': 'embedding', 'rows': n_ids,
+             'journal_replayed': journal.replayed,
+             'journal_dedup_hits': journal.dedup_hits,
+             'degraded': not on_tpu}]
+
+
 def main():
     try:
         _enable_cache()
@@ -655,7 +719,8 @@ def main():
         pass
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
-               bench_serving, bench_serving_paged, bench_serving_gateway):
+               bench_serving, bench_serving_paged, bench_serving_gateway,
+               bench_supervisor_recovery):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

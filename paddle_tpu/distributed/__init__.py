@@ -14,6 +14,10 @@ from . import meta_parallel  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import strategy  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import supervisor  # noqa: F401
+from .supervisor import (TrainingSupervisor, ShardSupervisor,  # noqa: F401
+                         ShardSpec, PushJournal, PreemptionWatcher,
+                         ResumeCursor, Preempted, SupervisorAbort)
 
 from .ps.dataset import MultiSlotDataset as QueueDataset  # noqa: F401
 from .ps.dataset import MultiSlotDataset as InMemoryDataset  # noqa: F401

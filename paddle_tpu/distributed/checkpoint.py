@@ -47,6 +47,8 @@ class AsyncCheckpointer:
         except Exception:
             self._ocp = None
             self._ckpt = None
+        self._thread = None
+        self._error = None
 
     def save(self, path, state_dict, force=True):
         state = _to_arrays(state_dict)
@@ -54,19 +56,39 @@ class AsyncCheckpointer:
         if self._ckpt is not None:
             self._ckpt.save(path, state, force=force)
         else:
+            # the fallback must match orbax's contract: save() returns
+            # immediately and wait_until_finished() blocks — a blocking
+            # fallback would stall the train step it is meant to overlap
+            import threading
             from ..framework.io_save import save as _save
-            _save(state, path + '.fallback.pdparams')
+            self.wait_until_finished()
+
+            def _write():
+                try:
+                    _save(state, path + '.fallback.pdparams')
+                except Exception as e:
+                    self._error = e
+            self._thread = threading.Thread(target=_write, daemon=True)
+            self._thread.start()
 
     def restore(self, path):
         path = os.path.abspath(path)
         if self._ckpt is not None:
             return self._ckpt.restore(path)
+        self.wait_until_finished()
         from ..framework.io_save import load as _load
         return _load(path + '.fallback.pdparams')
 
     def wait_until_finished(self):
         if self._ckpt is not None:
             self._ckpt.wait_until_finished()
+            return
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
 
 
 _CKPT = None
@@ -106,6 +128,14 @@ class CheckpointManager:
     def __init__(self, directory, keep_last=3):
         self.dir = directory
         self.keep_last = int(keep_last)
+        if self.keep_last < 1:
+            # keep_last=0 used to slice steps()[:-0] == [] and prune
+            # NOTHING — the opposite of what the caller asked for.
+            # There is no sane reading of "keep zero snapshots" for a
+            # manager whose job is restoring the newest one: refuse.
+            raise ValueError('keep_last must be >= 1 (got %d): the '
+                             'current snapshot is always kept'
+                             % self.keep_last)
         os.makedirs(self.dir, exist_ok=True)
 
     def _path(self, step):
@@ -141,7 +171,10 @@ class CheckpointManager:
         from ..framework import io_save
         for step in reversed(self.steps()):
             path = self._path(step)
-            if not verify_checkpoint(path):
+            # require_manifest: manager snapshots are always written
+            # through io_save.save, so a data file with no manifest is a
+            # writer that died between rename and manifest — torn, skip
+            if not verify_checkpoint(path, require_manifest=True):
                 continue
             try:
                 return step, io_save.load(path)

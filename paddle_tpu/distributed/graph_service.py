@@ -38,7 +38,9 @@ _M_GRAPH_ERRORS = _CLIENT_FAMS['graph_client_call_errors_total']
 # embedding service's OP_SEMANTICS.
 OP_SEMANTICS = {
     'stop': 'non_idempotent',           # second delivery hits a dead server
-    'add_edges': 'non_idempotent',      # store appends: resend duplicates
+    # store appends duplicate on resend, UNLESS the send is journaled:
+    # a (client, seq) pair lets the server dedup on its high-water mark
+    'add_edges': 'conditional',         # idempotent iff journaled
     'add_nodes': 'idempotent',          # no-op on an existing node
     'remove_nodes': 'idempotent',       # tombstone: resend re-tombstones
     'load_edge_file': 'non_idempotent',  # bulk append of the same file
@@ -49,7 +51,33 @@ OP_SEMANTICS = {
     'set_node_feat': 'idempotent',      # re-writes the same values
     'get_node_feat': 'idempotent',      # pure read
     'stats': 'idempotent',              # pure read
+    'ping': 'idempotent',               # liveness probe, pure read
+    'snapshot': 'idempotent',           # rewrites the same snapshot file
+    'restore': 'idempotent',            # reloads the same snapshot file
 }
+
+
+def _apply_graph_write(store, entry):
+    """Apply one mutation oplog entry to a store. Shared by the live
+    dispatch path and snapshot restore (the GraphStore may be the
+    opaque C++ backend, so snapshots persist the mutation log and
+    restore replays it into a fresh store)."""
+    kind = entry['kind']
+    if kind == 'add_edges':
+        return store.add_edges(entry['src'], entry['dst'],
+                               entry.get('weight'))
+    if kind == 'add_nodes':
+        return store.add_nodes(entry['ids'])
+    if kind == 'remove_nodes':
+        return store.remove_nodes(entry['ids'])
+    if kind == 'set_node_feat':
+        for i, f in zip(entry['ids'], entry['feats']):
+            store.set_node_feat(i, f)
+        return None
+    if kind == 'load_edge_file':
+        return store.load_edge_file(entry['path'],
+                                    entry.get('reversed', False))
+    raise ValueError('unknown graph write %r' % kind)
 
 
 class _GraphHandler(socketserver.BaseRequestHandler):
@@ -62,7 +90,6 @@ class _GraphHandler(socketserver.BaseRequestHandler):
         self.server.live_connections.discard(self.request)
 
     def handle(self):
-        store_map = self.server.stores
         while True:
             try:
                 msg = _recv_msg(self.request)
@@ -72,24 +99,61 @@ class _GraphHandler(socketserver.BaseRequestHandler):
             # carries trace context; always strips the metadata key
             span = _tracing.default_tracer().server_span(msg, 'graph.server')
             op = msg['op']
+            gsrv = self.server.graph_server
             try:
                 if op == 'stop':
                     _send_msg(self.request, b'ok')
                     self.server.shutdown()
                     return
-                store = store_map[msg.get('etype', 'default')]
-                if op == 'add_edges':
-                    store.add_edges(msg['src'], msg['dst'], msg.get('weight'))
+                if op == 'ping':
+                    _send_msg(self.request, {'ok': True,
+                                             'rank': gsrv.rank})
+                    continue
+                if op == 'snapshot':
+                    gsrv.snapshot(msg['path'])
                     _send_msg(self.request, b'ok')
+                    continue
+                if op == 'restore':
+                    gsrv.restore(msg['path'])
+                    _send_msg(self.request, b'ok')
+                    continue
+                # read stores fresh each request: restore() swaps the
+                # whole map and long-lived connections must see the
+                # rebuilt stores, not the pre-recovery ones
+                store = self.server.stores[msg.get('etype', 'default')]
+                if op == 'add_edges':
+                    entry = {'kind': 'add_edges',
+                             'etype': msg.get('etype', 'default'),
+                             'src': msg['src'], 'dst': msg['dst'],
+                             'weight': msg.get('weight')}
+                    cid = msg.get('client')
+                    if cid is not None:
+                        # journaled append: dedup on the per-client seq
+                        # high-water mark — exactly-once under retry
+                        applied = gsrv.journal_apply(
+                            cid, msg['seq'],
+                            lambda: gsrv.apply_write(entry))
+                        _send_msg(self.request,
+                                  {'ok': True, 'applied': applied})
+                    else:
+                        gsrv.apply_write(entry)
+                        _send_msg(self.request, b'ok')
                 elif op == 'add_nodes':
-                    store.add_nodes(msg['ids'])
+                    gsrv.apply_write({'kind': 'add_nodes',
+                                      'etype': msg.get('etype', 'default'),
+                                      'ids': msg['ids']})
                     _send_msg(self.request, b'ok')
                 elif op == 'remove_nodes':
-                    _send_msg(self.request,
-                              store.remove_nodes(msg['ids']))
+                    _send_msg(self.request, gsrv.apply_write(
+                        {'kind': 'remove_nodes',
+                         'etype': msg.get('etype', 'default'),
+                         'ids': msg['ids']}))
                 elif op == 'load_edge_file':
-                    n = store.load_edge_file(msg['path'],
-                                             msg.get('reversed', False))
+                    n = gsrv.apply_write(
+                        {'kind': 'load_edge_file',
+                         'etype': msg.get('etype', 'default'),
+                         'path': msg['path'],
+                         'reversed': msg.get('reversed', False)})
                     _send_msg(self.request, n)
                 elif op == 'sample_neighbors':
                     out = store.sample_neighbors(msg['ids'],
@@ -105,8 +169,10 @@ class _GraphHandler(socketserver.BaseRequestHandler):
                 elif op == 'degree':
                     _send_msg(self.request, store.degree(msg['ids']))
                 elif op == 'set_node_feat':
-                    for i, f in zip(msg['ids'], msg['feats']):
-                        store.set_node_feat(i, f)
+                    gsrv.apply_write({'kind': 'set_node_feat',
+                                      'etype': msg.get('etype', 'default'),
+                                      'ids': msg['ids'],
+                                      'feats': msg['feats']})
                     _send_msg(self.request, b'ok')
                 elif op == 'get_node_feat':
                     _send_msg(self.request,
@@ -137,8 +203,65 @@ class GraphPyServer:
         self._srv = _GraphTCPServer((host, port), _GraphHandler)
         self._srv.stores = {et: GraphStore() for et in edge_types}
         self._srv.live_connections = set()
+        self._srv.graph_server = self
         self.port = self._srv.server_address[1]
         self.rank = rank
+        self._edge_types = tuple(edge_types)
+        # the GraphStore may be the opaque ctypes backend, so durable
+        # state is an append-only mutation log: snapshot persists it,
+        # restore replays it into fresh stores. The journal holds the
+        # exactly-once (client -> last applied seq) marks.
+        self._oplog = []
+        self._journal = {}
+        # RLock: journal_apply holds it across apply_fn, and apply_fn is
+        # apply_write, which re-enters to append the oplog entry
+        self._state_lock = threading.RLock()
+
+    def journal_apply(self, client_id, seq, apply_fn):
+        """Apply a journaled write exactly once (mark-and-apply under
+        one lock, same contract as EmbeddingServer.journal_apply).
+        Returns False on a dedup hit."""
+        seq = int(seq)
+        with self._state_lock:
+            if seq <= self._journal.get(client_id, -1):
+                return False
+            apply_fn()
+            self._journal[client_id] = seq
+            return True
+
+    def apply_write(self, entry):
+        """Apply a mutation to its store and append it to the oplog."""
+        store = self._srv.stores[entry.get('etype', 'default')]
+        out = _apply_graph_write(store, entry)
+        with self._state_lock:
+            self._oplog.append(entry)
+        return out
+
+    def snapshot(self, path):
+        """Persist the mutation log + journal marks atomically (io_save:
+        temp + rename + CRC manifest)."""
+        from ..framework import io_save
+        with self._state_lock:
+            state = {'oplog': list(self._oplog),
+                     'journal': dict(self._journal),
+                     'edge_types': list(self._edge_types)}
+        io_save.save(state, path)
+
+    def restore(self, path):
+        """Rebuild every store by replaying a snapshot's mutation log
+        into fresh GraphStores, then seat its journal marks."""
+        from ..framework import io_save
+        state = io_save.load(path)
+        stores = {et: GraphStore()
+                  for et in state.get('edge_types', self._edge_types)}
+        for entry in state['oplog']:
+            _apply_graph_write(stores[entry.get('etype', 'default')],
+                               entry)
+        with self._state_lock:
+            self._srv.stores = stores
+            self._oplog = list(state['oplog'])
+            self._journal = {str(k): int(v)
+                             for k, v in state['journal'].items()}
 
     def start_server(self, block=False):
         if block:
@@ -174,15 +297,18 @@ class GraphPyClient:
     selects the shard; batch ops split/merge per shard.
 
     Transport is a ResilientChannel per shard: socket timeouts, reconnect
-    + retry for idempotent ops, circuit breaker per endpoint. Mutations
-    that are NOT safe to blind-resend (add_edges — a resend after an
-    applied-but-unacked write would duplicate edges) run single-attempt;
-    everything else retries across reconnects. `op_deadline` (seconds)
-    bounds each public operation across all its shards and retries.
+    + retry for idempotent ops, circuit breaker per endpoint. add_edges
+    is conditional: unjournaled, a resend after an applied-but-unacked
+    write would duplicate edges, so it runs single-attempt; with
+    `journal=` (a supervisor.PushJournal) each send carries a (client,
+    seq) pair the server dedups on, so it retries — and replays after a
+    shard restore — exactly once. Everything else retries across
+    reconnects. `op_deadline` (seconds) bounds each public operation
+    across all its shards and retries.
     """
 
     def __init__(self, endpoints, retry_policy=None, call_timeout=None,
-                 op_deadline=None):
+                 op_deadline=None, journal=None):
         self._channels = [
             ResilientChannel(ep, retry_policy=retry_policy,
                              **({'call_timeout': call_timeout}
@@ -190,6 +316,7 @@ class GraphPyClient:
             for ep in endpoints]
         self._n = len(endpoints)
         self._op_deadline = op_deadline
+        self._journal = journal
 
     def _deadline(self):
         return None if self._op_deadline is None \
@@ -238,21 +365,76 @@ class GraphPyClient:
                                       deadline=dl)
         return removed
 
+    @property
+    def journal(self):
+        """The PushJournal backing exactly-once sends (None when
+        unjournaled) — ShardSupervisor trims it at snapshot barriers."""
+        return self._journal
+
+    def _note_applied(self, out, seq):
+        """Count a server-side dedup hit on a journaled send."""
+        if seq is not None and isinstance(out, dict) \
+                and not out.get('applied', True):
+            self._journal.note_dedup()
+
     def add_edges(self, etype, src, dst, weight=None):
         src, shard = self._shard(src)
         dst = np.asarray(dst, np.int64)
         w = np.asarray(weight, np.float32) if weight is not None else None
+        seq = None
+        if self._journal is not None:
+            seq = self._journal.record({'kind': 'add_edges',
+                                        'etype': etype,
+                                        'src': src.tolist(),
+                                        'dst': dst.tolist(),
+                                        'weight': None if w is None
+                                        else w.tolist()})
         dl = self._deadline()
         for s in range(self._n):
             m = shard == s
             if m.any():
-                # NOT idempotent: the store appends, so a blind resend
-                # after an applied-but-unacked write duplicates edges
-                self._call(s, {'op': 'add_edges', 'etype': etype,
-                               'src': src[m].tolist(),
-                               'dst': dst[m].tolist(),
-                               'weight': w[m].tolist() if w is not None
-                               else None}, idempotent=False, deadline=dl)
+                # unjournaled appends are NOT idempotent (a blind resend
+                # after an applied-but-unacked write duplicates edges);
+                # journaled sends dedup server-side and may retry
+                msg = {'op': 'add_edges', 'etype': etype,
+                       'src': src[m].tolist(), 'dst': dst[m].tolist(),
+                       'weight': w[m].tolist() if w is not None else None}
+                if seq is not None:
+                    msg['client'] = self._journal.client_id
+                    msg['seq'] = seq
+                out = self._call(s, msg, idempotent=seq is not None,
+                                 deadline=dl)
+                self._note_applied(out, seq)
+
+    def replay_journal(self):
+        """Resend every retained add_edges entry (oldest first) after a
+        graph shard restart/restore; the server's journal marks make the
+        replay exactly-once. Returns (entries_replayed, dedup_hits)."""
+        if self._journal is None:
+            return 0, 0
+        before = self._journal.dedup_hits
+        replayed = 0
+        for seq, entry in self._journal.entries():
+            src = np.asarray(entry['src'], np.int64)
+            dst = np.asarray(entry['dst'], np.int64)
+            w = entry.get('weight')
+            w = np.asarray(w, np.float32) if w is not None else None
+            shard = src % self._n
+            dl = self._deadline()
+            for s in range(self._n):
+                m = shard == s
+                if not m.any():
+                    continue
+                msg = {'op': 'add_edges', 'etype': entry['etype'],
+                       'src': src[m].tolist(), 'dst': dst[m].tolist(),
+                       'weight': w[m].tolist() if w is not None else None,
+                       'client': self._journal.client_id, 'seq': seq}
+                out = self._call(s, msg, idempotent=seq is not None,
+                                 deadline=dl)
+                self._note_applied(out, seq)
+            replayed += 1
+            self._journal.note_replay()
+        return replayed, self._journal.dedup_hits - before
 
     def load_edge_file(self, etype, path, reversed=False):
         """Each server loads the rows whose src hashes to it; for the local

@@ -47,15 +47,21 @@ _M_PS_ERRORS = _CLIENT_FAMS['ps_client_call_errors_total']
 # blind-resent).
 OP_SEMANTICS = {
     'pull': 'idempotent',            # pure read
-    'push': 'accumulating',          # optimizer apply accumulates
-    'push_delta': 'accumulating',    # delta merge accumulates
+    # the accumulating writes are conditional: journaled sends carry a
+    # (client, seq) pair the server dedups on its high-water mark, so
+    # they retry safely; unjournaled sends must stay single-attempt
+    'push': 'conditional',           # idempotent iff journaled
+    'push_delta': 'conditional',     # idempotent iff journaled
     'pull_dense': 'idempotent',      # pure read
-    'push_dense': 'accumulating',    # grad apply accumulates
+    'push_dense': 'conditional',     # idempotent iff journaled
     'set_dense': 'idempotent',       # last-writer set of the same value
     'barrier': 'non_idempotent',     # a resend double-arrives a worker
     'tensor': 'conditional',         # set/get resend safely; increment not
     'save': 'idempotent',            # rewrites the same shard file
     'load': 'idempotent',            # reloads the same shard file
+    'ping': 'idempotent',            # liveness probe, pure read
+    'snapshot': 'idempotent',        # rewrites the same snapshot file
+    'restore': 'idempotent',         # reloads the same snapshot file
     'stop': 'non_idempotent',        # second delivery hits a dead server
 }
 
@@ -217,6 +223,32 @@ class EmbeddingTable:
     def shrink(self, threshold=0):
         pass
 
+    def state_dict(self):
+        """Full shard state for a supervisor snapshot: rows, optimizer
+        slots, admission sightings AND the row-init RNG state — a
+        restored shard must mint the same rows for ids it has not seen,
+        or a resumed run diverges from the uninterrupted one. (For
+        SsdSparseTable subclasses this covers the in-memory hot set.)"""
+        with self._lock:
+            return {
+                'rows': {int(k): v.copy() for k, v in self._rows.items()},
+                'slots': {int(k): [s.copy() for s in v]
+                          for k, v in self._slots.items()},
+                'seen': dict(self._seen),
+                'rng': self._rng.get_state(),
+            }
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self._rows = {int(k): np.asarray(v, np.float32)
+                          for k, v in state['rows'].items()}
+            self._slots = {int(k): [np.asarray(s, np.float32) for s in v]
+                           for k, v in state['slots'].items()}
+            self._seen = {int(k): int(v)
+                          for k, v in state.get('seen', {}).items()}
+            if state.get('rng') is not None:
+                self._rng.set_state(state['rng'])
+
 
 # -- socket RPC (multi-host path) ------------------------------------------
 
@@ -246,6 +278,17 @@ def _recv_msg(sock):
     return wire.decode(bytes(buf))
 
 
+def _apply_table_write(server, op, msg):
+    """Apply one accumulating write message to its table (shared by the
+    direct dispatch path and the journaled exactly-once path)."""
+    if op == 'push':
+        server.table(msg['table']).push(msg['ids'], msg['grads'])
+    elif op == 'push_delta':
+        server.table(msg['table']).push_delta(msg['ids'], msg['deltas'])
+    else:
+        server.table(msg['table']).push(msg['grad'])
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def setup(self):
         # registry lets chaos.kill_server sever established connections,
@@ -270,20 +313,23 @@ class _Handler(socketserver.BaseRequestHandler):
                 if op == 'pull':
                     out = server.table(msg['table']).pull(msg['ids'])
                     _send_msg(self.request, out)
-                elif op == 'push':
-                    server.table(msg['table']).push(msg['ids'],
-                                                    msg['grads'])
-                    _send_msg(self.request, b'ok')
-                elif op == 'push_delta':
-                    server.table(msg['table']).push_delta(msg['ids'],
-                                                          msg['deltas'])
-                    _send_msg(self.request, b'ok')
+                elif op in ('push', 'push_delta', 'push_dense'):
+                    cid = msg.get('client')
+                    if cid is not None:
+                        # journaled write: dedup on the per-client seq
+                        # high-water mark so a retried/replayed push
+                        # applies exactly once
+                        applied = server.journal_apply(
+                            cid, msg['seq'],
+                            lambda: _apply_table_write(server, op, msg))
+                        _send_msg(self.request,
+                                  {'ok': True, 'applied': applied})
+                    else:
+                        _apply_table_write(server, op, msg)
+                        _send_msg(self.request, b'ok')
                 elif op == 'pull_dense':
                     _send_msg(self.request,
                               server.table(msg['table']).pull())
-                elif op == 'push_dense':
-                    server.table(msg['table']).push(msg['grad'])
-                    _send_msg(self.request, b'ok')
                 elif op == 'set_dense':
                     server.table(msg['table']).set(msg['value'])
                     _send_msg(self.request, b'ok')
@@ -303,6 +349,15 @@ class _Handler(socketserver.BaseRequestHandler):
                     _send_msg(self.request, b'ok')
                 elif op == 'load':
                     server.table(msg['table']).load(msg['path'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'ping':
+                    _send_msg(self.request, {'ok': True,
+                                             'port': server.port})
+                elif op == 'snapshot':
+                    server.snapshot(msg['path'])
+                    _send_msg(self.request, b'ok')
+                elif op == 'restore':
+                    server.restore(msg['path'])
                     _send_msg(self.request, b'ok')
                 elif op == 'stop':
                     _send_msg(self.request, b'ok')
@@ -339,6 +394,11 @@ class EmbeddingServer:
         self.port = self._srv.server_address[1]
         self.endpoint = '%s:%d' % (host, self.port)
         self._thread = None
+        # exactly-once dedup state: client_id -> last applied seq.
+        # Included in snapshot()/restore() so a replayed journal after a
+        # restore is judged against the restored state, not a blank one.
+        self._journal = {}
+        self._journal_lock = threading.Lock()
 
     def create_table(self, table_id, dim, table_class=None, backend=None,
                      **kwargs):
@@ -370,6 +430,50 @@ class EmbeddingServer:
 
     def table(self, table_id):
         return self._tables[table_id]
+
+    def journal_apply(self, client_id, seq, apply_fn):
+        """Apply a journaled write exactly once. The mark-and-apply runs
+        under one lock so a duplicate arriving on a second connection
+        (client reconnected and resent before the first handler thread
+        finished) can never double-apply. Returns False on a dedup hit."""
+        seq = int(seq)
+        with self._journal_lock:
+            if seq <= self._journal.get(client_id, -1):
+                return False
+            apply_fn()
+            self._journal[client_id] = seq
+            return True
+
+    def state_dict(self):
+        """Snapshot every table that supports it (BarrierTable holds
+        only transient arrival counts and is deliberately skipped) plus
+        the exactly-once journal marks. Caller holds _journal_lock."""
+        tables = {}
+        for tid, table in self._tables.items():
+            state_fn = getattr(table, 'state_dict', None)
+            if state_fn is not None:
+                tables[tid] = state_fn()
+        return {'tables': tables, 'journal': dict(self._journal)}
+
+    def snapshot(self, path):
+        """Write the full shard state atomically (io_save: temp + rename
+        + CRC manifest). Held under the journal lock so journaled pushes
+        serialize against the snapshot — the journal marks in the file
+        exactly vouch for the table state next to them."""
+        from ...framework import io_save
+        with self._journal_lock:
+            state = self.state_dict()
+        io_save.save(state, path)
+
+    def restore(self, path):
+        """Load a snapshot() file into the (already created) tables."""
+        from ...framework import io_save
+        state = io_save.load(path)
+        for tid, table_state in state['tables'].items():
+            self._tables[tid].set_state_dict(table_state)
+        with self._journal_lock:
+            self._journal = {str(k): int(v)
+                             for k, v in state['journal'].items()}
 
     def start(self, block=False):
         if block:
@@ -409,19 +513,23 @@ class EmbeddingClient:
     Remote transport is a ResilientChannel per shard (socket timeouts,
     reconnect + retry for idempotent ops, per-endpoint circuit breaker).
     Reads (pull/pull_dense/tensor-get) and overwrites (set_dense) retry
-    transparently; grad applications (push/push_delta/push_dense) are NOT
-    idempotent — the server may have applied an unacked op, and resending
-    would double-apply — so they run single-attempt and surface a
-    RetryableError for the communicator's own error path. `op_deadline`
-    (seconds) bounds each public op across all shards and retries.
+    transparently. Grad applications (push/push_delta/push_dense) are
+    conditional: without a journal the server may have applied an unacked
+    op and a resend would double-apply, so they run single-attempt and
+    surface a RetryableError; with `journal=` (a supervisor.PushJournal)
+    every write carries a (client, seq) pair the server dedups on, so
+    they retry — and replay after a shard restore — exactly once.
+    `op_deadline` (seconds) bounds each public op across all shards and
+    retries.
     """
 
     def __init__(self, endpoints=None, servers=None, retry_policy=None,
-                 call_timeout=None, op_deadline=None):
+                 call_timeout=None, op_deadline=None, journal=None):
         self._local = servers  # in-proc mode: list of EmbeddingServer
         self._channels = None
         self._endpoints = endpoints
         self._op_deadline = op_deadline
+        self._journal = journal if servers is None else None
         if endpoints and not servers:
             kw = {} if call_timeout is None else \
                 {'call_timeout': call_timeout}
@@ -488,10 +596,34 @@ class EmbeddingClient:
             out[mask] = rows
         return out
 
+    @property
+    def journal(self):
+        """The PushJournal backing exactly-once sends (None when
+        unjournaled) — ShardSupervisor trims it at snapshot barriers."""
+        return self._journal
+
+    def _record(self, kind, table_id, ids, data):
+        """Journal one write before sending; returns its seq (or None
+        when unjournaled). Entries are retained until the journal is
+        trimmed at a snapshot barrier, so they can replay after a shard
+        restore."""
+        if self._journal is None:
+            return None
+        return self._journal.record({'kind': kind, 'table': table_id,
+                                     'ids': ids, 'data': data})
+
+    def _note_applied(self, out, seq):
+        """Count a server-side dedup hit (retried/replayed journaled
+        write the server had already applied)."""
+        if seq is not None and isinstance(out, dict) \
+                and not out.get('applied', True):
+            self._journal.note_dedup()
+
     def push(self, table_id, ids, grads):
         ids, shard_idx = self._shard(ids)
         grads = np.asarray(grads, np.float32)
         dl = self._deadline()
+        seq = self._record('push', table_id, ids.tolist(), grads)
         for s in range(self._n):
             mask = shard_idx == s
             if not mask.any():
@@ -500,11 +632,16 @@ class EmbeddingClient:
                 self._local[s].table(table_id).push(ids[mask].tolist(),
                                                     grads[mask])
             else:
-                # grad application is not idempotent: no blind resend
-                self._call(s, {'op': 'push', 'table': table_id,
-                               'ids': ids[mask].tolist(),
-                               'grads': grads[mask]}, idempotent=False,
-                           deadline=dl)
+                # unjournaled grad application is not idempotent: no
+                # blind resend; journaled sends dedup server-side
+                msg = {'op': 'push', 'table': table_id,
+                       'ids': ids[mask].tolist(), 'grads': grads[mask]}
+                if seq is not None:
+                    msg['client'] = self._journal.client_id
+                    msg['seq'] = seq
+                out = self._call(s, msg, idempotent=seq is not None,
+                                 deadline=dl)
+                self._note_applied(out, seq)
 
     def _dim(self, table_id):
         if self._local is not None:
@@ -518,6 +655,7 @@ class EmbeddingClient:
         ids, shard_idx = self._shard(ids)
         deltas = np.asarray(deltas, np.float32)
         dl = self._deadline()
+        seq = self._record('push_delta', table_id, ids.tolist(), deltas)
         for s in range(self._n):
             mask = shard_idx == s
             if not mask.any():
@@ -526,10 +664,54 @@ class EmbeddingClient:
                 self._local[s].table(table_id).push_delta(
                     ids[mask].tolist(), deltas[mask])
             else:
-                self._call(s, {'op': 'push_delta', 'table': table_id,
-                               'ids': ids[mask].tolist(),
-                               'deltas': deltas[mask]}, idempotent=False,
-                           deadline=dl)
+                msg = {'op': 'push_delta', 'table': table_id,
+                       'ids': ids[mask].tolist(), 'deltas': deltas[mask]}
+                if seq is not None:
+                    msg['client'] = self._journal.client_id
+                    msg['seq'] = seq
+                out = self._call(s, msg, idempotent=seq is not None,
+                                 deadline=dl)
+                self._note_applied(out, seq)
+
+    def replay_journal(self):
+        """Resend every retained journal entry (oldest first) after a
+        shard restart/restore. The servers' journal marks decide per
+        entry: writes lost with the crash re-apply, survivors dedup —
+        the sum is exactly-once relative to the restored state. Returns
+        (entries_replayed, dedup_hits counted during the replay)."""
+        if self._journal is None:
+            return 0, 0
+        before = self._journal.dedup_hits
+        replayed = 0
+        for seq, entry in self._journal.entries():
+            self._replay_entry(seq, entry)
+            replayed += 1
+            self._journal.note_replay()
+        return replayed, self._journal.dedup_hits - before
+
+    def _replay_entry(self, seq, entry):
+        kind, table_id = entry['kind'], entry['table']
+        data = np.asarray(entry['data'], np.float32)
+        dl = self._deadline()
+        if kind == 'push_dense':
+            msg = {'op': 'push_dense', 'table': table_id, 'grad': data,
+                   'client': self._journal.client_id, 'seq': seq}
+            out = self._call(self._owner(table_id), msg,
+                             idempotent=seq is not None, deadline=dl)
+            self._note_applied(out, seq)
+            return
+        key = 'grads' if kind == 'push' else 'deltas'
+        ids, shard_idx = self._shard(entry['ids'])
+        for s in range(self._n):
+            mask = shard_idx == s
+            if not mask.any():
+                continue
+            msg = {'op': kind, 'table': table_id,
+                   'ids': ids[mask].tolist(), key: data[mask],
+                   'client': self._journal.client_id, 'seq': seq}
+            out = self._call(s, msg, idempotent=seq is not None,
+                             deadline=dl)
+            self._note_applied(out, seq)
 
     # -- dense / barrier / tensor tables (placed by table_id % n) -----------
     def _owner(self, table_id):
@@ -546,10 +728,16 @@ class EmbeddingClient:
         s = self._owner(table_id)
         if self._local is not None:
             return self._local[s].table(table_id).push(grad)
-        # grad application is not idempotent: no blind resend
-        self._call(s, {'op': 'push_dense', 'table': table_id,
-                       'grad': np.asarray(grad, np.float32)},
-                   idempotent=False, deadline=self._deadline())
+        grad = np.asarray(grad, np.float32)
+        seq = self._record('push_dense', table_id, None, grad)
+        # unjournaled grad application is not idempotent: no blind resend
+        msg = {'op': 'push_dense', 'table': table_id, 'grad': grad}
+        if seq is not None:
+            msg['client'] = self._journal.client_id
+            msg['seq'] = seq
+        out = self._call(s, msg, idempotent=seq is not None,
+                         deadline=self._deadline())
+        self._note_applied(out, seq)
 
     def set_dense(self, table_id, value):
         s = self._owner(table_id)

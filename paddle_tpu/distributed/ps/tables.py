@@ -65,6 +65,17 @@ class DenseTable:
             self._value = data['value']
             self._slots = [s for s in data['slots']]
 
+    def state_dict(self):
+        with self._lock:
+            return {'value': self._value.copy(),
+                    'slots': [s.copy() for s in self._slots]}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self._value = np.asarray(state['value'], np.float32).copy()
+            self._slots = [np.asarray(s, np.float32).copy()
+                           for s in state['slots']]
+
 
 class TensorTable:
     """Named server-side dense tensors (reference table/tensor_table.cc —
@@ -91,6 +102,16 @@ class TensorTable:
             self._tensors[name] = delta.copy() if cur is None \
                 else cur + delta
             return self._tensors[name].copy()
+
+    def state_dict(self):
+        with self._lock:
+            return {'tensors': {k: v.copy()
+                                for k, v in self._tensors.items()}}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self._tensors = {str(k): np.asarray(v, np.float32).copy()
+                             for k, v in state['tensors'].items()}
 
 
 class BarrierTable:

@@ -27,6 +27,19 @@ __all__ = ['save', 'load', 'CheckpointCorruptError', 'manifest_path',
 _PROTOCOL = 4
 _MANIFEST_FORMAT = 1
 
+# Write-path fault hooks (same shape as distributed/resilience.py's
+# transport hooks): testing/chaos.py installs injectors here to crash a
+# save at a named point and prove the torn states a preempted writer can
+# leave behind. Points, in write order:
+#   'pre_rename'   — payload in the temp file, not yet renamed into place
+#   'pre_manifest' — payload renamed, manifest sidecar not yet written
+_FAULT_HOOKS = []
+
+
+def _fire(point, path):
+    for hook in list(_FAULT_HOOKS):
+        hook(point, path)
+
 
 class CheckpointCorruptError(IOError):
     """The file's bytes do not match its manifest (truncated / torn /
@@ -102,9 +115,17 @@ def save(obj, path, protocol=_PROTOCOL, **configs):
                            'size': len(payload),
                            'crc32': zlib.crc32(payload) & 0xFFFFFFFF})
     # data first, then manifest: a crash between the two renames leaves a
-    # stale manifest whose mismatch reads as "corrupt" — restore then
-    # falls back to an older snapshot, which is the conservative outcome
-    _write_atomic(path, payload)
+    # stale (or missing) manifest whose mismatch reads as "corrupt" —
+    # restore then falls back to an older snapshot, the conservative
+    # outcome. The _fire points let chaos tests crash at each boundary.
+    tmp = '%s.tmp.%d' % (path, os.getpid())
+    with open(tmp, 'wb') as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    _fire('pre_rename', path)
+    os.replace(tmp, path)
+    _fire('pre_manifest', path)
     _write_atomic(manifest_path(path), manifest.encode())
 
 
@@ -124,12 +145,18 @@ def _check_manifest(path, payload):
             'or torn snapshot' % (path, len(payload), m.get('size')))
 
 
-def verify_checkpoint(path):
+def verify_checkpoint(path, require_manifest=False):
     """True iff `path` exists and its bytes match its manifest (or it has
-    no manifest to check against)."""
+    no manifest to check against). With require_manifest=True a missing
+    manifest fails the check: for files that are always written through
+    save() (CheckpointManager snapshots, supervisor shard snapshots) a
+    bare data file means the writer died between rename and manifest —
+    a torn state to fall back from, not a legacy file to trust."""
     try:
         with open(path, 'rb') as f:
             payload = f.read()
+        if require_manifest and not os.path.exists(manifest_path(path)):
+            return False
         _check_manifest(path, payload)
         return True
     except (OSError, CheckpointCorruptError):

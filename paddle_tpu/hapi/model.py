@@ -12,6 +12,7 @@ import numpy as np
 
 from ..framework.core import Tensor, no_grad_guard
 from ..framework import functional as func_mod
+from ..distributed.supervisor import Preempted
 from ..metric import Metric
 from .callbacks import config_callbacks
 
@@ -127,7 +128,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, supervisor=None):
         from ..io import DataLoader, Dataset
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
@@ -164,15 +165,29 @@ class Model:
         warmup_epoch = 0 if eval_loader is None \
             else min(eval_freq, epochs) - 1
         it = 0
+        cursor = None
+        if supervisor is not None:
+            # elastic resume: the cursor restores params/optimizer and
+            # says how much completed work to skip deterministically
+            cursor = supervisor.restore(self)
+            if cursor is not None:
+                it = cursor.global_step
         logs = {}
         try:
             for epoch in range(epochs):
+                if cursor is not None and epoch < cursor.epoch:
+                    continue          # fully-trained epoch from before
                 for m in self._metrics:
                     m.reset()
                 cbks.on_epoch_begin(epoch)
                 logs = {}
+                if supervisor is not None:
+                    supervisor.begin_epoch(epoch)
                 data_iter = iter(train_loader)
                 step = 0
+                if cursor is not None and epoch == cursor.epoch:
+                    step = supervisor.fast_forward(data_iter)
+                    cursor = None
                 while True:
                     try:
                         with tl.phase('data_wait'):
@@ -188,6 +203,14 @@ class Model:
                     cbks.on_train_batch_end(step, logs)
                     step += 1
                     it += 1
+                    if supervisor is not None:
+                        try:
+                            supervisor.on_step(self, epoch, step, it)
+                        except Preempted:
+                            # urgent checkpoint already written; stop as
+                            # cleanly as num_iters would
+                            self.stop_training = True
+                            break
                     if num_iters is not None and it >= num_iters:
                         self.stop_training = True
                         break

@@ -16,7 +16,8 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_tracing_schema', 'record_perf_schema',
            'record_rpc_schema', 'record_client_op_schema',
            'record_train_loop_schema', 'record_fleet_schema',
-           'record_alert_schema', 'snapshot_line',
+           'record_alert_schema', 'record_supervisor_schema',
+           'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -386,6 +387,48 @@ def record_alert_schema(registry):
     return out
 
 
+# the elastic training supervisor's families (distributed/supervisor.py).
+# Single-source rule: TrainingSupervisor/ShardSupervisor and the schema
+# baseline both register through record_supervisor_schema. Label
+# budgets: role is the closed shard vocabulary {trainer, ps, graph};
+# kind is {periodic, urgent}; stage is the escalation ladder
+# {restart, restore, abort}.
+SUPERVISOR_FAMILIES = (
+    ('counter', 'supervisor_restarts_total',
+     'shard restarts driven by the supervisor', ('role',)),
+    ('histogram', 'supervisor_recover_seconds',
+     'MTTR: liveness-miss detection to shard recovered', ()),
+    ('counter', 'supervisor_checkpoints_total',
+     'training checkpoints written by the supervisor', ('kind',)),
+    ('counter', 'supervisor_preemptions_total',
+     'preemption notices honored with an urgent checkpoint', ()),
+    ('counter', 'supervisor_journal_replays_total',
+     'journaled push entries replayed after a shard recovery', ()),
+    ('counter', 'supervisor_journal_dedup_hits_total',
+     'replayed/retried journaled pushes the server deduplicated', ()),
+    ('counter', 'supervisor_escalations_total',
+     'recovery escalation stages entered', ('stage',)),
+    ('gauge', 'supervisor_shards_alive',
+     'shards passing liveness at the last heartbeat round', ()),
+)
+
+
+def record_supervisor_schema(registry):
+    """Register the elastic-supervisor families on `registry` and return
+    {name: family}. Used by the supervisor at construction and by
+    dryrun_registry so the committed baseline covers recovery."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in SUPERVISOR_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # recovery spans ~10ms (in-proc restart) to minutes (pod
+            # reschedule + snapshot restore + journal replay)
+            kw['buckets'] = exponential_buckets(0.01, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -405,6 +448,7 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_train_loop_schema(reg)
     record_fleet_schema(reg)
     record_alert_schema(reg)
+    record_supervisor_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -21,15 +21,18 @@ import socket
 import threading
 
 from ..distributed import resilience
+from ..framework import io_save as _io_save
 from ..monitor import tracing as _tracing
 
 __all__ = ['inject', 'drop_connections', 'delay_connections', 'partition',
-           'fail_after', 'kill_server', 'truncate_file', 'active_faults']
+           'fail_after', 'kill_server', 'truncate_file', 'crash_io_save',
+           'active_faults']
 
 
 def active_faults():
-    """Number of currently installed injectors (leak canary for tests)."""
-    return len(resilience._FAULT_HOOKS)
+    """Number of currently installed injectors (leak canary for tests) —
+    transport hooks plus checkpoint-writer hooks."""
+    return len(resilience._FAULT_HOOKS) + len(_io_save._FAULT_HOOKS)
 
 
 @contextlib.contextmanager
@@ -155,6 +158,42 @@ def kill_server(server):
         srv.server_close()
     except Exception:
         pass
+
+
+class WriterKilled(BaseException):
+    """Raised by crash_io_save to simulate a writer dying mid-save.
+
+    Deliberately NOT an Exception: a preempted pod doesn't run except
+    handlers, and deriving from BaseException keeps broad `except
+    Exception` recovery paths in the code under test from swallowing the
+    simulated death."""
+
+
+def crash_io_save(point, path_substr=None, times=1):
+    """Kill the io_save atomic writer at a named point for the scope.
+
+    point: 'pre_rename' (payload still in the temp file — the target
+    path is untouched) or 'pre_manifest' (payload renamed into place,
+    manifest sidecar missing/stale). path_substr scopes the crash to
+    matching destination paths; times bounds how many saves die.
+    Returns a context manager yielding the fault (inspect `.fired`).
+    """
+    def action(p, target):
+        raise WriterKilled('chaos: writer killed at %s of %s'
+                           % (p, target))
+    fault = _Fault(action, _as_points(point), path_substr, times)
+
+    @contextlib.contextmanager
+    def _scope():
+        _io_save._FAULT_HOOKS.append(fault)
+        try:
+            yield fault
+        finally:
+            try:
+                _io_save._FAULT_HOOKS.remove(fault)
+            except ValueError:
+                pass
+    return _scope()
 
 
 def truncate_file(path, keep_bytes=None, drop_bytes=16):

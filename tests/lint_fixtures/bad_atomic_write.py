@@ -1,0 +1,54 @@
+"""Fixture: checkpoint artifacts written raw — every write here can be
+torn by a preempted pod and leaves no manifest to flag it. graftlint's
+atomic-write rule must fire on each bad_* site and stay quiet on the
+good_* ones."""
+import os
+import pickle
+
+import numpy as np
+
+
+def bad_literal_path(state):
+    with open('model.ckpt', 'wb') as f:       # atomic-write
+        f.write(state)
+
+
+def bad_named_variable(checkpoint_path, payload):
+    f = open(checkpoint_path, 'w')            # atomic-write
+    f.write(payload)
+    f.close()
+
+
+def bad_pickle_dump(state, ckpt_path):
+    pickle.dump(state, ckpt_path)             # atomic-write
+
+
+def bad_handrolled_commit(tmp, ckpt_target):
+    os.replace(tmp, ckpt_target)              # atomic-write
+
+
+def good_read_side(ckpt_path):
+    # read mode never tears anything
+    with open(ckpt_path, 'rb') as f:
+        return f.read()
+
+
+def good_unnamed_write(path, payload):
+    # generic writer with no checkpoint evidence: out of scope
+    with open(path, 'w') as f:
+        f.write(payload)
+
+
+def good_sanctioned(state, path):
+    from paddle_tpu.framework import io_save
+    io_save.save(state, path + '.ckpt')
+
+
+def good_suppressed(state):
+    with open('debug.ckpt', 'wb') as f:  # graftlint: disable=atomic-write  forensics dump, torn is fine
+        f.write(state)
+
+
+def good_numpy_elsewhere(arr, path):
+    # no checkpoint evidence in the args
+    np.save(path, arr)

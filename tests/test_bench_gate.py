@@ -135,6 +135,22 @@ def test_cache_hit_rate_gates_as_higher_is_better():
     assert gate.check(improved, best) == []
 
 
+def test_supervisor_mttr_gates_lower_is_better():
+    """supervisor_mttr_seconds (bench_extra's elastic-recovery rung)
+    regresses UP: a supervisor that takes longer to bring a killed
+    shard back is a worse supervisor, regardless of the generic
+    throughput default."""
+    row = {'metric': 'supervisor_mttr_seconds', 'unit': 's',
+           'value': 0.08}
+    assert not gate.higher_is_better(row)
+    best = [dict(row, platform='tpu', degraded=False)]
+    slower = [dict(row, value=0.5, platform='tpu', degraded=False)]
+    findings = gate.check(slower, best)
+    assert len(findings) == 1 and findings[0]['direction'] == 'up'
+    faster = [dict(row, value=0.02, platform='tpu', degraded=False)]
+    assert gate.check(faster, best) == []
+
+
 def test_trust_degraded_admits_cpu_rows():
     """The compile-cache rungs are measured on CPU: invisible to the
     default gate (they must never displace real-TPU bests), gated

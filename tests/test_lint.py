@@ -51,6 +51,7 @@ CORPUS = [
     ('bad_metric_family.py', {'metric-unknown-family': 1,
                               'metric-label-arity': 1}),
     ('bad_span_no_cm.py', {'span-no-cm': 2}),
+    ('bad_atomic_write.py', {'atomic-write': 4}),
 ]
 
 

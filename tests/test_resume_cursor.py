@@ -1,0 +1,144 @@
+"""Resume-cursor determinism (ISSUE 14 satellite): seeded RNG streams
+and data-loader position round-trip exactly through a checkpoint, so a
+resumed run consumes the SAME batches in the SAME order as the
+uninterrupted run."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.supervisor import (ResumeCursor,
+                                               TrainingSupervisor)
+from paddle_tpu.framework import io_save
+from paddle_tpu.framework import random as prandom
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.io import DataLoader, Dataset
+
+
+def test_rng_capture_restores_both_streams():
+    """capture_rng/restore_rng must round-trip BOTH host RNG streams:
+    the global numpy one and the framework.random generator key."""
+    np.random.seed(42)
+    paddle.seed(4242)
+    np.random.rand(3)                  # advance both streams
+    prandom.next_key()
+    snap = ResumeCursor.capture_rng()
+    a_np = np.random.rand(5)
+    a_key = np.asarray(prandom.next_key())
+    ResumeCursor.restore_rng(snap)
+    b_np = np.random.rand(5)
+    b_key = np.asarray(prandom.next_key())
+    assert np.array_equal(a_np, b_np)
+    assert np.array_equal(a_key, b_key)
+
+
+def test_cursor_roundtrips_through_io_save(tmp_path):
+    np.random.seed(1)
+    cur = ResumeCursor(epoch=2, step=5, global_step=21,
+                       epoch_rng=ResumeCursor.capture_rng(),
+                       rng=ResumeCursor.capture_rng())
+    path = str(tmp_path / 'cursor.ckpt')
+    io_save.save(cur.to_state(), path)
+    back = ResumeCursor.from_state(io_save.load(path))
+    assert (back.epoch, back.step, back.global_step) == (2, 5, 21)
+    ResumeCursor.restore_rng(back.rng)
+    a = np.random.rand(4)
+    ResumeCursor.restore_rng(cur.rng)
+    assert np.array_equal(a, np.random.rand(4))
+
+
+def test_shuffled_loader_order_replays_from_epoch_rng():
+    """RandomSampler draws its permutation from the global numpy RNG
+    when the iterator is built; re-seating the epoch-start capture must
+    re-draw the identical shuffle."""
+
+    class _Idx(Dataset):
+        def __len__(self):
+            return 20
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    np.random.seed(7)
+    snap = ResumeCursor.capture_rng()
+    loader = DataLoader(_Idx(), batch_size=4, shuffle=True)
+    order1 = [tuple(np.asarray(b[0]).ravel()) for b in loader]
+    ResumeCursor.restore_rng(snap)
+    loader2 = DataLoader(_Idx(), batch_size=4, shuffle=True)
+    order2 = [tuple(np.asarray(b[0]).ravel()) for b in loader2]
+    assert order1 == order2
+    # and it IS a shuffle, not identity order
+    flat = [x for t in order1 for x in t]
+    assert flat != sorted(flat)
+
+
+class _TrackedData(Dataset):
+    """Records every index the loader touches, in order — the witness
+    for exact batch-order equality across an interrupted resume."""
+
+    def __init__(self, n=24):
+        rng = np.random.RandomState(3)
+        self.x = rng.randn(n, 4).astype(np.float32)
+        self.y = rng.randn(n, 1).astype(np.float32)
+        self.accessed = []
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        self.accessed.append(int(i))
+        return self.x[i], self.y[i]
+
+
+def _build_model():
+    paddle.seed(77)
+    np.random.seed(55)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss())
+    return m
+
+
+def test_resumed_run_consumes_identical_batch_order(tmp_path):
+    """Kill the trainer mid-epoch-1 and resume: the resumed run must
+    walk exactly the uninterrupted run's index sequence from the top of
+    the interrupted epoch (the fast-forwarded prefix re-reads the same
+    shuffle; training restarts at the exact loader position)."""
+    epochs, bs = 2, 4
+
+    data_ref = _TrackedData()
+    m_ref = _build_model()
+    m_ref.fit(data_ref, batch_size=bs, epochs=epochs, shuffle=True,
+              verbose=0)
+    per_epoch = len(data_ref) // bs * bs    # indices touched per epoch
+
+    class _Kill(Callback):
+        def __init__(self):
+            self.seen = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            self.seen += 1
+            if self.seen == 9:              # 3 steps into epoch 1
+                raise KeyboardInterrupt()
+
+    data_a = _TrackedData()
+    m_a = _build_model()
+    sup_a = TrainingSupervisor(str(tmp_path / 'ckpt'), save_every_steps=4)
+    with pytest.raises(KeyboardInterrupt):
+        m_a.fit(data_a, batch_size=bs, epochs=epochs, shuffle=True,
+                verbose=0, supervisor=sup_a, callbacks=[_Kill()])
+    assert sup_a.last_saved_step == 8        # epoch 1, step 2 cursor
+
+    data_b = _TrackedData()
+    m_b = _build_model()
+    np.random.seed(1000)   # wrong seed: the cursor must restore order
+    sup_b = TrainingSupervisor(str(tmp_path / 'ckpt'), save_every_steps=4)
+    m_b.fit(data_b, batch_size=bs, epochs=epochs, shuffle=True,
+            verbose=0, supervisor=sup_b)
+    # resumed run re-reads the whole interrupted epoch (fast-forward
+    # drains the trained prefix) — so its access log must equal the
+    # reference run's from the top of epoch 1
+    assert data_b.accessed == data_ref.accessed[per_epoch:]

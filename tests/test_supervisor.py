@@ -1,0 +1,408 @@
+"""Chaos kill matrix for the elastic training supervisor (ISSUE 14).
+
+Matrix: kill {trainer, PS shard, graph shard} at {mid-step,
+mid-checkpoint, mid-push}. The acceptance bar is exact:
+
+- a killed trainer resumes to BIT-IDENTICAL final params vs the
+  uninterrupted seeded run (same shuffles, same RNG stream, no
+  re-trained or skipped batches);
+- journaled PS/graph pushes apply exactly once under ack loss and
+  post-recovery replay — dedup hits equal the injected replays, and
+  the table state shows zero double-applies.
+"""
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.graph_service import (GraphPyClient,
+                                                  GraphPyServer)
+from paddle_tpu.distributed.ps.embedding_service import (EmbeddingClient,
+                                                         EmbeddingServer)
+from paddle_tpu.distributed.resilience import RetryPolicy
+from paddle_tpu.distributed.supervisor import (PreemptionWatcher,
+                                               PushJournal, ShardSpec,
+                                               ShardSupervisor,
+                                               SupervisorAbort,
+                                               TrainingSupervisor)
+from paddle_tpu.hapi.callbacks import Callback
+from paddle_tpu.hapi.model import Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.monitor.registry import MetricRegistry
+from paddle_tpu.testing import chaos
+
+
+# ---------------------------------------------------------------- trainer
+
+class _ToyData(Dataset):
+    def __init__(self, n=32):
+        rng = np.random.RandomState(7)
+        self.x = rng.randn(n, 4).astype(np.float32)
+        self.y = rng.randn(n, 1).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _build_model():
+    paddle.seed(1234)
+    np.random.seed(99)
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+    m = Model(net)
+    m.prepare(optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                             parameters=net.parameters()),
+              loss=nn.MSELoss())
+    return m
+
+
+def _params(m):
+    return {k: np.asarray(v._data if hasattr(v, '_data') else v)
+            for k, v in m.network.state_dict().items()}
+
+
+def _fit(m, **kw):
+    return m.fit(_ToyData(), batch_size=4, epochs=3, shuffle=True,
+                 verbose=0, **kw)
+
+
+@pytest.fixture(scope='module')
+def reference_params():
+    """Final params of the uninterrupted seeded 3-epoch run — the
+    bit-identity oracle for every trainer-kill scenario."""
+    m = _build_model()
+    _fit(m)
+    return _params(m)
+
+
+def _assert_bit_identical(got, ref):
+    for k in ref:
+        assert np.array_equal(ref[k], got[k]), \
+            'param %s diverged (max |d|=%g)' % (
+                k, np.abs(ref[k] - got[k]).max())
+
+
+class _KillAt(Callback):
+    """Simulated hard kill: raises out of the fit loop at the Nth
+    completed batch, before the supervisor's on_step checkpointing."""
+
+    def __init__(self, at, exc=KeyboardInterrupt):
+        self.at = at
+        self.exc = exc
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self.seen += 1
+        if self.seen == self.at:
+            raise self.exc('simulated kill at batch %d' % self.at)
+
+
+def test_trainer_killed_mid_step_resumes_bit_identical(
+        tmp_path, reference_params):
+    ckpt = str(tmp_path / 'ckpt')
+    m1 = _build_model()
+    sup1 = TrainingSupervisor(ckpt, save_every_steps=5)
+    with pytest.raises(KeyboardInterrupt):
+        _fit(m1, supervisor=sup1, callbacks=[_KillAt(13)])
+    assert sup1.last_saved_step == 10
+
+    m2 = _build_model()
+    np.random.seed(555)   # wrong seed on purpose: the cursor must win
+    sup2 = TrainingSupervisor(ckpt, save_every_steps=5)
+    _fit(m2, supervisor=sup2)
+    _assert_bit_identical(_params(m2), reference_params)
+
+
+@pytest.mark.parametrize('point', ['pre_rename', 'pre_manifest'])
+def test_trainer_killed_mid_checkpoint_falls_back(tmp_path, point,
+                                                  reference_params):
+    """The writer dies INSIDE the step-8 checkpoint (both torn states:
+    before the rename, and between rename and manifest). Restart must
+    fall back to the intact step-4 snapshot and still reach the
+    bit-identical final state."""
+    ckpt = str(tmp_path / 'ckpt')
+    m1 = _build_model()
+    sup1 = TrainingSupervisor(ckpt, save_every_steps=4)
+    with chaos.crash_io_save(point, path_substr='step_8') as fault:
+        with pytest.raises(chaos.WriterKilled):
+            _fit(m1, supervisor=sup1)
+    assert fault.fired == 1
+    if point == 'pre_manifest':
+        # data file landed, manifest did not: present but torn
+        assert os.path.exists(os.path.join(ckpt, 'step_8.ckpt'))
+    else:
+        assert not os.path.exists(os.path.join(ckpt, 'step_8.ckpt'))
+
+    m2 = _build_model()
+    sup2 = TrainingSupervisor(ckpt, save_every_steps=4)
+    cursor = sup2.restore(m2)
+    assert cursor.global_step == 4        # torn step-8 skipped
+    _fit(m2, supervisor=sup2)
+    _assert_bit_identical(_params(m2), reference_params)
+
+
+def test_sigterm_preemption_checkpoints_and_resumes(tmp_path,
+                                                    reference_params):
+    """Real SIGTERM: the watcher's handler flags it, on_step writes an
+    urgent checkpoint and stops the run cleanly; the next run resumes
+    to the bit-identical final state."""
+    ckpt = str(tmp_path / 'ckpt')
+
+    class _Sigterm(Callback):
+        def __init__(self):
+            self.seen = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            self.seen += 1
+            if self.seen == 7:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    m1 = _build_model()
+    with PreemptionWatcher() as watcher:
+        sup1 = TrainingSupervisor(ckpt, watcher=watcher)
+        _fit(m1, supervisor=sup1, callbacks=[_Sigterm()])
+    assert m1.stop_training
+    assert sup1.last_saved_step == 7      # urgent, not periodic
+
+    m2 = _build_model()
+    sup2 = TrainingSupervisor(ckpt)
+    _fit(m2, supervisor=sup2)
+    _assert_bit_identical(_params(m2), reference_params)
+
+
+# ---------------------------------------------------------------- PS shard
+
+def _make_embedding_server(port=0):
+    srv = EmbeddingServer(port=port)
+    srv.create_table(0, dim=4, optimizer='sgd', lr=1.0)
+    srv.start()
+    return srv
+
+
+def test_ps_push_ack_lost_dedups_exactly_once():
+    """Mid-push kill from the client's view: the reply is lost AFTER the
+    server applied the write. The journaled retry must be deduplicated —
+    dedup hits equal the injected drops, and the table shows exactly one
+    application."""
+    srv = _make_embedding_server()
+    try:
+        journal = PushJournal('trainer-0', registry=MetricRegistry())
+        cli = EmbeddingClient(endpoints=['127.0.0.1:%d' % srv.port],
+                              journal=journal)
+        ids = [1, 2, 3]
+        base = cli.pull(0, ids)
+        grad = np.ones((3, 4), np.float32)
+        with chaos.drop_connections(endpoint=str(srv.port), point='recv',
+                                    times=1) as fault:
+            cli.push(0, ids, grad)
+        assert fault.fired == 1
+        assert journal.dedup_hits == fault.fired   # retry was dedup'd
+        got = cli.pull(0, ids)
+        # lr=1.0 SGD: exactly one application is base - grad; a double
+        # apply would be base - 2*grad
+        assert np.allclose(got, base - grad)
+    finally:
+        srv.stop()
+
+
+def test_ps_shard_killed_recovers_exactly_once(tmp_path):
+    """PS shard hard-killed after a snapshot barrier plus one extra
+    journaled push. Recovery = restart + restore + replay; the replay
+    applies only the post-snapshot entry, a second (spurious) replay
+    dedups everything, and the final table state equals the pre-kill
+    state bit for bit."""
+    reg = MetricRegistry()
+    srv = _make_embedding_server()
+    port = srv.port
+    holder = {'srv': srv}
+
+    def restart():
+        holder['srv'] = _make_embedding_server(port)
+
+    try:
+        journal = PushJournal('trainer-0', registry=reg)
+        cli = EmbeddingClient(endpoints=['127.0.0.1:%d' % port],
+                              journal=journal)
+        ids = [1, 2, 3]
+        cli.pull(0, ids)
+        cli.push(0, ids, np.ones((3, 4), np.float32))      # seq 1
+
+        sup = ShardSupervisor(miss_threshold=1, restart_budget=3,
+                              ping_timeout=0.5, registry=reg)
+        sup.add_shard(ShardSpec('emb0', '127.0.0.1:%d' % port, role='ps',
+                                restart=restart,
+                                snapshot_dir=str(tmp_path / 'snaps'),
+                                clients=(cli,)))
+        sup.snapshot_all()
+        assert len(journal) == 0      # barrier trims the covered prefix
+
+        cli.push(0, ids, np.ones((3, 4), np.float32))      # seq 2
+        want = cli.pull(0, ids)
+
+        chaos.kill_server(holder['srv'])
+        assert sup.poll() == {'emb0': True}   # detect + recover inline
+        assert sup.alive('emb0')
+
+        got = cli.pull(0, ids)
+        assert np.array_equal(want, got)      # zero double-applies
+        # recovery replayed exactly the one post-snapshot entry, fresh
+        assert journal.replayed == 1
+        assert journal.dedup_hits == 0
+
+        # a spurious second replay must be entirely dedup'd
+        replayed, dedup = cli.replay_journal()
+        assert (replayed, dedup) == (1, 1)
+        assert journal.dedup_hits == 1        # == injected replays
+        assert np.array_equal(cli.pull(0, ids), want)
+
+        fams = {f.name: f for f in reg.collect()}
+        assert fams['supervisor_restarts_total'].labels('ps').value() == 1
+        count, total = fams['supervisor_recover_seconds'].value()
+        assert count == 1 and total > 0
+        assert fams['supervisor_shards_alive'].value() == 1
+    finally:
+        try:
+            holder['srv'].stop()
+        except Exception:
+            pass
+
+
+@pytest.mark.filterwarnings(
+    'ignore::pytest.PytestUnhandledThreadExceptionWarning')
+def test_ps_snapshot_killed_mid_write_keeps_journal(tmp_path):
+    """Shard killed mid-CHECKPOINT: the snapshot writer dies before the
+    manifest. snapshot_all must propagate the failure WITHOUT trimming
+    the journal, and recovery must fall back to the older intact
+    snapshot + full journal replay — state still exact."""
+    reg = MetricRegistry()
+    srv = _make_embedding_server()
+    port = srv.port
+    holder = {'srv': srv}
+
+    def restart():
+        holder['srv'] = _make_embedding_server(port)
+
+    try:
+        journal = PushJournal('trainer-0', registry=reg)
+        cli = EmbeddingClient(endpoints=['127.0.0.1:%d' % port],
+                              journal=journal)
+        ids = [1, 2, 3]
+        cli.pull(0, ids)
+        cli.push(0, ids, np.ones((3, 4), np.float32))
+
+        sup = ShardSupervisor(miss_threshold=1, restart_budget=3,
+                              ping_timeout=0.5, registry=reg)
+        sup.add_shard(ShardSpec('emb0', '127.0.0.1:%d' % port, role='ps',
+                                restart=restart,
+                                snapshot_dir=str(tmp_path / 'snaps'),
+                                clients=(cli,)))
+        sup.snapshot_all()                    # intact snap 1, trims seq 1
+        cli.push(0, ids, np.ones((3, 4), np.float32))
+
+        with chaos.crash_io_save('pre_manifest', path_substr='emb0_snap'):
+            with pytest.raises(Exception):
+                sup.snapshot_all()            # torn snap 2, server died
+        assert len(journal) == 1              # NOT trimmed
+
+        want = cli.pull(0, ids)
+        chaos.kill_server(holder['srv'])
+        sup.poll()
+        assert sup.alive('emb0')
+        # torn snap 2 skipped -> snap 1 restored -> journal replayed
+        assert np.array_equal(cli.pull(0, ids), want)
+        assert journal.replayed == 1
+    finally:
+        try:
+            holder['srv'].stop()
+        except Exception:
+            pass
+
+
+def test_escalation_aborts_after_restart_budget(tmp_path):
+    """No restart hook can bring the shard back: the ladder must walk
+    restart -> abort, raise SupervisorAbort, and count the stages."""
+    reg = MetricRegistry()
+    srv = _make_embedding_server()
+    port = srv.port
+    sup = ShardSupervisor(miss_threshold=1, restart_budget=2,
+                          ping_timeout=0.2, registry=reg,
+                          backoff=RetryPolicy(base_delay=0.01,
+                                              max_delay=0.02, jitter=0.0))
+    sup.add_shard(ShardSpec('emb0', '127.0.0.1:%d' % port, role='ps',
+                            restart=None,
+                            snapshot_dir=str(tmp_path / 'snaps')))
+    chaos.kill_server(srv)
+    with pytest.raises(SupervisorAbort):
+        sup.poll()
+    assert not sup.alive('emb0')
+    fams = {f.name: f for f in reg.collect()}
+    esc = fams['supervisor_escalations_total']
+    assert esc.labels('restart').value() == 1
+    assert esc.labels('abort').value() == 1
+    assert fams['supervisor_restarts_total'].labels('ps').value() == 0
+
+
+# ---------------------------------------------------------------- graph
+
+def test_graph_shard_killed_recovers_exactly_once(tmp_path):
+    """Graph shard variant of the kill matrix: oplog snapshot + journal
+    replay rebuild the store, ack-lost retries dedup, degrees stay
+    exact (no double-added edges)."""
+    reg = MetricRegistry()
+    srv = GraphPyServer(rank=0, port=0)
+    srv.start_server()
+    port = srv.port
+    holder = {'srv': srv}
+
+    def restart():
+        s = GraphPyServer(rank=0, port=port)
+        s.start_server()
+        holder['srv'] = s
+
+    try:
+        journal = PushJournal('trainer-g', registry=reg)
+        cli = GraphPyClient(endpoints=['127.0.0.1:%d' % port],
+                            journal=journal)
+        # mid-push ack loss on a journaled add_edges: retry dedups
+        with chaos.drop_connections(endpoint=str(port), point='recv',
+                                    times=1) as fault:
+            cli.add_edges('default', [1, 2, 3], [4, 5, 6])
+        assert fault.fired == 1
+        assert journal.dedup_hits == fault.fired
+        deg = cli.get_degree('default', [1, 2, 3])
+        assert list(deg) == [1, 1, 1]         # not double-added
+
+        sup = ShardSupervisor(miss_threshold=1, restart_budget=3,
+                              ping_timeout=0.5, registry=reg)
+        sup.add_shard(ShardSpec('graph0', '127.0.0.1:%d' % port,
+                                role='graph', restart=restart,
+                                snapshot_dir=str(tmp_path / 'gsnaps'),
+                                clients=(cli,)))
+        sup.snapshot_all()
+        assert len(journal) == 0
+        cli.add_edges('default', [7], [8])     # post-snapshot entry
+
+        chaos.kill_server(holder['srv'])
+        sup.poll()
+        assert sup.alive('graph0')
+        deg = cli.get_degree('default', [1, 2, 3, 7])
+        assert list(deg) == [1, 1, 1, 1]
+        assert journal.replayed == 1
+
+        fams = {f.name: f for f in reg.collect()}
+        assert fams['supervisor_restarts_total'].labels(
+            'graph').value() == 1
+    finally:
+        try:
+            holder['srv'].stop_server()
+        except Exception:
+            pass
+
+
+def test_no_leaked_faults():
+    assert chaos.active_faults() == 0

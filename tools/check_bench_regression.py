@@ -91,6 +91,9 @@ def higher_is_better(row):
     text = '%s %s' % (row.get('metric', ''), row.get('unit', ''))
     if 'hit_rate' in text:
         return True
+    if 'mttr' in text:
+        # recovery time: a faster supervisor is a better supervisor
+        return False
     return not ('ms' in text.split() or 'latency' in text
                 or text.endswith('_ms') or 'compile' in text)
 

@@ -2,12 +2,13 @@ from .retrace import RetraceChecker
 from .locks import LockChecker
 from .idempotency import IdempotencyChecker
 from .metrics import MetricsChecker
+from .atomic_write import AtomicWriteChecker
 
 __all__ = ['RetraceChecker', 'LockChecker', 'IdempotencyChecker',
-           'MetricsChecker', 'all_checkers']
+           'MetricsChecker', 'AtomicWriteChecker', 'all_checkers']
 
 
 def all_checkers():
     """Fresh instances of every registered checker."""
     return [RetraceChecker(), LockChecker(), IdempotencyChecker(),
-            MetricsChecker()]
+            MetricsChecker(), AtomicWriteChecker()]

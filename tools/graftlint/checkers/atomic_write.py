@@ -1,0 +1,118 @@
+"""Atomic-write discipline checker for checkpoint artifacts.
+
+The repo has exactly one sanctioned durable-artifact writer:
+``paddle_tpu/framework/io_save.py`` (temp file + fsync + ``os.replace``
++ CRC32 manifest sidecar, with chaos fault hooks at the torn-write
+points). A checkpoint written any other way can be torn by a preempted
+pod — and, with no manifest, ``CheckpointManager.restore_latest`` has no
+way to know it is torn.
+
+Rule:
+
+- atomic-write — a checkpoint-flavored artifact is written through a
+  raw mechanism outside io_save: ``open(..., 'w'/'wb'/'a'...)``,
+  ``pickle.dump``/``np.save``/``np.savez``, or a hand-rolled
+  ``os.rename``/``os.replace`` commit, where the call's argument
+  subtree carries checkpoint evidence (a string constant or an
+  identifier mentioning ckpt / checkpoint / pdparams / pdopt / snap).
+
+Evidence is deliberately lexical: the checker only fires where the code
+itself says it is writing a checkpoint. Generic ``open(path, 'w')``
+helpers stay quiet — naming the artifact is what creates the duty to
+write it atomically.
+"""
+import ast
+
+from ..core import Checker
+
+# the sanctioned writer itself (and only it) may touch these primitives
+# on checkpoint paths
+EXEMPT_MODULES = ('paddle_tpu.framework.io_save',)
+
+KEYWORDS = ('ckpt', 'checkpoint', 'pdparams', 'pdopt', 'snap')
+
+_RAW_DUMPERS = {'dump': ('pickle',), 'save': ('np', 'numpy'),
+                'savez': ('np', 'numpy'), 'savez_compressed': ('np',
+                                                               'numpy')}
+
+
+def _mentions_checkpoint(node):
+    """True when any string constant or identifier under `node` names a
+    checkpoint-ish artifact."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            text = n.value.lower()
+        elif isinstance(n, ast.Name):
+            text = n.id.lower()
+        elif isinstance(n, ast.Attribute):
+            text = n.attr.lower()
+        elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.arg)):
+            text = (n.name if hasattr(n, 'name') else n.arg).lower()
+        else:
+            continue
+        if any(k in text for k in KEYWORDS):
+            return True
+    return False
+
+
+def _args_mention_checkpoint(call):
+    return any(_mentions_checkpoint(a) for a in call.args) or \
+        any(_mentions_checkpoint(kw.value) for kw in call.keywords)
+
+
+def _write_mode(call):
+    """The mode string of an open() call when it writes, else None."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == 'mode':
+            mode = kw.value
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str) \
+            and any(c in mode.value for c in 'wax+'):
+        return mode.value
+    return None
+
+
+class AtomicWriteChecker(Checker):
+    name = 'atomic_write'
+    RULES = {
+        'atomic-write': 'checkpoint artifact written without the '
+                        'io_save atomic writer (temp+fsync+rename+'
+                        'manifest)',
+    }
+
+    def check(self, project):
+        out = []
+        for module in project.modules:
+            if module.modname in EXEMPT_MODULES:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._raw_write(node)
+                if hit and _args_mention_checkpoint(node):
+                    self.finding(
+                        module, node, 'atomic-write',
+                        '%s writes a checkpoint artifact raw — a '
+                        'preempted writer tears it and no manifest '
+                        'marks it torn; route it through '
+                        'framework.io_save.save' % hit, out)
+        return out
+
+    @staticmethod
+    def _raw_write(call):
+        """Human-readable label of the raw write mechanism, or None."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == 'open':
+            mode = _write_mode(call)
+            return "open(..., %r)" % mode if mode else None
+        if isinstance(f, ast.Attribute):
+            base = f.value.id if isinstance(f.value, ast.Name) else None
+            if base == 'os' and f.attr in ('rename', 'replace'):
+                return 'os.%s' % f.attr
+            allowed = _RAW_DUMPERS.get(f.attr)
+            if allowed and base in allowed:
+                return '%s.%s' % (base, f.attr)
+        return None

@@ -648,6 +648,123 @@ def bench_serving_gateway(on_tpu):
     return rows
 
 
+def bench_serving_gateway_tenants(on_tpu):
+    """Mixed-tenant gateway rung (ISSUE 15): the Poisson workload split
+    across two tenants ('premium' short prompts, 'batch' long prompts)
+    through a clean 2-replica gateway, observed through the wide-event
+    request log rather than the aggregate counters.
+
+    Rows: one per-tenant TTFT p50 row per tenant (unit 'ms', keyed by
+    the `tenant` aux field — the regression gate checks these
+    lower-is-better), plus a kv attribution row whose value is the
+    per-tenant KV page·second split. Every row carries the cross-check
+    fields `kv_events_page_seconds` (sum over wide events) and
+    `kv_pool_page_seconds` (sum of the slot allocators' pool-occupancy
+    integrals): for the slot engine the two are equal by construction,
+    and tools/request_report.py --kv-integral gates exactly that."""
+    import paddle_tpu as paddle
+    from paddle_tpu.monitor.events import (RequestLog,
+                                           set_default_request_log)
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import ContinuousBatchingEngine, ServingGateway
+    from paddle_tpu.serving.metrics import percentile
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        lens, mnt, n_req = (32, 64, 96, 128), 64, 32
+        max_len, chunk, block, num_slots = 256, 32, 8, 8
+        mean_gap = 0.02
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        lens, mnt, n_req = (8, 16, 24, 32), 32, 24
+        max_len, chunk, block, num_slots = 64, 32, 8, 8
+        mean_gap = 0.002
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    # premium gets the short half of the length ladder, batch the long
+    # half — distinguishable TTFT profiles from one workload
+    tenants = ['premium' if i % 2 == 0 else 'batch'
+               for i in range(n_req)]
+    prompts = [[int(t) for t in rng.randint(
+        0, cfg.vocab_size,
+        lens[(i % 2) * (len(lens) // 2) + (i // 2) % (len(lens) // 2)])]
+        for i in range(n_req)]
+    arrivals = _poisson_arrivals(n_req, mean_gap)
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=num_slots, max_len=max_len,
+            prefill_chunk=chunk, decode_block=block)
+
+    # the log must be installed BEFORE construction: engines and the
+    # gateway cache default_request_log() like they cache the tracer
+    log = RequestLog(capacity=4 * n_req)
+    prev_log = set_default_request_log(log)
+    try:
+        reg = MetricRegistry()
+        gw = ServingGateway(factory, replicas=2, registry=reg)
+        gw.generate(prompts[:2], max_new_tokens=2,
+                    tenant='warmup')                          # compile
+        gw.start()
+        reqs = []
+        t0 = time.time()
+        for p, arr, ten in zip(prompts, arrivals, tenants):
+            now = time.time() - t0
+            if arr > now:
+                time.sleep(arr - now)
+            reqs.append(gw.submit(p, max_new_tokens=mnt, tenant=ten))
+        for r in reqs:
+            r.wait(600)
+        dt = time.time() - t0
+        gw.shutdown()
+        # pool-occupancy integral across the pool; wide-event sum must
+        # match it exactly for slot engines (warmup events included —
+        # the integral saw those slots too)
+        pool_ps = sum(rep.engine.allocator.page_seconds()
+                      for rep in gw.pool)
+        events = log.events()
+    finally:
+        set_default_request_log(prev_log)
+    toks = sum(len(r.tokens) for r in reqs)
+    ev_ps = sum(e['kv_page_seconds'] for e in events)
+    kv_by_tenant = {}
+    ttft_by_tenant = {}
+    for e in events:
+        kv_by_tenant[e['tenant']] = (kv_by_tenant.get(e['tenant'], 0.0)
+                                     + e['kv_page_seconds'])
+        if e['first_token_t'] is not None and e['arrival_t'] is not None:
+            ttft_by_tenant.setdefault(e['tenant'], []).append(
+                (e['first_token_t'] - e['arrival_t']) * 1e3)
+    base = {'trace': 'poisson', 'mean_gap_s': mean_gap,
+            'requests': n_req, 'new_tokens': mnt,
+            'num_slots': num_slots, 'replicas': 2, 'workload': 'mixed',
+            'policy': 'least_loaded', 'degraded': not on_tpu,
+            'kv_events_page_seconds': round(ev_ps, 6),
+            'kv_pool_page_seconds': round(pool_ps, 6)}
+    rows = [dict(base, metric='serving_gateway_mixed_tokens_per_sec',
+                 value=round(toks / dt, 2), unit='tokens/sec')]
+    for tenant in ('premium', 'batch'):
+        rows.append(dict(
+            base, metric='serving_gateway_tenant_ttft_p50',
+            value=round(percentile(ttft_by_tenant.get(tenant, [0.0]),
+                                   50), 3),
+            unit='ms', tenant=tenant,
+            tenant_requests=sum(1 for e in events
+                                if e['tenant'] == tenant),
+            tenant_kv_page_seconds=round(
+                kv_by_tenant.get(tenant, 0.0), 6)))
+    return rows
+
+
 def bench_supervisor_recovery(on_tpu):
     """Elastic-supervisor MTTR rung (ISSUE 14): a journaled PS shard is
     snapshotted, hard-killed, and recovered by the ShardSupervisor
@@ -720,7 +837,7 @@ def main():
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
-               bench_supervisor_recovery):
+               bench_serving_gateway_tenants, bench_supervisor_recovery):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

@@ -23,7 +23,11 @@ Four layers, each usable alone:
   served at /fleet;
 - ``alerts``    — AlertManager: declarative threshold + multi-window
   SLO burn-rate rules with a pending→firing→resolved lifecycle,
-  flight dumps on firing edges, served at /alerts.
+  flight dumps on firing edges, served at /alerts;
+- ``events``    — RequestLog: ONE canonical wide event per serving
+  request (lifecycle timestamps, tenant, KV page·seconds, failover
+  history) in a bounded ring + rotating JSONL sink, served at
+  /requests; TenantLabeler bounds per-tenant metric cardinality.
 
 Built-in instrumentation (resilient RPC, the serving engine, PS/graph
 clients, hapi TelemetryCallback, the dryrun telemetry line) feeds
@@ -37,8 +41,12 @@ from .registry import (Counter, Gauge, Histogram, MetricRegistry,
 from .export import schema_of, to_dict, to_json, to_prometheus
 from .server import MetricsServer
 from .runtime import RuntimeSampler
-from .tracing import (FlightRecorder, Span, Tracer, default_tracer,
-                      set_default_tracer, spans_to_chrome)
+from .tracing import (FlightRecorder, Span, TraceRetention, Tracer,
+                      default_tracer, set_default_tracer,
+                      spans_to_chrome)
+from .events import (REQUEST_EVENT_FIELDS, RequestLog, TenantLabeler,
+                     default_request_log, set_default_request_log)
+from . import events
 from .federation import FleetCollector, ScrapeTarget, merge_snapshots
 from .alerts import (AlertManager, AlertRule, BurnRateRule,
                      ThresholdRule)
@@ -58,4 +66,6 @@ __all__ = ['MetricRegistry', 'Counter', 'Gauge', 'Histogram',
            'CompileWatchdog', 'RecompileError', 'StepTimeline',
            'FleetCollector', 'ScrapeTarget', 'merge_snapshots',
            'AlertManager', 'AlertRule', 'ThresholdRule', 'BurnRateRule',
-           'federation', 'alerts']
+           'federation', 'alerts', 'TraceRetention', 'RequestLog',
+           'TenantLabeler', 'REQUEST_EVENT_FIELDS', 'default_request_log',
+           'set_default_request_log', 'events']

@@ -5,8 +5,10 @@ image bakes nothing in): text exposition 0.0.4 on /metrics, a tiny JSON
 liveness body on /healthz, the tracer's flight-recorder ring on
 /debug/traces (?format=chrome for a Perfetto-loadable body), the
 federated fleet view on /fleet (?scrape=1 to force a cycle, ?format=prom
-for text exposition of the merge) and alert state on /alerts when a
-FleetCollector / AlertManager is attached, 404 elsewhere. HEAD is
+for text exposition of the merge), alert state on /alerts when a
+FleetCollector / AlertManager is attached, and the wide-event request
+log on /requests (?tenant= / ?outcome= / ?min_failovers= / ?limit=
+filters) when a RequestLog is attached, 404 elsewhere. HEAD is
 answered on every route (load-balancer probes use it and must not see
 http.server's default 501). Ephemeral-port by default so tests and
 multi-engine processes never collide; `.port`/`.url` report the bound
@@ -80,6 +82,30 @@ class _Handler(http.server.BaseHTTPRequestHandler):
             return (200, 'application/json',
                     json.dumps({'firing': mgr.firing(),
                                 'alerts': mgr.state()}).encode())
+        if path == '/requests':
+            log = getattr(self.server, 'events', None)
+            if log is None:
+                return (404, 'text/plain; charset=utf-8',
+                        b'no request log attached\n')
+            import urllib.parse
+            q = urllib.parse.parse_qs(query)
+
+            def _one(name, conv=str):
+                vals = q.get(name)
+                return None if not vals else conv(vals[0])
+
+            try:
+                evs = log.events(tenant=_one('tenant'),
+                                 outcome=_one('outcome'),
+                                 min_failovers=_one('min_failovers', int),
+                                 limit=_one('limit', int))
+            except ValueError:
+                return (400, 'text/plain; charset=utf-8',
+                        b'min_failovers/limit must be integers\n')
+            body = json.dumps({'count': len(evs),
+                               'dropped': log.dropped,
+                               'events': evs}).encode()
+            return 200, 'application/json', body
         if path == '/debug/traces':
             tracer = getattr(self.server, 'tracer', None)
             if tracer is None:
@@ -133,7 +159,7 @@ class MetricsServer:
 
     def __init__(self, registry=None, host='127.0.0.1', port=0,
                  tracer=None, readiness=None, collector=None,
-                 alerts=None):
+                 alerts=None, events=None):
         self.registry = registry if registry is not None \
             else default_registry()
         if tracer is None:
@@ -151,6 +177,9 @@ class MetricsServer:
         # routes answer 404 like any unknown path.
         self.collector = collector
         self.alerts = alerts
+        # /requests: a monitor.events.RequestLog (the wide-event ring).
+        # Optional like the collector — unattached answers 404.
+        self.events = events
         self._host = host
         self._port = int(port)
         self._srv = None
@@ -165,6 +194,7 @@ class MetricsServer:
         self._srv.readiness = self.readiness
         self._srv.collector = self.collector
         self._srv.alerts = self.alerts
+        self._srv.events = self.events
         self._srv.started = time.monotonic()
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         name='metrics-server', daemon=True)

@@ -17,6 +17,7 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_rpc_schema', 'record_client_op_schema',
            'record_train_loop_schema', 'record_fleet_schema',
            'record_alert_schema', 'record_supervisor_schema',
+           'record_request_event_schema', 'record_tenant_schema',
            'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
@@ -429,6 +430,66 @@ def record_supervisor_schema(registry):
     return out
 
 
+# the wide-event request log's health families (monitor/events.py).
+# Single-source rule: RequestLog and the schema baseline both register
+# through record_request_event_schema. Unlabeled — the log is a
+# process-level object, per-request detail lives in the events
+# themselves, never in labels.
+REQUEST_EVENT_FAMILIES = (
+    ('counter', 'request_events_total',
+     'wide request events emitted (one per completed serving request)'),
+    ('counter', 'request_events_dropped_total',
+     'wide events evicted from the bounded in-memory ring'),
+    ('counter', 'request_sink_rotations_total',
+     'request-log JSONL sink files rotated at the size cap'),
+)
+
+
+def record_request_event_schema(registry):
+    """Register the wide-event request-log families on `registry` and
+    return {name: family}. Used by RequestLog at construction and by
+    dryrun_registry so the committed baseline covers the event log."""
+    out = {}
+    for kind, name, doc in REQUEST_EVENT_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc)
+    return out
+
+
+# the per-tenant attribution families. Single-source rule: the engines'
+# ServingMetrics, the gateway and the schema baseline all register
+# through record_tenant_schema. Label budget (docs/observability.md):
+# tenant is BOUNDED by construction — events.TenantLabeler interns the
+# first cap (default 16) distinct tenants and folds the rest into a
+# fixed set of hashed overflow_<n> buckets, so worst-case cardinality is
+# cap + overflow buckets + the 'default' label, independent of traffic.
+TENANT_FAMILIES = (
+    ('counter', 'tenant_requests_total',
+     'requests completed per tenant', ('tenant',)),
+    ('counter', 'tenant_tokens_total',
+     'generated tokens delivered per tenant', ('tenant',)),
+    ('histogram', 'tenant_ttft_seconds',
+     'time to first token per tenant', ('tenant',)),
+    ('counter', 'tenant_kv_byte_seconds_total',
+     'KV-cache bytes held x seconds, attributed per tenant', ('tenant',)),
+)
+
+
+def record_tenant_schema(registry):
+    """Register the per-tenant attribution families on `registry` and
+    return {name: family}. Used by ServingMetrics / ServingGateway at
+    construction and by dryrun_registry so the committed baseline covers
+    tenant attribution."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in TENANT_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # same ladder as the unlabeled TTFT families
+            kw['buckets'] = exponential_buckets(0.002, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -449,6 +510,8 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_fleet_schema(reg)
     record_alert_schema(reg)
     record_supervisor_schema(reg)
+    record_request_event_schema(reg)
+    record_tenant_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

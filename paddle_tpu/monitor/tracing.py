@@ -38,7 +38,8 @@ import time
 
 from .registry import default_registry
 
-__all__ = ['Span', 'Tracer', 'FlightRecorder', 'NULL_SPAN', 'TRACE_KEY',
+__all__ = ['Span', 'Tracer', 'FlightRecorder', 'TraceRetention',
+           'NULL_SPAN', 'TRACE_KEY',
            'default_tracer', 'set_default_tracer', 'current_span',
            'register_metrics', 'spans_to_chrome', 'note_fault',
            'TRACING_FAMILIES']
@@ -59,6 +60,10 @@ TRACING_FAMILIES = (
      'finished spans evicted from the flight-recorder ring'),
     ('counter', 'trace_exemplars_total',
      'histogram observations annotated with a trace_id exemplar'),
+    ('counter', 'trace_retention_discarded_total',
+     'completed span trees the tail sampler decided not to keep'),
+    ('counter', 'trace_retention_evicted_total',
+     'kept or pending span trees evicted at the retention caps'),
 )
 
 
@@ -71,6 +76,10 @@ def register_metrics(registry):
     out['trace_flight_dumps_total'] = registry.counter(
         'trace_flight_dumps_total',
         'flight-recorder dumps written, by trigger reason', ('reason',))
+    out['trace_retained_total'] = registry.counter(
+        'trace_retained_total',
+        'complete span trees kept by tail-based retention, by reason',
+        ('reason',))
     return out
 
 
@@ -352,6 +361,155 @@ def spans_to_chrome(spans, pid=None, process_name=None):
     return {'traceEvents': events, 'displayTimeUnit': 'ms'}
 
 
+class TraceRetention:
+    """Tail-based trace retention: decide AFTER a trace completes.
+
+    Head sampling (keep 1%) throws away exactly the traces worth
+    reading; tail sampling buffers each trace's finished spans until its
+    ROOT span (parent_id None) completes, then keeps the whole tree when
+    the request was interesting — errored (any span with status
+    'error'), slow (root duration over `slow_threshold_s`, typically the
+    serving SLO), or force-marked by an outside observer (the gateway
+    marks failed-over requests via ``mark``) — plus a probabilistic
+    `keep_probability` sample of healthy traffic as a baseline. Wide
+    events (monitor/events.py) carry the trace_id, so ``get(trace_id)``
+    closes the event → full-span-tree join.
+
+    Everything is bounded: at most `capacity` kept trees (FIFO
+    eviction), at most `pending_capacity` incomplete trees, and a
+    bounded memory of recent decisions/marks; evictions and discards
+    are counted, never silent. Attach to a tracer via
+    ``Tracer(retention=...)`` or ``tracer.retention = ...``; a detached
+    store costs the hot path nothing (one load + branch in
+    ``_on_finish``, which only runs when tracing is enabled anyway)."""
+
+    def __init__(self, capacity=256, slow_threshold_s=None,
+                 keep_probability=0.0, pending_capacity=1024,
+                 registry=None, rng=None):
+        if capacity < 1 or pending_capacity < 1:
+            raise ValueError('capacities must be >= 1')
+        self.capacity = int(capacity)
+        self.slow_threshold_s = slow_threshold_s
+        self.keep_probability = float(keep_probability)
+        self.pending_capacity = int(pending_capacity)
+        self._rng = rng or random.random
+        self._lock = threading.Lock()
+        self._pending = collections.OrderedDict()   # trace_id -> [span]
+        self._kept = collections.OrderedDict()      # trace_id -> entry
+        self._marked = collections.OrderedDict()    # trace_id -> reason
+        self._decided = collections.OrderedDict()   # trace_id -> True
+        reg = registry if registry is not None else default_registry()
+        fams = register_metrics(reg)
+        self._m_retained = fams['trace_retained_total']
+        self._m_discarded = fams['trace_retention_discarded_total']
+        self._m_evicted = fams['trace_retention_evicted_total']
+
+    def mark(self, trace_id, reason='forced'):
+        """Force-keep `trace_id` when its tree completes (or
+        immediately, if it already has). The gateway calls this with
+        reason 'failover' for every re-placed request."""
+        if not trace_id:
+            return
+        with self._lock:
+            entry = self._kept.get(trace_id)
+            if entry is not None:
+                if reason not in entry['reasons']:
+                    entry['reasons'].append(reason)
+                return
+            self._marked[trace_id] = reason
+            while len(self._marked) > self.pending_capacity:
+                self._marked.popitem(last=False)
+
+    def offer(self, span_dict):
+        """Feed one finished span (called by Tracer._on_finish)."""
+        tid = span_dict.get('trace_id')
+        if not tid:
+            return
+        with self._lock:
+            entry = self._kept.get(tid)
+            if entry is not None:
+                # straggler span of an already-kept tree
+                entry['spans'].append(span_dict)
+                return
+            if tid in self._decided:
+                return
+            spans = self._pending.get(tid)
+            if spans is None:
+                while len(self._pending) >= self.pending_capacity:
+                    self._pending.popitem(last=False)
+                    self._m_evicted.inc()
+                spans = self._pending[tid] = []
+            spans.append(span_dict)
+            if span_dict.get('parent_id') is None:
+                self._decide_locked(tid, span_dict)
+
+    def _decide_locked(self, tid, root):
+        spans = self._pending.pop(tid, [])
+        reasons = []
+        forced = self._marked.pop(tid, None)
+        if forced is not None:
+            reasons.append(forced)
+        if any(s.get('status') == 'error' for s in spans):
+            reasons.append('error')
+        duration = float(root.get('end') or 0.0) \
+            - float(root.get('start') or 0.0)
+        if self.slow_threshold_s is not None \
+                and duration > self.slow_threshold_s:
+            reasons.append('slow')
+        if not reasons and self.keep_probability > 0.0 \
+                and self._rng() < self.keep_probability:
+            reasons.append('sampled')
+        self._decided[tid] = True
+        while len(self._decided) > 4 * self.pending_capacity:
+            self._decided.popitem(last=False)
+        if not reasons:
+            self._m_discarded.inc()
+            return
+        while len(self._kept) >= self.capacity:
+            self._kept.popitem(last=False)
+            self._m_evicted.inc()
+        self._kept[tid] = {'trace_id': tid, 'reasons': reasons,
+                           'root': root.get('name'),
+                           'duration_s': duration,
+                           'end': root.get('end'), 'spans': spans}
+        self._m_retained.labels(reasons[0]).inc()
+
+    def get(self, trace_id):
+        """The full retained span tree for `trace_id` (list of span
+        dicts, finish order), or None if it was not kept."""
+        with self._lock:
+            entry = self._kept.get(trace_id)
+            return list(entry['spans']) if entry is not None else None
+
+    def traces(self, reason=None):
+        """Summaries of the kept trees, oldest first: trace_id, reasons,
+        root span name, duration."""
+        with self._lock:
+            entries = list(self._kept.values())
+        out = []
+        for e in entries:
+            if reason is not None and reason not in e['reasons']:
+                continue
+            out.append({'trace_id': e['trace_id'],
+                        'reasons': list(e['reasons']),
+                        'root': e['root'],
+                        'duration_s': e['duration_s'],
+                        'end': e['end'],
+                        'span_count': len(e['spans'])})
+        return out
+
+    def __len__(self):
+        with self._lock:
+            return len(self._kept)
+
+    def clear(self):
+        with self._lock:
+            self._pending.clear()
+            self._kept.clear()
+            self._marked.clear()
+            self._decided.clear()
+
+
 class Tracer:
     """Span factory + the enabled/disabled switch.
 
@@ -362,7 +520,7 @@ class Tracer:
     production so cross-process spans share a timeline."""
 
     def __init__(self, enabled=True, clock=None, recorder=None,
-                 registry=None):
+                 registry=None, retention=None):
         self.enabled = bool(enabled)
         self.clock = clock or time.time
         self.registry = registry if registry is not None \
@@ -372,6 +530,9 @@ class Tracer:
         self._m_finished = fams['trace_spans_finished_total']
         self.recorder = recorder if recorder is not None else \
             FlightRecorder(registry=self.registry, clock=self.clock)
+        # tail-based retention is opt-in: None costs one load + branch
+        # per finished span (attach with tracer.retention = TraceRetention())
+        self.retention = retention
 
     def enable(self):
         self.enabled = True
@@ -385,15 +546,24 @@ class Tracer:
         """The calling thread/context's innermost entered span."""
         return _current.get()
 
-    def start_span(self, name, parent=None, ctx=None, tags=None):
+    def start_span(self, name, parent=None, ctx=None, tags=None,
+                   root=False):
         """Begin a span. Parent resolution: explicit `ctx` (a wire dict
         from a remote client) > explicit `parent` span > the contextvar
-        current span > a fresh root. The returned span is NOT current
-        until entered (``with``) — lifecycle spans held across calls
-        (a serving request) just ``finish()`` manually."""
+        current span > a fresh root. `root=True` skips the contextvar
+        lookup and always opens a NEW trace — request-identity spans
+        (the engines' serving.request) use it so a request re-submitted
+        inside a gateway routing/failover span still owns its own trace
+        (tail retention decides per request, and the wide event's
+        trace_id resolves to exactly that request's tree). The returned
+        span is NOT current until entered (``with``) — lifecycle spans
+        held across calls (a serving request) just ``finish()``
+        manually."""
         if not self.enabled:
             return NULL_SPAN
-        if ctx is not None:
+        if root:
+            trace_id, parent_id = _new_id(128), None
+        elif ctx is not None:
             trace_id = str(ctx.get('trace_id') or _new_id(128))
             parent_id = ctx.get('span_id')
         elif parent:
@@ -424,7 +594,11 @@ class Tracer:
 
     def _on_finish(self, span):
         self._m_finished.inc()
-        self.recorder.record(span.to_dict())
+        d = span.to_dict()
+        self.recorder.record(d)
+        ret = self.retention
+        if ret is not None:
+            ret.offer(d)
 
 
 def _env_enabled():

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 
 from ..framework import functional as _fm
 from ..framework.core import Tensor, no_grad_guard
+from ..monitor import events as _events
 from ..monitor import tracing as _tracing
 from ..monitor.perf import CompileWatchdog, StepTimeline
 from ..monitor.perf import costmodel as _costmodel
@@ -47,6 +48,18 @@ from .metrics import ServingMetrics
 from .scheduler import Request, Scheduler
 
 __all__ = ['ContinuousBatchingEngine']
+
+
+def _kv_row_bytes(model):
+    """Bytes one KV-cache row (all layers, K+V) costs for `model` —
+    the conversion factor between page·seconds and byte·seconds for
+    per-tenant billing."""
+    config = model.config
+    head_dim = config.hidden_size // config.num_heads
+    dtype = str(model.gpt.wte.weight.dtype).replace('paddle.', '')
+    itemsize = {'bfloat16': 2, 'float16': 2, 'int8': 1}.get(
+        dtype) or np.dtype(dtype).itemsize
+    return 2 * len(model.gpt.h) * config.num_heads * head_dim * itemsize
 
 
 def _pick_token(lg, key, temp, topk, sample):
@@ -112,6 +125,10 @@ class _EngineBase:
         # cached at construction (like the registry): swap the default
         # tracer BEFORE building the engine under test
         self._tracer = _tracing.default_tracer()
+        # wide-event request log, same caching rule; subclasses set the
+        # page->bytes factor once their cache layout is known
+        self.events = _events.default_request_log()
+        self._kv_page_bytes = 0
         self.trace_counts = {k: 0 for k in self._programs}
         # scrape-visible retrace canary: flat at 1 per program == the
         # bounded-compilation contract holds in production, not just
@@ -140,11 +157,20 @@ class _EngineBase:
     # ---- front door ---------------------------------------------------
 
     def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
-                    top_k=0, do_sample=False, seed=0, stream=False):
-        """Queue a generation request; returns the Request handle."""
+                    top_k=0, do_sample=False, seed=0, stream=False,
+                    tenant=None, emit_event=True):
+        """Queue a generation request; returns the Request handle.
+
+        `tenant` is the attribution dimension: it rides the request into
+        the per-tenant metric families and the wide event. `emit_event=
+        False` suppresses this engine's wide event — the gateway sets it
+        so a failed-over request still produces exactly ONE canonical
+        record (the gateway's, which knows the failover history)."""
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
-                      do_sample=do_sample, seed=seed)
+                      do_sample=do_sample, seed=seed, tenant=tenant)
+        req._emit_event = bool(emit_event)
+        req._tenant_label = self.metrics.tenant_label(tenant)
         # front-door guard, shared by BOTH engines (the paged subclass
         # overrides _validate without chaining): a request whose worst
         # case — prompt plus every generated token but the last — cannot
@@ -165,14 +191,22 @@ class _EngineBase:
                     'engine is shut down — it no longer admits requests')
             self._validate(req)
             self.scheduler.submit(req)
-            self.metrics.on_arrival(req.id)
+            t = self.metrics.now()
+            req._arrival_t = t
+            self.metrics.on_arrival(req.id, t)
             tr = self._tracer
             if tr.enabled:
-                req._span = tr.start_span(
-                    'serving.request',
-                    tags={'request_id': req.id,
-                          'prompt_len': len(req.prompt),
-                          'max_new_tokens': req.max_new_tokens})
+                tags = {'request_id': req.id,
+                        'prompt_len': len(req.prompt),
+                        'max_new_tokens': req.max_new_tokens}
+                if tenant is not None:
+                    tags['tenant'] = req._tenant_label
+                # root=True: the request owns its trace even when
+                # submitted inside a gateway routing/failover span —
+                # tail retention decides at THIS span's finish, and the
+                # wide event's trace_id joins to exactly this tree
+                req._span = tr.start_span('serving.request', tags=tags,
+                                          root=True)
                 req._span.add_event('queued',
                                     queue_depth=len(self.scheduler.queue))
         return req
@@ -304,6 +338,7 @@ class _EngineBase:
 
     def _admit(self):
         for slot, req in self.scheduler.admit():
+            req._admit_t = self.metrics.now()
             self.metrics.on_admitted(req.id)
             if req._span is not None:
                 req._span.add_event('admitted', slot=slot)
@@ -348,16 +383,25 @@ class _EngineBase:
         if req._stream_q is not None:
             for t in tokens:
                 req._stream_q.put(t)
+        if req._first_token_t is None:
+            req._first_token_t = self.metrics.now()
+            if req._arrival_t is not None:
+                self.metrics.on_tenant_ttft(
+                    req._tenant_label, req._first_token_t - req._arrival_t)
+        self.metrics.on_tenant_tokens(req._tenant_label, len(tokens))
         self.metrics.on_tokens(
             req.id, len(tokens),
             trace_id=None if req._span is None else req._span.trace_id)
 
-    def _retire(self, req):
+    def _retire(self, req, outcome='ok'):
         slot = req.slot
         self._active[slot] = False
         del self._requests[slot]
-        self.scheduler.retire(req)
+        self.scheduler.retire(req)     # sets req.kv_page_seconds
+        req._finish_t = self.metrics.now()
         self.metrics.on_retired(req.id)
+        self.metrics.on_tenant_retired(
+            req._tenant_label, req.kv_page_seconds * self._kv_page_bytes)
         if req._phase is not None:
             req._phase.finish()
             req._phase = None
@@ -365,6 +409,38 @@ class _EngineBase:
             req._span.set_tag('tokens', len(req.tokens))
             req._span.add_event('retired')
             req._span.finish()
+        self._emit_wide_event(req, outcome)
+
+    def _emit_wide_event(self, req, outcome):
+        """THE canonical per-request record (monitor/events.py). One
+        load + branch when the log is disabled; skipped entirely for
+        gateway-managed requests (the gateway emits the canonical one,
+        with the failover history only it knows)."""
+        log = self.events
+        if not log.enabled or not req._emit_event:
+            return
+        wait = (req._admit_t - req._arrival_t) \
+            if req._admit_t is not None and req._arrival_t is not None \
+            else None
+        log.emit(
+            request_id=req.id,
+            tenant=req._tenant_label,
+            trace_id=None if req._span is None else req._span.trace_id,
+            arrival_t=req._arrival_t,
+            admit_t=req._admit_t,
+            first_token_t=req._first_token_t,
+            finish_t=req._finish_t,
+            queue_wait_s=wait,
+            prefill_chunks=req._prefill_chunks,
+            prompt_tokens=len(req.prompt),
+            output_tokens=len(req.tokens),
+            prefix_hit_tokens=req._prefix_hit,
+            spec_proposed=req._spec_proposed,
+            spec_accepted=req._spec_accepted,
+            kv_page_seconds=req.kv_page_seconds,
+            failovers=0,
+            replicas=[],
+            outcome=outcome)
 
 
 class ContinuousBatchingEngine(_EngineBase):
@@ -385,6 +461,8 @@ class ContinuousBatchingEngine(_EngineBase):
         self.allocator = SlotAllocator(self.num_slots)
         self.scheduler = Scheduler(self.allocator, self.max_len,
                                    prefill_chunk)
+        # billing unit for kv_byte_seconds: a slot reserves max_len rows
+        self._kv_page_bytes = _kv_row_bytes(model) * self.max_len
         if donate is None:
             # cache buffers dominate engine memory; donating them lets
             # XLA update in place. CPU donation is a no-op that warns.

@@ -24,7 +24,14 @@ def slo_burn_rate(samples, now, slo_ttft_s, window_s):
 
     `samples` is an iterable of (t, ttft_seconds). No samples in the
     window means no evidence of burn — 0.0, never NaN.
+
+    The snapshot below is load-bearing: the gateway hands its live
+    `_ttfts` deque here, and driver threads append to it concurrently.
+    Appends on a maxlen deque evict from the left, and iterating a
+    deque while another thread mutates it raises RuntimeError — so
+    iterate a tuple copy, never the live object.
     """
+    samples = tuple(samples)
     recent = [ttft for (t, ttft) in samples if now - t <= window_s]
     if not recent:
         return 0.0

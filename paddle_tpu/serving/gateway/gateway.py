@@ -42,9 +42,10 @@ import queue as _queue
 import threading
 import time
 
+from ...monitor import events as _events
 from ...monitor import tracing as _tracing
 from ...monitor.registry import default_registry
-from ...monitor.telemetry import record_gateway_schema
+from ...monitor.telemetry import record_gateway_schema, record_tenant_schema
 from .autoscaler import slo_burn_rate
 from .replica import DRAINING, READY, STATE_CODES, InprocReplica
 from .router import LeastLoadedRouter
@@ -70,8 +71,11 @@ class GatewayRequest:
         self.sampling = dict(sampling)
         self.tokens = []
         self.replica_history = []
+        self.failovers = 0       # replica losses survived
         self.arrival_t = None
+        self.first_token_t = None
         self.error = None        # set iff rejected after being accepted
+        self._eng_req = None     # current engine-side Request
         self._stream_q = _queue.Queue() if stream else None
         self._finished = threading.Event()
 
@@ -127,6 +131,16 @@ class ServingGateway:
         self._m_queue = fams['gateway_queue_depth']
         self._m_burn = fams['gateway_slo_burn_rate']
         self._m_ttft = fams['gateway_ttft_seconds']
+        # tenant attribution at the FRONT DOOR (replicas keep their own
+        # engine-level tenant families on private registries): requests
+        # and TTFT are observed here where failovers are invisible to
+        # the caller, so a tenant's TTFT includes failover stalls
+        tfams = record_tenant_schema(self.registry)
+        self._m_tenant_requests = tfams['tenant_requests_total']
+        self._m_tenant_ttft = tfams['tenant_ttft_seconds']
+        self._labeler = _events.TenantLabeler()
+        # wide-event log, cached at construction like the tracer
+        self.events = _events.default_request_log()
         self.pool = []                      # never shrinks; index == id
         self._pending = collections.deque()
         self._ttfts = collections.deque(maxlen=4096)   # (t, ttft_s)
@@ -146,12 +160,17 @@ class ServingGateway:
 
     # ---- front door ---------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=32, stream=False, **sampling):
+    def submit(self, prompt, max_new_tokens=32, stream=False, tenant=None,
+               **sampling):
         """Accept one request; returns the GatewayRequest handle.
         Raises ValueError for requests no replica could EVER admit (the
         engines' front-door guard) — those must fail the caller, not
-        trip failover."""
-        sampling = dict(sampling, max_new_tokens=max_new_tokens)
+        trip failover.
+
+        `tenant` folds into the sampling dict so a failover re-submit
+        carries it: attribution survives replica loss by construction."""
+        sampling = dict(sampling, max_new_tokens=max_new_tokens,
+                        tenant=tenant)
         gw = GatewayRequest(prompt, sampling, stream=stream)
         with self._lock:
             gw.arrival_t = self._clock()
@@ -195,9 +214,17 @@ class ServingGateway:
                     continue
                 rep.breaker.record_success()
                 rep.assigned[gw] = eng_req
+                gw._eng_req = eng_req
                 gw.replica_history.append(rep.index)
                 self._m_route.labels(str(rep.index)).inc()
                 span.set_tag('replica', rep.index)
+                if gw.failovers and eng_req._span is not None:
+                    # force-retain the replacement trace: a failed-over
+                    # request's span tree must be retrievable from the
+                    # wide event's trace_id no matter how fast it ran
+                    ret = self._tracer.retention
+                    if ret is not None:
+                        ret.mark(eng_req._span.trace_id, 'failover')
                 rep.wake()
                 return True
             span.set_tag('replica', -1)
@@ -216,6 +243,7 @@ class ServingGateway:
                 gw.error = exc
                 if gw._stream_q is not None:
                     gw._stream_q.put(None)
+                self._emit_wide_event_locked(gw, 'error')
                 gw._finished.set()
                 continue
             if not routed:
@@ -250,6 +278,8 @@ class ServingGateway:
                       'breaker_opened': bool(opened)}):
             for gw in victims:
                 self._m_failover.inc()
+                gw.failovers += 1    # before routing: the replacement
+                gw._eng_req = None   # trace gets the failover mark
                 if not self._route_locked(gw):
                     self._pending.append(gw)
         self._m_queue.set(len(self._pending))
@@ -288,8 +318,11 @@ class ServingGateway:
             new = er.tokens[len(gw.tokens):]
             if new:
                 if not gw.tokens:
+                    gw.first_token_t = now
                     ttft = now - gw.arrival_t
                     self._m_ttft.observe(ttft)
+                    self._m_tenant_ttft.labels(self._labeler.label(
+                        gw.sampling.get('tenant'))).observe(ttft)
                     self._ttfts.append((now, ttft))
                 gw.tokens.extend(new)
                 if gw._stream_q is not None:
@@ -300,11 +333,61 @@ class ServingGateway:
                 del rep.assigned[gw]
                 self._complete_locked(gw)
 
-    def _complete_locked(self, gw):
+    def _complete_locked(self, gw, outcome='ok'):
         if gw._stream_q is not None:
             gw._stream_q.put(None)
+        self._m_tenant_requests.labels(self._labeler.label(
+            gw.sampling.get('tenant'))).inc()
+        self._emit_wide_event_locked(gw, outcome)
         gw._finished.set()
         self._m_completed.inc()
+
+    def _emit_wide_event_locked(self, gw, outcome):
+        """THE canonical record for a gateway-managed request. Engine
+        events are suppressed at replica.submit (emit_event=False), so
+        exactly one event per submitted request exists no matter how
+        many replicas it traversed; failovers/replicas carry the part
+        only the gateway knows. Per-request fields (prefill chunks, KV
+        page-seconds, spec counts) come from the FINAL engine request —
+        a dead replica's partial window is gone with the replica.
+
+        Instrumentation attrs are read with getattr defaults: the
+        replica contract only requires tokens/done on engine requests,
+        so a duck-typed engine without the serving internals still gets
+        a (sparser) event rather than an AttributeError."""
+        log = self.events
+        if not log.enabled:
+            return
+        er = gw._eng_req
+        span = getattr(er, '_span', None)
+        trace_id = None if span is None else span.trace_id
+        admit_t = getattr(er, '_admit_t', None)
+        wait = None
+        if admit_t is not None:
+            # both clocks default to time.monotonic; with an injected
+            # gateway clock this degrades to engine-side wait only
+            wait = admit_t - (gw.arrival_t if self._clock
+                              is time.monotonic
+                              else getattr(er, '_arrival_t', admit_t))
+        log.emit(
+            request_id=gw.id,
+            tenant=self._labeler.label(gw.sampling.get('tenant')),
+            trace_id=trace_id,
+            arrival_t=gw.arrival_t,
+            admit_t=admit_t,
+            first_token_t=gw.first_token_t,
+            finish_t=self._clock(),
+            queue_wait_s=wait,
+            prefill_chunks=getattr(er, '_prefill_chunks', 0),
+            prompt_tokens=len(gw.prompt),
+            output_tokens=len(gw.tokens),
+            prefix_hit_tokens=getattr(er, '_prefix_hit', 0),
+            spec_proposed=getattr(er, '_spec_proposed', 0),
+            spec_accepted=getattr(er, '_spec_accepted', 0),
+            kv_page_seconds=getattr(er, 'kv_page_seconds', 0.0),
+            failovers=gw.failovers,
+            replicas=list(gw.replica_history),
+            outcome=outcome)
 
     # ---- drive: sync mode ---------------------------------------------
 

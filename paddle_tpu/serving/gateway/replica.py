@@ -86,7 +86,11 @@ class InprocReplica:
         hook first: a partitioned replica rejects the submission before
         the engine sees it, like a dead socket."""
         fire_fault_points('send', self.endpoint)
-        eng_req = self.engine.add_request(prompt, **sampling)
+        # emit_event=False: the GATEWAY emits the one canonical wide
+        # event per request (it alone knows the failover history); an
+        # engine-level event per placement would double-count failovers
+        eng_req = self.engine.add_request(prompt, emit_event=False,
+                                          **sampling)
         # refresh the queue gauge immediately so the router's next
         # ranking sees this submission without waiting for a step
         self.engine.metrics.on_queue_depth(

@@ -20,6 +20,7 @@ occupant's rows are unreachable the moment the length resets (the
 engine's first prefill chunk writes back the new occupant's own length).
 """
 import heapq
+import time
 from collections import OrderedDict
 
 __all__ = ['SlotAllocator', 'build_slot_caches', 'PageAllocator',
@@ -34,24 +35,57 @@ class SlotAllocator:
     replay the same workload and must see the same slot layout.
     """
 
-    def __init__(self, num_slots):
+    def __init__(self, num_slots, clock=None):
         if num_slots < 1:
             raise ValueError('num_slots must be >= 1, got %d' % num_slots)
         self.num_slots = num_slots
+        self.clock = clock or time.monotonic
         self._free = list(range(num_slots))
         heapq.heapify(self._free)
         self._owner = {}  # slot -> opaque owner (request id)
+        self._held_since = {}  # slot -> advance timestamp at alloc
+        self._integral = 0.0   # integral of in_use over time (slot*s)
+        self._last_t = self.clock()
+
+    def _advance(self):
+        """Accrue the occupancy integral up to now; returns now. Every
+        state change routes through here, so per-request holding times
+        measured from the SAME timestamps sum exactly to the pool
+        integral (the billing cross-check in bench/request_report)."""
+        now = self.clock()
+        self._integral += len(self._owner) * (now - self._last_t)
+        self._last_t = now
+        return now
+
+    def touch(self):
+        """Public advance: accrue the integral and return the shared
+        timestamp (schedulers stamp request holding windows with it)."""
+        return self._advance()
+
+    def page_seconds(self):
+        """The pool-occupancy integral: sum over time of slots held, in
+        slot·seconds (one slot == the allocation granule == one 'page'
+        for attribution purposes)."""
+        self._advance()
+        return self._integral
 
     def alloc(self, owner):
         """Claim the lowest free slot for `owner`; None when full."""
         if not self._free:
             return None
+        now = self._advance()
         slot = heapq.heappop(self._free)
         self._owner[slot] = owner
+        self._held_since[slot] = now
         return slot
 
+    def held_since(self, slot):
+        """The integral timestamp at which `slot` was allocated."""
+        return self._held_since.get(slot)
+
     def free(self, slot):
-        """Release `slot` back to the free list.
+        """Release `slot` back to the free list; returns the seconds it
+        was held (measured on the integral's own timestamps).
 
         Freeing a slot that is not currently allocated — including a
         second free of the same slot — raises: a silent double-free here
@@ -63,8 +97,10 @@ class SlotAllocator:
             raise ValueError(
                 'slot %r is not allocated (double-free, or never '
                 'allocated)' % (slot,))
+        now = self._advance()
         del self._owner[slot]
         heapq.heappush(self._free, slot)
+        return now - self._held_since.pop(slot)
 
     def owner_of(self, slot):
         return self._owner.get(slot)
@@ -100,19 +136,44 @@ class PageAllocator:
     the page returns to the free list only at refcount 0.
     """
 
-    def __init__(self, num_pages):
+    def __init__(self, num_pages, clock=None):
         if num_pages < 2:
             raise ValueError('num_pages must be >= 2 (page 0 is the '
                              'reserved scratch page), got %d' % num_pages)
         self.num_pages = num_pages
+        self.clock = clock or time.monotonic
         self._free = list(range(1, num_pages))
         heapq.heapify(self._free)
         self._refs = {}  # page -> refcount (> 0)
+        self._integral = 0.0  # integral of in_use over time (page*s)
+        self._last_t = self.clock()
+
+    def _advance(self):
+        """Accrue the occupancy integral (distinct pages referenced x
+        elapsed time) up to now; returns now. Shared pages count ONCE
+        here no matter how many sequences map them — per-request
+        attribution can therefore exceed the pool integral exactly when
+        prefix sharing saves pool space."""
+        now = self.clock()
+        self._integral += len(self._refs) * (now - self._last_t)
+        self._last_t = now
+        return now
+
+    def touch(self):
+        """Public advance: accrue the integral and return the shared
+        timestamp (schedulers stamp request holding windows with it)."""
+        return self._advance()
+
+    def page_seconds(self):
+        """The pool-occupancy integral in page·seconds."""
+        self._advance()
+        return self._integral
 
     def alloc(self):
         """Claim the lowest free page at refcount 1; None when empty."""
         if not self._free:
             return None
+        self._advance()
         page = heapq.heappop(self._free)
         self._refs[page] = 1
         return page
@@ -135,6 +196,7 @@ class PageAllocator:
                 'allocated)' % (page,))
         self._refs[page] -= 1
         if self._refs[page] == 0:
+            self._advance()
             del self._refs[page]
             heapq.heappush(self._free, page)
             return True

@@ -18,9 +18,11 @@ Two outputs from the same events:
 import time
 
 from ..monitor import tracing as _tracing
+from ..monitor.events import TenantLabeler
 from ..monitor.registry import default_registry
 from ..monitor.telemetry import (record_serving_schema,
-                                 record_serving_request_schema)
+                                 record_serving_request_schema,
+                                 record_tenant_schema)
 
 __all__ = ['ServingMetrics', 'percentile']
 
@@ -79,6 +81,14 @@ class ServingMetrics:
         self._m_spec_accepted = paged['serving_spec_tokens_accepted_total']
         self._m_exemplars = _tracing.register_metrics(
             r)['trace_exemplars_total']
+        # per-tenant attribution families (bounded cardinality: the
+        # labeler interns a capped tenant set + hashed overflow buckets)
+        tenant = record_tenant_schema(r)
+        self._m_tenant_requests = tenant['tenant_requests_total']
+        self._m_tenant_tokens = tenant['tenant_tokens_total']
+        self._m_tenant_ttft = tenant['tenant_ttft_seconds']
+        self._m_tenant_kv = tenant['tenant_kv_byte_seconds_total']
+        self._labeler = TenantLabeler()
         self._prefill_tokens = 0
         self._prefix_hits = 0
         self._prefix_misses = 0
@@ -166,6 +176,26 @@ class ServingMetrics:
         if misses:
             self._prefix_misses += misses
             self._m_prefix_misses.inc(misses)
+
+    def tenant_label(self, tenant):
+        """The bounded metric label for `tenant` (None -> 'default')."""
+        return self._labeler.label(tenant)
+
+    def on_tenant_tokens(self, label, count):
+        """`count` generated tokens attributed to tenant `label` (a
+        value from tenant_label, never a raw caller string)."""
+        if count > 0:
+            self._m_tenant_tokens.labels(label).inc(count)
+
+    def on_tenant_ttft(self, label, seconds):
+        self._m_tenant_ttft.labels(label).observe(seconds)
+
+    def on_tenant_retired(self, label, kv_byte_seconds):
+        """One request of tenant `label` finished having integrated
+        `kv_byte_seconds` of KV-cache residency."""
+        self._m_tenant_requests.labels(label).inc()
+        if kv_byte_seconds > 0:
+            self._m_tenant_kv.labels(label).inc(kv_byte_seconds)
 
     def on_spec(self, proposed, accepted):
         """One speculative verify pass: `proposed` draft tokens went in,

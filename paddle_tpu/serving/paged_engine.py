@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from ..framework import functional as _fm
 from ..framework.core import Tensor
 from ..text.models.gpt import GPTPagedCache
-from .engine import _EngineBase, _pick_token
+from .engine import _EngineBase, _kv_row_bytes, _pick_token
 from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
                        build_paged_pools)
 from .scheduler import PagedScheduler
@@ -126,6 +126,8 @@ class PagedContinuousBatchingEngine(_EngineBase):
         self.scheduler = PagedScheduler(self.allocator, self.pages,
                                         self.max_len, prefill_chunk,
                                         self.page_size, self.prefix)
+        # billing unit for kv_byte_seconds: one physical page
+        self._kv_page_bytes = _kv_row_bytes(model) * self.page_size
         # per-row KV length (rows written), the block-table companion to
         # the base class's host control arrays. Mid-prefill rows track
         # consumed so in-program garbage writes from frozen lanes land
@@ -182,9 +184,9 @@ class PagedContinuousBatchingEngine(_EngineBase):
                                           m - self._prefix_seen[1])
             self._prefix_seen = [h, m]
 
-    def _retire(self, req):
+    def _retire(self, req, outcome='ok'):
         slot = req.slot
-        super()._retire(req)
+        super()._retire(req, outcome)
         self._lens[slot] = 0
 
     # ---- the three compiled programs ----------------------------------
@@ -366,6 +368,8 @@ class PagedContinuousBatchingEngine(_EngineBase):
             left = int(self._budgets[slot]) - int(self._gen[slot])
             emit = [int(x) for x in g[:min(a + 1, left)]]
             self.metrics.on_spec(K, max(len(emit) - 1, 0))
+            req._spec_proposed += K
+            req._spec_accepted += max(len(emit) - 1, 0)
             if req._span is not None:
                 req._span.add_event('spec_accept', proposed=K,
                                     accepted=max(len(emit) - 1, 0))

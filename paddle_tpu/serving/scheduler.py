@@ -43,7 +43,7 @@ class Request:
     """
 
     def __init__(self, prompt, max_new_tokens=32, temperature=1.0,
-                 top_k=0, do_sample=False, seed=0):
+                 top_k=0, do_sample=False, seed=0, tenant=None):
         self.id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -51,8 +51,21 @@ class Request:
         self.top_k = int(top_k)
         self.do_sample = bool(do_sample)
         self.seed = int(seed)
+        self.tenant = tenant      # attribution dimension (opaque string)
         self.tokens = []          # generated ids (prompt NOT included)
         self.state = QUEUED
+        # wide-event lifecycle fields (monitor/events.py): the engine
+        # stamps the timestamps on its metrics clock; the scheduler owns
+        # the KV holding window on the allocator's integral clock
+        self.kv_page_seconds = 0.0
+        self._arrival_t = None
+        self._admit_t = None
+        self._first_token_t = None
+        self._finish_t = None
+        self._prefill_chunks = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._kv_hold_t = None    # allocator timestamp at reservation
         self.slot = None          # bound while resident
         self._key = None          # PRNG key, set at admission
         self._consumed = 0        # prompt tokens already prefilled
@@ -123,6 +136,10 @@ class Scheduler:
             req.slot = slot
             req.state = PREFILL
             req._consumed = 0
+            # holding window opens on the allocator's own advance
+            # timestamp, so per-request durations sum exactly to the
+            # pool-occupancy integral
+            req._kv_hold_t = self.allocator.held_since(slot)
             self.resident[slot] = req
             admitted.append((slot, req))
         return admitted
@@ -146,6 +163,7 @@ class Scheduler:
 
     def mark_prefilled(self, req, consumed):
         req._consumed = consumed
+        req._prefill_chunks += 1
         if req._consumed >= len(req.prompt):
             req.state = DECODE
 
@@ -157,7 +175,8 @@ class Scheduler:
         """Release a finished request's slot and wake any waiters."""
         slot = req.slot
         del self.resident[slot]
-        self.allocator.free(slot)
+        # one slot is the allocation granule: page·seconds == slot·seconds
+        req.kv_page_seconds = self.allocator.free(slot)
         req.state = DONE
         req.slot = None
         if req._stream_q is not None:
@@ -238,6 +257,9 @@ class PagedScheduler(Scheduler):
                 break                          # head blocked => stop: FIFO
             self.queue.popleft()
             pages, hit_len = plan
+            # the request's page-holding window opens here (shared
+            # prefix pages were increfed inside _reserve moments ago)
+            req._kv_hold_t = self.pages.touch()
             slot = self.allocator.alloc(req.id)
             row = self.block_tables[slot]
             row[:] = SCRATCH_PAGE
@@ -294,8 +316,18 @@ class PagedScheduler(Scheduler):
     def retire(self, req):
         slot = req.slot
         row = self.block_tables[slot]
-        for b in range(self._nblocks.pop(slot, 0)):
+        nblocks = self._nblocks.pop(slot, 0)
+        now = self.pages.touch()
+        held = (now - req._kv_hold_t) if req._kv_hold_t is not None \
+            else 0.0
+        for b in range(nblocks):
             if row[b] != SCRATCH_PAGE:
                 self.pages.decref(int(row[b]))
         row[:] = SCRATCH_PAGE
         super().retire(req)
+        # super() set the SLOT holding time; this engine bills PAGES:
+        # every reserved page, shared prefix hits included (the tenant
+        # pinned them for its whole residency even if another tenant
+        # also mapped them — see PageAllocator._advance for why the
+        # per-request sum can exceed the pool integral under sharing)
+        req.kv_page_seconds = nblocks * held

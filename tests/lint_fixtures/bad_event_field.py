@@ -1,0 +1,23 @@
+"""Fixture: wide-event schema violations the events checker must catch.
+
+Two shapes of the same mistake — a field name the committed baseline
+(tools/request_event_baseline.json) does not know:
+
+  1. an emit(...) keyword typo'd at an emission site (RequestLog.emit
+     would raise at runtime, but only when that path runs);
+  2. a REQUEST_EVENT_FIELDS table declaring a field the baseline was
+     never taught.
+"""
+from paddle_tpu.monitor.events import default_request_log
+
+# a vendored/forked schema table drifting from the baseline
+REQUEST_EVENT_FIELDS = (
+    ('request_id', 'engine- or gateway-level request id'),
+    ('tenant_id', 'BAD: the canonical field is named `tenant`'),
+)
+
+
+def emit_event(req):
+    log = default_request_log()
+    # `tennant` is a typo of `tenant`; the checker flags it statically
+    log.emit(request_id=req.id, tennant='acme', outcome='ok')

@@ -253,11 +253,24 @@ def _fleet_losses(fused, strategy_kwargs, steps=2, schedule=None,
     return [float(step(ids, lbl).numpy()) for _ in range(steps)]
 
 
+# the pp schedules hit XLA:CPU's SPMD partitioner gap ("UNIMPLEMENTED:
+# PartitionId instruction is not supported for SPMD partitioning");
+# real-TPU runs are unaffected
+_CPU_NO_PARTITION_ID = pytest.mark.skipif(
+    jax.default_backend() == 'cpu',
+    reason='XLA:CPU SPMD partitioner lacks PartitionId (UNIMPLEMENTED); '
+           'runs on TPU')
+
+
 @pytest.mark.parametrize('name,kw', [
-    ('1f1b_pp2', dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
-                      schedule='1F1B', layers=4)),
-    ('gpipe_pp2', dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
-                       schedule='GPipe', layers=4)),
+    pytest.param('1f1b_pp2',
+                 dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
+                      schedule='1F1B', layers=4),
+                 marks=_CPU_NO_PARTITION_ID),
+    pytest.param('gpipe_pp2',
+                 dict(strategy_kwargs={'dp_degree': 4, 'pp_degree': 2},
+                      schedule='GPipe', layers=4),
+                 marks=_CPU_NO_PARTITION_ID),
     ('sp4', dict(strategy_kwargs={'dp_degree': 2, 'sp_degree': 4})),
 ])
 def test_fused_loss_composes_with_schedules(name, kw):

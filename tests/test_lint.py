@@ -52,6 +52,7 @@ CORPUS = [
                               'metric-label-arity': 1}),
     ('bad_span_no_cm.py', {'span-no-cm': 2}),
     ('bad_atomic_write.py', {'atomic-write': 4}),
+    ('bad_event_field.py', {'event-unknown-field': 2}),
 ]
 
 
@@ -171,6 +172,31 @@ def test_metric_baseline_is_two_way(tmp_path):
         [str(f) for f in findings]
 
 
+def test_event_baseline_is_two_way(tmp_path):
+    """Same discipline for the wide-event schema: code->baseline is the
+    fixture corpus; baseline->code is checked with a doctored baseline
+    listing a field no REQUEST_EVENT_FIELDS table declares."""
+    from tools.graftlint.checkers.events import EventsChecker
+    with open(os.path.join(REPO, 'tools/request_event_baseline.json')) as f:
+        baseline = json.load(f)
+    baseline['fields'].append('bogus_field')
+    doctored = tmp_path / 'events.json'
+    doctored.write_text(json.dumps(baseline))
+    project = Project.load(['paddle_tpu'], root=REPO)
+    findings = EventsChecker(baseline_path=str(doctored)).check(project)
+    assert any(f.rule == 'event-stale-field'
+               and f.symbol == 'bogus_field' for f in findings), \
+        [str(f) for f in findings]
+    # the stale check is anchored on the events module: a fixture-only
+    # run must not drown in repo-wide stale noise
+    fixture_only = Project.load([os.path.join(FIXTURES,
+                                              'bad_event_field.py')],
+                                root=REPO)
+    assert all(f.rule != 'event-stale-field'
+               for f in EventsChecker(
+                   baseline_path=str(doctored)).check(fixture_only))
+
+
 def test_baseline_roundtrip(tmp_path):
     findings = _lint([FIXTURES])
     assert findings
@@ -201,7 +227,8 @@ def test_gate_common_convention():
     [sys.executable, 'tools/check_bench_regression.py',
      '--new', os.devnull, '--baseline', os.devnull],
     [sys.executable, '-m', 'tools.graftlint'],
-], ids=['metrics', 'bench', 'graftlint'])
+    [sys.executable, 'tools/request_report.py', '--text', '-'],
+], ids=['metrics', 'bench', 'graftlint', 'request_report'])
 def test_gates_share_nothing_to_check_shape(argv):
     """Every gate speaks the same protocol: empty input -> exit 2 with a
     single {'checked': 0, ...} JSON line."""

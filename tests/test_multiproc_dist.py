@@ -70,6 +70,10 @@ def _dp_train_worker(out_dir):
         f.write('%.8e %.8e' % (float(loss), float(jnp.sum(w1))))
 
 
+@pytest.mark.skipif(
+    os.environ.get('JAX_PLATFORMS', '').startswith('cpu'),
+    reason="jaxlib: \"Multiprocess computations aren't implemented on "
+           'the CPU backend\"; runs on TPU')
 def test_two_process_dp_step_loss_parity(tmp_path):
     spawn_mod.spawn(_dp_train_worker, args=(str(tmp_path),), nprocs=2)
     files = sorted(os.listdir(tmp_path))

@@ -38,11 +38,13 @@ from tools import gate_common  # noqa: E402
 # auxiliary config fields that distinguish otherwise same-env rows
 # (bench_extra rungs vary these, not the knob env). The paged-serving
 # rung adds page_size/spec_k/workload: a spec-on row must never land in
-# a spec-off row's regression bucket.
+# a spec-off row's regression bucket. `tenant` keys the mixed-tenant
+# gateway rung's per-tenant TTFT rows — premium and batch latencies are
+# different contracts and must gate separately.
 _AUX_CONFIG = ('replicas', 'kill_at', 'policy',
                'num_slots', 'new_tokens', 'prompt_len', 'image_size',
                'trace', 'model', 'scan_steps', 'page_size', 'spec_k',
-               'workload')
+               'workload', 'tenant')
 
 __all__ = ['eligible', 'config_key', 'higher_is_better', 'expand_derived',
            'check', 'main']
@@ -93,6 +95,9 @@ def higher_is_better(row):
         return True
     if 'mttr' in text:
         # recovery time: a faster supervisor is a better supervisor
+        return False
+    if 'ttft' in text:
+        # time-to-first-token (incl. the per-tenant columns): latency
         return False
     return not ('ms' in text.split() or 'latency' in text
                 or text.endswith('_ms') or 'compile' in text)

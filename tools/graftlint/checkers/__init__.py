@@ -3,12 +3,14 @@ from .locks import LockChecker
 from .idempotency import IdempotencyChecker
 from .metrics import MetricsChecker
 from .atomic_write import AtomicWriteChecker
+from .events import EventsChecker
 
 __all__ = ['RetraceChecker', 'LockChecker', 'IdempotencyChecker',
-           'MetricsChecker', 'AtomicWriteChecker', 'all_checkers']
+           'MetricsChecker', 'AtomicWriteChecker', 'EventsChecker',
+           'all_checkers']
 
 
 def all_checkers():
     """Fresh instances of every registered checker."""
     return [RetraceChecker(), LockChecker(), IdempotencyChecker(),
-            MetricsChecker(), AtomicWriteChecker()]
+            MetricsChecker(), AtomicWriteChecker(), EventsChecker()]

@@ -1,0 +1,164 @@
+"""Wide-event schema checker.
+
+The committed field list (tools/request_event_baseline.json) is the
+contract wide-event consumers parse against (request_report, the
+/requests route, downstream log pipelines); code and baseline must
+agree BOTH ways:
+
+- event-unknown-field — code declares a field in a REQUEST_EVENT_FIELDS
+  table, or passes a keyword to an event log's ``emit(...)``, that the
+  baseline does not list (a typo'd emission site would otherwise raise
+  only at runtime — and only when that code path runs);
+- event-stale-field   — the baseline lists a field no
+  REQUEST_EVENT_FIELDS table declares any more (only checked when the
+  project includes the events module, so fixture runs don't drown in
+  repo-wide noise).
+
+Emission sites are found by receiver shape, mirroring the metrics
+checker's family tracking: ``emit`` called on a name assigned from
+``RequestLog(...)`` / ``default_request_log()`` / an ``.events``
+attribute, or directly on an ``.events`` attribute.
+"""
+import ast
+import json
+import os
+
+from ..core import Checker, Finding, REPO_ROOT
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, 'tools',
+                                'request_event_baseline.json')
+ANCHOR_MODULE = 'paddle_tpu.monitor.events'
+
+_LOG_MAKERS = ('RequestLog', 'default_request_log')
+
+
+def _declared_fields(module):
+    """[(field, node)] from every REQUEST_EVENT_FIELDS assignment in the
+    module — entries are (name, help) tuples; the name is element 0."""
+    out = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if 'REQUEST_EVENT_FIELDS' not in names:
+            continue
+        if not isinstance(node.value, (ast.Tuple, ast.List)):
+            continue
+        for entry in node.value.elts:
+            if not isinstance(entry, (ast.Tuple, ast.List)) \
+                    or not entry.elts:
+                continue
+            head = entry.elts[0]
+            if isinstance(head, ast.Constant) \
+                    and isinstance(head.value, str):
+                out.append((head.value, entry))
+    return out
+
+
+def _event_receivers(module):
+    """Names bound to a request log within the module: assigned from a
+    RequestLog constructor / default_request_log() / an `.events`
+    attribute (the caching convention every emission site follows)."""
+    recv = set()
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        bound = False
+        if isinstance(v, ast.Call):
+            f = v.func
+            callee = f.id if isinstance(f, ast.Name) else \
+                f.attr if isinstance(f, ast.Attribute) else None
+            bound = callee in _LOG_MAKERS
+        elif isinstance(v, ast.Attribute) and v.attr == 'events':
+            bound = True
+        if not bound:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                recv.add(tgt.id)
+            elif (isinstance(tgt, ast.Attribute)
+                  and isinstance(tgt.value, ast.Name)
+                  and tgt.value.id == 'self'):
+                recv.add('self.' + tgt.attr)
+    return recv
+
+
+def _emit_sites(module):
+    """[(kwargs, node)] for ``<event receiver>.emit(...)`` calls."""
+    recv = _event_receivers(module)
+    sites = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'emit'):
+            continue
+        obj = node.func.value
+        key = None
+        if isinstance(obj, ast.Name):
+            key = obj.id
+        elif isinstance(obj, ast.Attribute):
+            if isinstance(obj.value, ast.Name) and obj.value.id == 'self':
+                key = 'self.' + obj.attr
+            if obj.attr == 'events':
+                key = key if key in recv else '@events'
+        if key == '@events' or key in recv:
+            kwargs = [kw.arg for kw in node.keywords
+                      if kw.arg is not None]
+            sites.append((kwargs, node))
+    return sites
+
+
+class EventsChecker(Checker):
+    name = 'events'
+    RULES = {
+        'event-unknown-field': 'code declares or emits a wide-event '
+                               'field missing from the baseline',
+        'event-stale-field': 'the baseline lists a wide-event field no '
+                             'code declares',
+    }
+
+    def __init__(self, baseline_path=DEFAULT_BASELINE):
+        self.baseline_path = baseline_path
+
+    def _load_baseline(self):
+        if not os.path.exists(self.baseline_path):
+            return None
+        with open(self.baseline_path) as fh:
+            data = json.load(fh)
+        fields = data.get('fields', data) if isinstance(data, dict) \
+            else data
+        return set(fields)
+
+    def check(self, project):
+        out = []
+        baseline = self._load_baseline()
+        if baseline is None:
+            return out
+        rel = os.path.relpath(self.baseline_path, REPO_ROOT)
+        declared = set()
+        for module in project.modules:
+            for field, node in _declared_fields(module):
+                declared.add(field)
+                if field not in baseline:
+                    self.finding(
+                        module, node, 'event-unknown-field',
+                        "wide-event field '%s' is not in %s; update the "
+                        'baseline when the schema change is intentional'
+                        % (field, rel), out)
+            for kwargs, node in _emit_sites(module):
+                for kw in kwargs:
+                    if kw not in baseline:
+                        self.finding(
+                            module, node, 'event-unknown-field',
+                            "emit(...) passes field '%s' which is not "
+                            'in %s; RequestLog.emit would raise at '
+                            'runtime' % (kw, rel), out)
+        if ANCHOR_MODULE in project.by_modname:
+            for field in sorted(baseline - declared):
+                out.append(Finding(
+                    'event-stale-field', rel.replace(os.sep, '/'), 1,
+                    "baseline lists wide-event field '%s' but no "
+                    'REQUEST_EVENT_FIELDS table declares it' % field,
+                    symbol=field))
+        return out

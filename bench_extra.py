@@ -240,11 +240,18 @@ def bench_gpt_decode(on_tpu):
     return rows
 
 
-def _poisson_arrivals(n, mean_gap, seed=0):
-    """Cumulative Poisson-process arrival offsets (seconds), seeded so
-    every run and the sequential baseline replay the same trace."""
-    gaps = np.random.RandomState(seed).exponential(mean_gap, size=n)
-    return np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+def _serving_workload(n_req, lens, mnt, mean_gap, vocab, tenants=None):
+    """The serving rungs' shared workload spec: seeded Poisson arrivals
+    with a prompt-length ladder, expressed in the capacity.workload
+    language. Parameters and RNG streams match the retired hand-rolled
+    generators exactly (capacity.workload pins the parity), so stored
+    bench bests stay comparable; rows carry the spec hash."""
+    from paddle_tpu.capacity import workload
+    return workload.WorkloadSpec(
+        requests=n_req, seed=0, vocab_size=vocab,
+        arrival={'process': 'poisson', 'mean_gap_s': mean_gap},
+        lengths={'dist': 'ladder', 'lens': list(lens)},
+        output={'dist': 'fixed', 'len': mnt}, tenants=tenants)
 
 
 def _perf_fields(eng, t_cold=None, bursts=None, wall=None):
@@ -359,11 +366,10 @@ def bench_serving(on_tpu):
     if on_tpu:
         model.bfloat16()
     model.eval()
-    rng = np.random.RandomState(0)
-    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
-                                            lens[i % len(lens)])]
-               for i in range(n_req)]
-    arrivals = _poisson_arrivals(n_req, mean_gap)
+    spec = _serving_workload(n_req, lens, mnt, mean_gap, cfg.vocab_size)
+    trace = spec.generate()
+    prompts = trace.prompts()
+    arrivals = trace.arrivals()
     rows = []
 
     def run_variant(tag, extra):
@@ -396,6 +402,7 @@ def bench_serving(on_tpu):
                        'speedup_vs_sequential': round(tps / seq_tps, 2),
                        'trace': 'poisson', 'mean_gap_s': mean_gap,
                        'requests': n_req, 'new_tokens': mnt,
+                       'workload_spec': spec.hash,
                        'traces': eng.compiled_sizes(),
                        'degraded': not on_tpu}
             else:
@@ -406,7 +413,8 @@ def bench_serving(on_tpu):
                        'num_slots': num_slots,
                        'occupancy_mean': round(rep['occupancy_mean'], 3),
                        'trace': 'burst', 'requests': n_req,
-                       'new_tokens': mnt, 'degraded': not on_tpu}
+                       'new_tokens': mnt, 'workload_spec': spec.hash,
+                       'degraded': not on_tpu}
             row.update(_perf_fields(eng, t_cold,
                                     eng.timeline.steps - b0,
                                     time.time() - w0))
@@ -487,14 +495,19 @@ def bench_serving_paged(on_tpu):
     if on_tpu:
         model.bfloat16()
     model.eval()
-    rng = np.random.RandomState(0)
-    system = [int(t) for t in rng.randint(0, cfg.vocab_size, sys_len)]
-    prompts = [system + [int(t) for t in rng.randint(
-                   0, cfg.vocab_size, tail_lens[i % len(tail_lens)])]
-               for i in range(n_req)]
-    arrivals = [0.0] * n_req                 # burst: full occupancy
+    from paddle_tpu.capacity import workload
+    spec = workload.WorkloadSpec(
+        requests=n_req, seed=0, vocab_size=cfg.vocab_size,
+        arrival={'process': 'burst'},        # everything at t=0
+        lengths={'dist': 'ladder', 'lens': list(tail_lens)},
+        output={'dist': 'fixed', 'len': mnt},
+        prefix={'len': sys_len, 'groups': 1, 'prob': 1.0})
+    trace = spec.generate()
+    prompts = trace.prompts()
+    arrivals = trace.arrivals()              # burst: full occupancy
     base = {'new_tokens': mnt, 'num_slots': num_seqs, 'page_size': page,
             'workload': 'shared_prefix', 'trace': 'burst',
+            'workload_spec': spec.hash,
             'requests': n_req, 'degraded': not on_tpu}
     rows = []
 
@@ -583,11 +596,10 @@ def bench_serving_gateway(on_tpu):
     if on_tpu:
         model.bfloat16()
     model.eval()
-    rng = np.random.RandomState(0)
-    prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size,
-                                            lens[i % len(lens)])]
-               for i in range(n_req)]
-    arrivals = _poisson_arrivals(n_req, mean_gap)
+    from paddle_tpu.capacity.replay import replay as replay_trace
+    spec = _serving_workload(n_req, lens, mnt, mean_gap, cfg.vocab_size)
+    trace = spec.generate()
+    prompts = trace.prompts()
     replicas, kill_frac = 2, 0.5
 
     def factory():
@@ -604,34 +616,29 @@ def bench_serving_gateway(on_tpu):
         b0 = sum(r.engine.timeline.steps for r in gw.pool)
         gw.start()
         kill_i = None if kill_at is None else int(n_req * kill_at)
-        reqs = []
-        t0 = time.time()
-        for i, (p, arr) in enumerate(zip(prompts, arrivals)):
-            now = time.time() - t0
-            if arr > now:
-                time.sleep(arr - now)
+
+        def maybe_kill(i):
             if kill_i is not None and i == kill_i:
                 gw.kill_replica(1)
-            reqs.append(gw.submit(p, max_new_tokens=mnt))
-        for r in reqs:
-            r.wait(600)
-        dt = time.time() - t0
+
+        res = replay_trace(gw, trace, max_new_tokens=mnt,
+                                     timeout=600,
+                                     before_submit=maybe_kill)
         bursts = sum(r.engine.timeline.steps for r in gw.pool) - b0
         gw.shutdown()
-        toks = sum(len(r.tokens) for r in reqs)
-        completed = sum(1 for r in reqs if r.done)
         failovers = int(reg.get('gateway_failover_total').value())
         # replica 0 always survives the chaos run: its decode program is
         # representative, and bursts summed pool-wide make the MFU an
         # aggregate utilization over the whole gateway
-        perf = _perf_fields(gw.pool[0].engine, t_cold, bursts, dt)
-        return (toks / dt, completed / float(len(reqs)), failovers,
+        perf = _perf_fields(gw.pool[0].engine, t_cold, bursts, res.wall_s)
+        return (res.tokens_per_sec, res.completed_ratio, failovers,
                 gw.report(), perf)
 
     base = {'unit': 'tokens/sec', 'trace': 'poisson',
             'mean_gap_s': mean_gap, 'requests': n_req, 'new_tokens': mnt,
             'num_slots': num_slots, 'replicas': replicas,
-            'policy': 'least_loaded', 'degraded': not on_tpu}
+            'policy': 'least_loaded', 'workload_spec': spec.hash,
+            'degraded': not on_tpu}
     rows = []
     tps, ratio, fo, rep, perf = drive(None)
     rows.append(dict(base, metric='serving_gateway_tokens_per_sec',
@@ -689,16 +696,20 @@ def bench_serving_gateway_tenants(on_tpu):
     if on_tpu:
         model.bfloat16()
     model.eval()
-    rng = np.random.RandomState(0)
+    from paddle_tpu.capacity.replay import replay as replay_trace
     # premium gets the short half of the length ladder, batch the long
     # half — distinguishable TTFT profiles from one workload
-    tenants = ['premium' if i % 2 == 0 else 'batch'
-               for i in range(n_req)]
-    prompts = [[int(t) for t in rng.randint(
-        0, cfg.vocab_size,
-        lens[(i % 2) * (len(lens) // 2) + (i // 2) % (len(lens) // 2)])]
-        for i in range(n_req)]
-    arrivals = _poisson_arrivals(n_req, mean_gap)
+    spec = _serving_workload(
+        n_req, lens, mnt, mean_gap, cfg.vocab_size,
+        tenants={'mode': 'round_robin', 'tenants': [
+            {'name': 'premium',
+             'lengths': {'dist': 'ladder',
+                         'lens': list(lens[:len(lens) // 2])}},
+            {'name': 'batch',
+             'lengths': {'dist': 'ladder',
+                         'lens': list(lens[len(lens) // 2:])}}]})
+    trace = spec.generate()
+    prompts = trace.prompts()
 
     def factory():
         return ContinuousBatchingEngine(
@@ -715,16 +726,9 @@ def bench_serving_gateway_tenants(on_tpu):
         gw.generate(prompts[:2], max_new_tokens=2,
                     tenant='warmup')                          # compile
         gw.start()
-        reqs = []
-        t0 = time.time()
-        for p, arr, ten in zip(prompts, arrivals, tenants):
-            now = time.time() - t0
-            if arr > now:
-                time.sleep(arr - now)
-            reqs.append(gw.submit(p, max_new_tokens=mnt, tenant=ten))
-        for r in reqs:
-            r.wait(600)
-        dt = time.time() - t0
+        res = replay_trace(gw, trace, max_new_tokens=mnt,
+                                     timeout=600)
+        dt = res.wall_s
         gw.shutdown()
         # pool-occupancy integral across the pool; wide-event sum must
         # match it exactly for slot engines (warmup events included —
@@ -734,7 +738,7 @@ def bench_serving_gateway_tenants(on_tpu):
         events = log.events()
     finally:
         set_default_request_log(prev_log)
-    toks = sum(len(r.tokens) for r in reqs)
+    toks = res.tokens
     ev_ps = sum(e['kv_page_seconds'] for e in events)
     kv_by_tenant = {}
     ttft_by_tenant = {}
@@ -747,7 +751,8 @@ def bench_serving_gateway_tenants(on_tpu):
     base = {'trace': 'poisson', 'mean_gap_s': mean_gap,
             'requests': n_req, 'new_tokens': mnt,
             'num_slots': num_slots, 'replicas': 2, 'workload': 'mixed',
-            'policy': 'least_loaded', 'degraded': not on_tpu,
+            'policy': 'least_loaded', 'workload_spec': spec.hash,
+            'degraded': not on_tpu,
             'kv_events_page_seconds': round(ev_ps, 6),
             'kv_pool_page_seconds': round(pool_ps, 6)}
     rows = [dict(base, metric='serving_gateway_mixed_tokens_per_sec',
@@ -829,6 +834,113 @@ def bench_supervisor_recovery(on_tpu):
              'degraded': not on_tpu}]
 
 
+def bench_capacity_calibration(on_tpu):
+    """Capacity-simulator calibration rung (ISSUE 16): replay a small
+    Poisson trace through a real 1-replica in-proc gateway, fit the
+    two-parameter service model from its wide events, re-run the SAME
+    trace through the discrete-event simulator, and report the TTFT
+    divergence (max of p50/p99 relative error — the regression gate
+    checks it LOWER-is-better; K-S statistic rides along as a field).
+
+    A second, ungated-by-measurement row answers the acceptance
+    question directly: a million-request synthetic sweep under a PINNED
+    service model (so the reported minimum-replica answer is
+    deterministic run to run), with the measured model's answer as an
+    informational field.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.capacity import simulator, workload
+    from paddle_tpu.capacity.replay import measure as replay_measure
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        lens, mnt, n_req = (32, 64, 96, 128), 64, 32
+        max_len, chunk, block, num_slots = 256, 32, 8, 8
+        mean_gap = 0.02
+    else:
+        # the bench_serving CPU regime: decode-GEMM-bound, service-bound
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        lens, mnt, n_req = (8, 16, 24, 32), 32, 24
+        max_len, chunk, block, num_slots = 64, 32, 8, 8
+        mean_gap = 0.002
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    spec = _serving_workload(n_req, lens, mnt, mean_gap, cfg.vocab_size)
+    trace = spec.generate()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=num_slots, max_len=max_len,
+            prefill_chunk=chunk, decode_block=block)
+
+    reg = MetricRegistry()
+    real_events, res = replay_measure(
+        factory, trace, replicas=1, max_new_tokens=mnt, registry=reg)
+    fitted = simulator.ServiceModel.from_events(
+        real_events, prefill_chunk=chunk, decode_block=block,
+        num_slots=num_slots, trace=trace, replicas=1)
+    sim = simulator.simulate(trace, fitted, replicas=1,
+                             router='least_loaded', registry=reg)
+    div = simulator.compare_events(sim.to_events(), real_events)['overall']
+    rows = [{'metric': 'capacity_sim_ttft_divergence',
+             'value': round(max(div['p50_rel_err'], div['p99_rel_err']), 4),
+             'unit': 'rel_err', 'trace': 'poisson',
+             'mean_gap_s': mean_gap, 'requests': n_req,
+             'new_tokens': mnt, 'num_slots': num_slots, 'replicas': 1,
+             'workload_spec': spec.hash,
+             'ks': round(div['ks'], 4),
+             'p50_rel_err': round(div['p50_rel_err'], 4),
+             'p99_rel_err': round(div['p99_rel_err'], 4),
+             'sim_p50_ms': round(div['sim_p50_s'] * 1e3, 3),
+             'real_p50_ms': round(div['real_p50_s'] * 1e3, 3),
+             'sim_p99_ms': round(div['sim_p99_s'] * 1e3, 3),
+             'real_p99_ms': round(div['real_p99_s'] * 1e3, 3),
+             'service_model': fitted.to_dict(),
+             'replay_tokens_per_sec': round(res.tokens_per_sec, 2),
+             'degraded': not on_tpu}]
+
+    # million-request sweep under a pinned model: the reported
+    # minimum-replica answer must be deterministic for the gate
+    big = workload.WorkloadSpec(
+        requests=1000000, seed=0,
+        arrival={'process': 'diurnal', 'mean_gap_s': 0.0005,
+                 'period_s': 120.0, 'peak_to_trough': 4.0},
+        lengths={'dist': 'zipf', 'a': 1.8, 'min': 8, 'max': 256},
+        output={'dist': 'lognormal', 'median': 12, 'sigma': 0.5,
+                'min': 1, 'max': 64},
+        tenants={'mode': 'zipf', 'count': 20, 'a': 1.5})
+    pinned = simulator.ServiceModel(0.002, 0.004, prefill_chunk=chunk,
+                                    decode_block=block,
+                                    num_slots=num_slots)
+    sweep = simulator.sweep_replicas(big.generate(), pinned,
+                                     counts=(8, 16, 32), slo_ttft_s=0.25)
+    measured_min = simulator.sweep_replicas(
+        trace, fitted, counts=(1, 2, 4),
+        slo_ttft_s=10 * div['real_p99_s'])['min_replicas']
+    rows.append({'metric': 'capacity_sweep_min_replicas',
+                 'value': sweep['min_replicas'], 'unit': 'replicas',
+                 'requests': sweep['requests'],
+                 'slo_ttft_s': sweep['slo_ttft_s'],
+                 'workload_spec': big.hash,
+                 'sweep_points': sweep['points'],
+                 'sweep_wall_s': round(sum(p['sim_wall_s']
+                                           for p in sweep['points']), 3),
+                 'measured_model_min_replicas': measured_min,
+                 'service_model': pinned.to_dict(),
+                 'degraded': not on_tpu})
+    return rows
+
+
 def main():
     try:
         _enable_cache()
@@ -837,7 +949,8 @@ def main():
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
-               bench_serving_gateway_tenants, bench_supervisor_recovery):
+               bench_serving_gateway_tenants, bench_supervisor_recovery,
+               bench_capacity_calibration):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

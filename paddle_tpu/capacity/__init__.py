@@ -1,0 +1,34 @@
+"""Capacity planning: workload language, trace replay, fleet simulator.
+
+One `Trace` form, three doors:
+
+- `workload`  — declarative, seeded trace generation (Poisson/diurnal
+  arrivals, heavy-tailed lengths, tenant skew, shared prefixes) and
+  loaders for recorded wide-event JSONL. Same spec + same seed ==
+  byte-identical trace.
+- `replay`    — open-loop, arrival-faithful replay of a Trace against
+  the real ServingGateway; the single arrival generator behind the
+  serving bench rungs.
+- `simulator` — discrete-event gateway+replicas simulation with a
+  calibrated two-parameter service model; validates against replayed
+  runs by TTFT-distribution divergence, then sweeps replica counts at
+  million-request scale in seconds.
+
+This package imports numpy and the stdlib-only monitor/ layer eagerly;
+jax-backed serving machinery loads only inside replay's functions.
+"""
+from .replay import ReplayResult, measure, replay
+from .simulator import (ServiceModel, SimResult, compare_events,
+                        ks_statistic, min_replicas_for, simulate,
+                        sweep_replicas, ttft_divergence, ttfts_of_events)
+from .workload import (Trace, WorkloadSpec, generate, load_trace,
+                       poisson_arrivals, trace_from_events)
+
+__all__ = [
+    'Trace', 'WorkloadSpec', 'generate', 'load_trace',
+    'poisson_arrivals', 'trace_from_events',
+    'ReplayResult', 'replay', 'measure',
+    'ServiceModel', 'SimResult', 'simulate', 'sweep_replicas',
+    'min_replicas_for', 'ks_statistic', 'ttft_divergence',
+    'compare_events', 'ttfts_of_events',
+]

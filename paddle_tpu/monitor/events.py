@@ -164,9 +164,13 @@ class RequestLog:
         self._m_rotations.inc()
 
     def events(self, tenant=None, outcome=None, min_failovers=None,
-               limit=None):
+               since_ts=None, until_ts=None, limit=None):
         """Snapshot of the ring (oldest first), optionally filtered.
-        ``limit`` keeps the newest N after filtering."""
+        ``since_ts``/``until_ts`` select the half-open arrival-time
+        window [since, until) in the log's own clock (the gateway's
+        monotonic timestamps) — how the capacity replay loader slices
+        one run out of a longer recording. ``limit`` keeps the newest N
+        after filtering."""
         with self._lock:
             out = list(self._ring)
         if tenant is not None:
@@ -176,6 +180,16 @@ class RequestLog:
         if min_failovers is not None:
             out = [e for e in out
                    if (e['failovers'] or 0) >= min_failovers]
+        if since_ts is not None:
+            since_ts = float(since_ts)
+            out = [e for e in out
+                   if e['arrival_t'] is not None
+                   and e['arrival_t'] >= since_ts]
+        if until_ts is not None:
+            until_ts = float(until_ts)
+            out = [e for e in out
+                   if e['arrival_t'] is not None
+                   and e['arrival_t'] < until_ts]
         if limit is not None and limit >= 0:
             out = out[-limit:]
         return out

@@ -7,8 +7,9 @@ liveness body on /healthz, the tracer's flight-recorder ring on
 federated fleet view on /fleet (?scrape=1 to force a cycle, ?format=prom
 for text exposition of the merge), alert state on /alerts when a
 FleetCollector / AlertManager is attached, and the wide-event request
-log on /requests (?tenant= / ?outcome= / ?min_failovers= / ?limit=
-filters) when a RequestLog is attached, 404 elsewhere. HEAD is
+log on /requests (?tenant= / ?outcome= / ?min_failovers= /
+?since_ts= / ?until_ts= / ?limit= filters) when a RequestLog is
+attached, 404 elsewhere. HEAD is
 answered on every route (load-balancer probes use it and must not see
 http.server's default 501). Ephemeral-port by default so tests and
 multi-engine processes never collide; `.port`/`.url` report the bound
@@ -98,10 +99,13 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 evs = log.events(tenant=_one('tenant'),
                                  outcome=_one('outcome'),
                                  min_failovers=_one('min_failovers', int),
+                                 since_ts=_one('since_ts', float),
+                                 until_ts=_one('until_ts', float),
                                  limit=_one('limit', int))
             except ValueError:
                 return (400, 'text/plain; charset=utf-8',
-                        b'min_failovers/limit must be integers\n')
+                        b'min_failovers/limit must be integers and '
+                        b'since_ts/until_ts floats\n')
             body = json.dumps({'count': len(evs),
                                'dropped': log.dropped,
                                'events': evs}).encode()

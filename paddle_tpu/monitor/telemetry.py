@@ -18,7 +18,7 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_train_loop_schema', 'record_fleet_schema',
            'record_alert_schema', 'record_supervisor_schema',
            'record_request_event_schema', 'record_tenant_schema',
-           'snapshot_line',
+           'record_capacity_schema', 'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -490,6 +490,45 @@ def record_tenant_schema(registry):
     return out
 
 
+# the capacity-planning families (paddle_tpu/capacity/): trace replay
+# against the real gateway plus the discrete-event fleet simulator.
+# Single-source rule: replay.replay/simulator.simulate and the schema
+# baseline all register through record_capacity_schema. Unlabeled —
+# per-request and per-tenant detail lives in the wide events the runs
+# emit, never in labels.
+CAPACITY_FAMILIES = (
+    ('counter', 'capacity_requests_replayed_total',
+     'trace requests submitted by the open-loop replay harness'),
+    ('counter', 'capacity_replay_runs_total',
+     'completed open-loop trace replays'),
+    ('histogram', 'capacity_replay_lag_seconds',
+     'worst submit-behind-schedule lag per replay run'),
+    ('counter', 'sim_requests_total',
+     'requests pushed through the discrete-event fleet simulator'),
+    ('counter', 'sim_runs_total',
+     'completed fleet-simulator runs'),
+    ('gauge', 'sim_last_p99_ttft_seconds',
+     'p99 simulated TTFT of the most recent simulator run'),
+)
+
+
+def record_capacity_schema(registry):
+    """Register the capacity-planning families on `registry` and return
+    {name: family}. Used by capacity.replay / capacity.simulate when
+    handed a registry and by dryrun_registry so the committed baseline
+    covers capacity planning."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc in CAPACITY_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # replay lag spans scheduler jitter (~ms) to a saturated
+            # submitter falling a full trace behind (~minutes)
+            kw['buckets'] = exponential_buckets(0.001, 2.0, 18)
+        out[name] = getattr(registry, kind)(name, doc, **kw)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -512,6 +551,7 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_supervisor_schema(reg)
     record_request_event_schema(reg)
     record_tenant_schema(reg)
+    record_capacity_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

@@ -151,6 +151,33 @@ def test_supervisor_mttr_gates_lower_is_better():
     assert gate.check(faster, best) == []
 
 
+def test_capacity_divergence_gates_lower_is_better():
+    """bench_capacity_calibration's rows regress UP: a simulator whose
+    TTFT distribution drifts further from the measured gateway
+    (capacity_sim_ttft_divergence, rel_err) is a worse simulator, and a
+    sweep that suddenly needs more replicas for the same pinned service
+    model (capacity_sweep_min_replicas) is a capacity regression."""
+    div = {'metric': 'capacity_sim_ttft_divergence', 'unit': 'rel_err',
+           'value': 0.3}
+    assert not gate.higher_is_better(div)
+    best = [dict(div, platform='tpu', degraded=False)]
+    worse = [dict(div, value=0.6, platform='tpu', degraded=False)]
+    findings = gate.check(worse, best)
+    assert len(findings) == 1 and findings[0]['direction'] == 'up'
+    better = [dict(div, value=0.1, platform='tpu', degraded=False)]
+    assert gate.check(better, best) == []
+
+    rep = {'metric': 'capacity_sweep_min_replicas', 'unit': 'replicas',
+           'value': 16}
+    assert not gate.higher_is_better(rep)
+    best = [dict(rep, platform='tpu', degraded=False)]
+    more = [dict(rep, value=32, platform='tpu', degraded=False)]
+    findings = gate.check(more, best)
+    assert len(findings) == 1 and findings[0]['direction'] == 'up'
+    fewer = [dict(rep, value=8, platform='tpu', degraded=False)]
+    assert gate.check(fewer, best) == []
+
+
 def test_trust_degraded_admits_cpu_rows():
     """The compile-cache rungs are measured on CPU: invisible to the
     default gate (they must never displace real-TPU bests), gated

@@ -138,6 +138,31 @@ def test_event_filters():
         == ['b']
 
 
+def test_event_time_range_filters_are_half_open():
+    log = RequestLog(capacity=16, registry=MetricRegistry())
+    for i, t in enumerate((10.0, 20.0, 30.0)):
+        log.emit(**_ev(request_id='t%d' % i, arrival_t=t))
+    log.emit(**_ev(request_id='noarr', arrival_t=None))
+    assert [e['request_id'] for e in log.events(since_ts=20.0)] \
+        == ['t1', 't2']
+    # [since, until): the until bound is exclusive
+    assert [e['request_id'] for e in log.events(until_ts=20.0)] == ['t0']
+    assert [e['request_id']
+            for e in log.events(since_ts=10.0, until_ts=30.0)] \
+        == ['t0', 't1']
+    # string values coerce (the HTTP route's path), garbage raises
+    assert [e['request_id'] for e in log.events(since_ts='25')] == ['t2']
+    with pytest.raises(ValueError):
+        log.events(since_ts='zap')
+    # events that never entered the system carry no arrival_t and never
+    # match a time window
+    assert all(e['request_id'] != 'noarr'
+               for e in log.events(since_ts=0.0))
+    # composes with the other filters
+    assert [e['request_id']
+            for e in log.events(since_ts=10.0, limit=1)] == ['t2']
+
+
 def test_concurrent_emit_is_safe():
     reg = MetricRegistry()
     log = RequestLog(capacity=4096, registry=reg)
@@ -487,3 +512,26 @@ def test_requests_route_serves_and_filters():
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(srv.url + '/requests', timeout=5)
         assert ei.value.code == 404
+
+
+def test_requests_route_time_range_filters():
+    log = RequestLog(capacity=16, registry=MetricRegistry())
+    for i, t in enumerate((10.0, 20.0, 30.0)):
+        log.emit(**_ev(request_id='t%d' % i, arrival_t=t))
+    with MetricsServer(registry=MetricRegistry(), events=log) as srv:
+        def get(qs=''):
+            body = urllib.request.urlopen(
+                srv.url + '/requests' + qs, timeout=5).read().decode()
+            return json.loads(body)
+        assert [e['request_id'] for e in get('?since_ts=20')['events']] \
+            == ['t1', 't2']
+        assert [e['request_id'] for e in get('?until_ts=20')['events']] \
+            == ['t0']
+        got = get('?since_ts=10&until_ts=30')
+        assert [e['request_id'] for e in got['events']] == ['t0', 't1']
+        assert get('?since_ts=20.5&tenant=t')['count'] == 1
+        for bad in ('?since_ts=zap', '?until_ts=1e'):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + '/requests' + bad,
+                                       timeout=5)
+            assert ei.value.code == 400

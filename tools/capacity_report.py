@@ -1,0 +1,199 @@
+"""Capacity-planning report: join a trace, a simulator run, and
+(optionally) a real replay's wide events; gate on sim-vs-real TTFT
+divergence.
+
+Inputs (all offline — no jax, no gateway):
+
+    --trace FILE        a Trace JSONL (workload.Trace.to_jsonl), a
+                        recorded RequestLog sink, or captured dryrun
+                        request_event lines — anything
+                        capacity.workload.load_trace ingests;
+    --spec FILE / --spec-inline JSON
+                        a WorkloadSpec to generate the trace from
+                        (deterministic: same spec+seed, same trace);
+    --real FILE         wide-event JSONL of a real run of the SAME
+                        trace (a RequestLog sink), repeatable;
+    --sim FILE          wide-event JSONL of a simulator run
+                        (SimResult.to_events dumped one per line),
+                        repeatable. When absent and a trace is given,
+                        --simulate runs the discrete-event simulator
+                        here, with --prefill-chunk-s/--decode-burst-s
+                        or --calibrate (fit the service model from the
+                        --real events, then simulate).
+
+Report: overall + per-tenant TTFT p50/p99 sim-vs-real divergence
+(K-S statistic, relative errors), the simulator summary, and — with
+--sweep — the replica-count sweep and its minimum-replica answer for
+--slo-ms.
+
+Gate (tools/gate_common protocol, like check_bench_regression): a
+sim-vs-real comparison whose p50 or p99 relative error exceeds
+--max-p50-err/--max-p99-err (or K-S over --max-ks, when given) is a
+finding -> exit 1. No inputs -> exit 2; otherwise 0 with a summary.
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# capacity/ and monitor/ avoid jax at import time, but the paddle_tpu
+# package __init__ pulls it in: load the subpackages without executing
+# the parent (request_report's pattern).
+if 'paddle_tpu' not in sys.modules:
+    _pkg = types.ModuleType('paddle_tpu')
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, 'paddle_tpu')]
+    sys.modules['paddle_tpu'] = _pkg
+
+from paddle_tpu.capacity import simulator, workload  # noqa: E402
+from tools import gate_common  # noqa: E402
+from tools.request_report import load_events  # noqa: E402
+
+__all__ = ['check_divergence', 'main']
+
+
+def check_divergence(cmp, max_p50_err, max_p99_err, max_ks=None):
+    """Pure gate over compare_events() output: findings (empty == pass).
+    Per-tenant entries marked 'skipped' never gate — small samples make
+    percentile error meaningless."""
+    findings = []
+    rows = [('overall', cmp['overall'])]
+    rows += sorted(cmp.get('tenants', {}).items())
+    for name, div in rows:
+        if 'skipped' in div:
+            continue
+        over = []
+        if div['p50_rel_err'] > max_p50_err:
+            over.append(('p50_rel_err', div['p50_rel_err'], max_p50_err))
+        if div['p99_rel_err'] > max_p99_err:
+            over.append(('p99_rel_err', div['p99_rel_err'], max_p99_err))
+        if max_ks is not None and div['ks'] > max_ks:
+            over.append(('ks', div['ks'], max_ks))
+        for what, got, limit in over:
+            findings.append({'problem': 'ttft_divergence', 'scope': name,
+                             'stat': what, 'value': round(got, 4),
+                             'threshold': limit,
+                             'sim_p50_s': div['sim_p50_s'],
+                             'real_p50_s': div['real_p50_s'],
+                             'sim_p99_s': div['sim_p99_s'],
+                             'real_p99_s': div['real_p99_s']})
+    return findings
+
+
+def _load_trace(args):
+    if args.spec_inline:
+        return workload.generate(
+            workload.WorkloadSpec.from_dict(json.loads(args.spec_inline)))
+    if args.spec:
+        with open(args.spec) as f:
+            return workload.generate(
+                workload.WorkloadSpec.from_dict(json.load(f)))
+    if args.trace:
+        return workload.load_trace(path=args.trace)
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--trace', help='trace JSONL / recorded wide events')
+    ap.add_argument('--spec', help='WorkloadSpec JSON file to generate')
+    ap.add_argument('--spec-inline', help='WorkloadSpec JSON literal')
+    ap.add_argument('--real', action='append', default=[],
+                    help='real run wide-event JSONL (repeatable)')
+    ap.add_argument('--sim', action='append', default=[],
+                    help='simulated run wide-event JSONL (repeatable)')
+    ap.add_argument('--simulate', action='store_true',
+                    help='run the simulator on the trace here')
+    ap.add_argument('--calibrate', action='store_true',
+                    help='fit the service model from --real events '
+                         '(implies --simulate)')
+    ap.add_argument('--prefill-chunk-s', type=float, default=0.002)
+    ap.add_argument('--decode-burst-s', type=float, default=0.004)
+    ap.add_argument('--prefill-chunk', type=int, default=32)
+    ap.add_argument('--decode-block', type=int, default=8)
+    ap.add_argument('--num-slots', type=int, default=8)
+    ap.add_argument('--replicas', type=int, default=1,
+                    help='simulated replica count (default %(default)s)')
+    ap.add_argument('--router', default='least_loaded',
+                    choices=('least_loaded', 'round_robin'))
+    ap.add_argument('--sweep', help='comma list of replica counts to '
+                                    'sweep, e.g. 1,2,4,8')
+    ap.add_argument('--slo-ms', type=float, default=1000.0,
+                    help='TTFT SLO for the sweep (default %(default)s)')
+    ap.add_argument('--percentile', type=float, default=99.0,
+                    help='sweep tail percentile (default %(default)s)')
+    ap.add_argument('--max-p50-err', type=float, default=0.5,
+                    help='gate: max sim-vs-real TTFT p50 relative error')
+    ap.add_argument('--max-p99-err', type=float, default=0.5,
+                    help='gate: max sim-vs-real TTFT p99 relative error')
+    ap.add_argument('--max-ks', type=float, default=None,
+                    help='gate: max K-S statistic (ungated by default '
+                         '— CI timing noise shifts whole distributions)')
+    args = ap.parse_args(argv)
+
+    trace = _load_trace(args)
+    real_events, skipped = load_events(args.real, ())
+    sim_events, s2 = load_events(args.sim, ())
+    skipped += s2
+
+    summary = {'skipped_lines': skipped}
+    if trace is not None:
+        summary['trace'] = {'requests': len(trace),
+                            'duration_s': round(trace.duration_s, 3),
+                            'spec_hash': trace.spec_hash,
+                            'tenants': trace.tenant_mix()}
+
+    if (args.simulate or args.calibrate or args.sweep) and trace is None:
+        return gate_common.nothing_to_check(
+            'simulation requested but no trace/spec given')
+
+    model = None
+    if args.calibrate:
+        if not real_events:
+            return gate_common.nothing_to_check(
+                '--calibrate needs --real events to fit from')
+        model = simulator.ServiceModel.from_events(
+            real_events, prefill_chunk=args.prefill_chunk,
+            decode_block=args.decode_block, num_slots=args.num_slots,
+            trace=trace, replicas=args.replicas, router=args.router)
+    elif args.simulate or args.sweep:
+        model = simulator.ServiceModel(
+            args.prefill_chunk_s, args.decode_burst_s,
+            prefill_chunk=args.prefill_chunk,
+            decode_block=args.decode_block, num_slots=args.num_slots)
+    if model is not None:
+        summary['service_model'] = model.to_dict()
+
+    if (args.simulate or args.calibrate) and not sim_events:
+        res = simulator.simulate(trace, model, replicas=args.replicas,
+                                 router=args.router)
+        summary['sim'] = res.summary(slo_ttft_s=args.slo_ms / 1e3)
+        sim_events = res.to_events()
+
+    if args.sweep:
+        counts = [int(c) for c in args.sweep.split(',') if c.strip()]
+        summary['sweep'] = simulator.sweep_replicas(
+            trace, model, counts=counts, slo_ttft_s=args.slo_ms / 1e3,
+            percentile=args.percentile)
+
+    findings = []
+    if sim_events and real_events:
+        cmp = simulator.compare_events(sim_events, real_events)
+        summary['divergence'] = cmp
+        findings = check_divergence(cmp, args.max_p50_err,
+                                    args.max_p99_err, max_ks=args.max_ks)
+    elif not sim_events and not real_events and 'sweep' not in summary:
+        return gate_common.nothing_to_check(
+            'no simulated or real events to compare '
+            '(give --trace/--spec with --simulate, or --sim/--real '
+            'files)')
+
+    return gate_common.finish(findings, summary)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

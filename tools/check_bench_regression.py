@@ -99,6 +99,13 @@ def higher_is_better(row):
     if 'ttft' in text:
         # time-to-first-token (incl. the per-tenant columns): latency
         return False
+    if 'divergence' in text or 'rel_err' in text:
+        # sim-vs-real calibration error (capacity_sim_ttft_divergence):
+        # a better-calibrated simulator diverges LESS
+        return False
+    if 'min_replicas' in text:
+        # capacity answer: fewer replicas for the same SLO is better
+        return False
     return not ('ms' in text.split() or 'latency' in text
                 or text.endswith('_ms') or 'compile' in text)
 

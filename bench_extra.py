@@ -770,6 +770,143 @@ def bench_serving_gateway_tenants(on_tpu):
     return rows
 
 
+def bench_serving_gateway_qos(on_tpu):
+    """Overload-QoS rung (ISSUE 17): a mixed-tenant burst through a
+    2-replica gateway behind the admission layer — 'premium' (priority
+    1, unthrottled) vs 'bg' (token-bucket rate-limited, priority 0) —
+    where the BACKGROUND arrival rate DOUBLES halfway through the run
+    (a second bg-only trace overlaid from the midpoint). Graceful
+    degradation is the claim: the gateway sheds background traffic
+    (outcome='rejected' wide events) while every premium request
+    completes (asserted == 1.0 inline) and the premium TTFT tail stays
+    bounded.
+
+    Rows for the regression gate: premium TTFT p99 (ms,
+    lower-is-better), shed rate (ratio, lower-is-better — a regression
+    here means the policy started over-shedding the same workload), and
+    the premium completed ratio (ratio, higher-is-better)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.capacity.replay import replay as replay_trace
+    from paddle_tpu.capacity.workload import Trace
+    from paddle_tpu.monitor.events import (RequestLog,
+                                           set_default_request_log)
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import (ContinuousBatchingEngine, QosPolicy,
+                                    ServingGateway, TenantClass)
+    from paddle_tpu.serving.metrics import percentile
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        lens, mnt, n_req = (32, 64, 96, 128), 64, 32
+        max_len, chunk, block, num_slots = 256, 32, 8, 8
+        mean_gap, bg_rate, slo_ms = 0.02, 30.0, 2000.0
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=4,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        lens, mnt, n_req = (8, 16, 24, 32), 32, 24
+        max_len, chunk, block, num_slots = 64, 32, 8, 8
+        mean_gap, bg_rate, slo_ms = 0.002, 300.0, 5000.0
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.bfloat16()
+    model.eval()
+    # steady half: premium + bg round-robin; burst half: a bg-only
+    # trace at the SAME per-request gap overlaid from the midpoint, so
+    # the background arrival rate doubles while premium's is unchanged
+    spec = _serving_workload(
+        n_req, lens, mnt, mean_gap, cfg.vocab_size,
+        tenants={'mode': 'round_robin', 'tenants': [
+            {'name': 'premium'}, {'name': 'bg'}]})
+    burst_spec = _serving_workload(
+        n_req // 2, lens, mnt, mean_gap, cfg.vocab_size,
+        tenants={'mode': 'round_robin', 'tenants': [{'name': 'bg'}]})
+    a, b = spec.generate(), burst_spec.generate()
+    t_mid = float(a.arrival[-1]) * 0.5
+    bg_id = a.tenant_names.index('bg')
+    arr = np.concatenate([a.arrival, b.arrival + t_mid])
+    order = np.argsort(arr, kind='stable')
+    trace = Trace(
+        arr[order],
+        np.concatenate([a.prompt_len, b.prompt_len])[order],
+        np.concatenate([a.new_tokens, b.new_tokens])[order],
+        np.concatenate([a.tenant_id,
+                        np.full(len(b), bg_id, np.int64)])[order],
+        a.tenant_names,
+        np.full(len(order), -1, np.int64),
+        np.zeros(len(order), np.int64),
+        meta={'vocab_size': cfg.vocab_size, 'spec': {'seed': 0}})
+    prompts = trace.prompts()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=num_slots, max_len=max_len,
+            prefill_chunk=chunk, decode_block=block)
+
+    def policy():
+        return QosPolicy(classes=[
+            TenantClass('premium', priority=1),
+            TenantClass('bg', rate=bg_rate, burst=max(4, num_slots),
+                        priority=0)])
+
+    log = RequestLog(capacity=4 * len(trace))
+    prev_log = set_default_request_log(log)
+    try:
+        reg = MetricRegistry()
+        gw = ServingGateway(factory, replicas=2, admission=policy(),
+                            registry=reg)
+        gw.generate(prompts[:2], max_new_tokens=2,
+                    tenant='warmup')                          # compile
+        gw.start()
+        res = replay_trace(gw, trace, max_new_tokens=mnt, timeout=600)
+        gw.shutdown()
+        events = [e for e in log.events() if e['tenant'] != 'warmup']
+    finally:
+        set_default_request_log(prev_log)
+    tenants = trace.tenants()
+    premium = [h for h, t in zip(res.handles, tenants) if t == 'premium']
+    shed = sum(1 for h in res.handles if h.error is not None)
+    shed_rate = shed / float(len(res.handles))
+    prem_done = sum(1 for h in premium if h.done and h.error is None)
+    prem_ratio = prem_done / float(len(premium))
+    if prem_ratio != 1.0:
+        raise AssertionError(
+            'premium completed_ratio %.4f != 1.0 under background burst'
+            % prem_ratio)
+    prem_ttft = [(e['first_token_t'] - e['arrival_t']) * 1e3
+                 for e in events
+                 if e['tenant'] == 'premium'
+                 and e['first_token_t'] is not None]
+    p99 = percentile(prem_ttft, 99) or 0.0
+    rejected_events = sum(1 for e in events if e['outcome'] == 'rejected')
+    if rejected_events != shed:
+        raise AssertionError(
+            'rejected wide events (%d) != shed handles (%d)'
+            % (rejected_events, shed))
+    base = {'trace': 'poisson+bg_burst', 'mean_gap_s': mean_gap,
+            'requests': len(trace), 'new_tokens': mnt,
+            'num_slots': num_slots, 'replicas': 2,
+            'policy': 'least_loaded', 'bg_rate': bg_rate,
+            'bg_doubles_at_s': round(t_mid, 4),
+            'workload_spec': spec.hash, 'burst_spec': burst_spec.hash,
+            'degraded': not on_tpu}
+    return [
+        dict(base, metric='serving_gateway_qos_premium_ttft_p99',
+             value=round(p99, 3), unit='ms', slo_ttft_ms=slo_ms,
+             slo_ok=bool(p99 <= slo_ms),
+             premium_requests=len(premium)),
+        dict(base, metric='serving_gateway_qos_shed_rate',
+             value=round(shed_rate, 4), unit='ratio', shed=shed),
+        dict(base, metric='serving_gateway_qos_premium_completed_ratio',
+             value=round(prem_ratio, 4), unit='ratio',
+             premium_requests=len(premium)),
+    ]
+
+
 def bench_supervisor_recovery(on_tpu):
     """Elastic-supervisor MTTR rung (ISSUE 14): a journaled PS shard is
     snapshotted, hard-killed, and recovered by the ShardSupervisor
@@ -949,8 +1086,8 @@ def main():
     on_tpu = _platform() == 'tpu'
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
-               bench_serving_gateway_tenants, bench_supervisor_recovery,
-               bench_capacity_calibration):
+               bench_serving_gateway_tenants, bench_serving_gateway_qos,
+               bench_supervisor_recovery, bench_capacity_calibration):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

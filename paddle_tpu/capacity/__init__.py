@@ -17,10 +17,12 @@ One `Trace` form, three doors:
 This package imports numpy and the stdlib-only monitor/ layer eagerly;
 jax-backed serving machinery loads only inside replay's functions.
 """
+from .qos import QosPolicy, TenantClass, TokenBucket
 from .replay import ReplayResult, measure, replay
 from .simulator import (ServiceModel, SimResult, compare_events,
                         ks_statistic, min_replicas_for, simulate,
-                        sweep_replicas, ttft_divergence, ttfts_of_events)
+                        sweep_qos, sweep_replicas, ttft_divergence,
+                        ttfts_of_events)
 from .workload import (Trace, WorkloadSpec, generate, load_trace,
                        poisson_arrivals, trace_from_events)
 
@@ -28,7 +30,8 @@ __all__ = [
     'Trace', 'WorkloadSpec', 'generate', 'load_trace',
     'poisson_arrivals', 'trace_from_events',
     'ReplayResult', 'replay', 'measure',
+    'QosPolicy', 'TenantClass', 'TokenBucket',
     'ServiceModel', 'SimResult', 'simulate', 'sweep_replicas',
-    'min_replicas_for', 'ks_statistic', 'ttft_divergence',
+    'sweep_qos', 'min_replicas_for', 'ks_statistic', 'ttft_divergence',
     'compare_events', 'ttfts_of_events',
 ]

@@ -101,7 +101,9 @@ def replay(gateway, trace, speed=1.0, max_new_tokens=None, seed=0,
         h.wait(timeout)
     wall = time.monotonic() - t0
     tokens = sum(len(h.tokens) for h in handles)
-    completed = sum(1 for h in handles if h.done)
+    # done-with-error handles are shed/failed requests (an admission
+    # reject finishes instantly) — they must not inflate completed
+    completed = sum(1 for h in handles if h.done and h.error is None)
     if fams is not None:
         fams['capacity_requests_replayed_total'].inc(len(handles))
         fams['capacity_replay_runs_total'].inc()

@@ -30,11 +30,13 @@ counts — a million-request sweep completes in seconds on CPU because
 the per-step inner loop is O(num_slots) plain-int work and traces stay
 columnar (no prompts, no per-token events).
 """
+import heapq
+
 import numpy as np
 
 __all__ = ['ServiceModel', 'SimResult', 'simulate', 'sweep_replicas',
-           'ks_statistic', 'ttft_divergence', 'compare_events',
-           'ttfts_of_events']
+           'sweep_qos', 'ks_statistic', 'ttft_divergence',
+           'compare_events', 'ttfts_of_events']
 
 
 class ServiceModel:
@@ -157,7 +159,7 @@ class _Replica:
     `advance` is ONE engine step."""
 
     __slots__ = ('t', 'queue', 'active', 'slots', 'seen_prefix', 'alive',
-                 'draining', 'outstanding', 'busy_slot_s')
+                 'draining', 'outstanding', 'busy_slot_s', 'ready')
 
     def __init__(self, t0, slots):
         self.t = float(t0)
@@ -169,13 +171,19 @@ class _Replica:
         self.draining = False
         self.outstanding = 0
         self.busy_slot_s = 0.0
+        self.ready = []          # QoS staging heap: (-priority, req_idx)
 
 
 class SimResult:
-    """Columnar per-request outcomes of one simulation."""
+    """Columnar per-request outcomes of one simulation.
+
+    `outcome` / `priority` / `reject_reason` columns are present only
+    for QoS runs (simulate(..., qos=...)); without a policy they are
+    None and every request is implicitly admitted ('ok')."""
 
     def __init__(self, trace, admit, first, finish, failovers, replica_of,
-                 prefix_hits, chunks, replica_timeline, wall_s):
+                 prefix_hits, chunks, replica_timeline, wall_s,
+                 outcome=None, priority=None, reject_reason=None):
         self.trace = trace
         self.admit = admit
         self.first = first
@@ -186,6 +194,9 @@ class SimResult:
         self.chunks = chunks
         self.replica_timeline = replica_timeline   # [(sim_t, n_alive)]
         self.wall_s = wall_s                       # host seconds to run
+        self.outcome = outcome                     # 'ok' | 'rejected'
+        self.priority = priority
+        self.reject_reason = reject_reason
 
     def __len__(self):
         return len(self.trace)
@@ -194,15 +205,38 @@ class SimResult:
     def max_replicas(self):
         return max(n for _, n in self.replica_timeline)
 
+    def ok_mask(self):
+        """Admitted requests — the ones latency statistics make sense
+        for (a shed request never produced a token)."""
+        if self.outcome is None:
+            return np.ones(len(self), dtype=bool)
+        return self.outcome == 'ok'
+
     def ttft(self):
-        return self.first - self.trace.arrival
+        return (self.first - self.trace.arrival)[self.ok_mask()]
 
     def queue_wait(self):
-        return self.admit - self.trace.arrival
+        return (self.admit - self.trace.arrival)[self.ok_mask()]
 
     def ttft_percentiles(self, qs=(50, 99)):
         t = self.ttft()
         return {q: float(np.percentile(t, q)) for q in qs}
+
+    def ttft_percentiles_by_priority(self, qs=(50, 99)):
+        """{priority: {q: ttft}} over admitted requests — the graceful-
+        degradation read: premium classes should hold their tail while
+        the background class absorbs the shedding."""
+        if self.priority is None:
+            return {0: self.ttft_percentiles(qs)}
+        t = self.first - self.trace.arrival
+        m = self.ok_mask()
+        out = {}
+        for p in sorted(set(int(x) for x in self.priority)):
+            mask = m & (self.priority == p)
+            if mask.any():
+                out[int(p)] = {q: float(np.percentile(t[mask], q))
+                               for q in qs}
+        return out
 
     def summary(self, slo_ttft_s=None):
         p = self.ttft_percentiles((50, 90, 99))
@@ -215,6 +249,10 @@ class SimResult:
                                                        99)),
                'failovers': int(self.failovers.sum()),
                'prefix_hit_requests': int(self.prefix_hits.sum())}
+        if self.outcome is not None:
+            rej = int((self.outcome == 'rejected').sum())
+            out['rejected'] = rej
+            out['shed_rate'] = rej / float(len(self))
         if slo_ttft_s is not None:
             out['slo_ttft_s'] = float(slo_ttft_s)
             out['slo_ok'] = bool(p[99] <= slo_ttft_s)
@@ -229,25 +267,34 @@ class SimResult:
         names = tr.tenant_names
         out = []
         for i in range(len(tr)):
+            shed = (self.outcome is not None
+                    and self.outcome[i] == 'rejected')
             out.append({
                 'request_id': 'sim-%d' % i,
                 'tenant': names[tr.tenant_id[i]],
+                'priority': (int(self.priority[i])
+                             if self.priority is not None else 0),
                 'trace_id': None,
                 'arrival_t': float(tr.arrival[i]),
-                'admit_t': float(self.admit[i]),
-                'first_token_t': float(self.first[i]),
+                # a shed request never reached a replica: no admit, no
+                # first token (ttfts_of_events skips the Nones)
+                'admit_t': None if shed else float(self.admit[i]),
+                'first_token_t': None if shed else float(self.first[i]),
                 'finish_t': float(self.finish[i]),
-                'queue_wait_s': float(self.admit[i] - tr.arrival[i]),
+                'queue_wait_s': (0.0 if shed else
+                                 float(self.admit[i] - tr.arrival[i])),
                 'prefill_chunks': int(self.chunks[i]),
                 'prompt_tokens': int(tr.prompt_len[i]),
-                'output_tokens': int(tr.new_tokens[i]),
+                'output_tokens': 0 if shed else int(tr.new_tokens[i]),
                 'prefix_hit_tokens': int(tr.prefix_len[i])
                 if self.prefix_hits[i] else 0,
                 'spec_proposed': 0, 'spec_accepted': 0,
-                'kv_page_seconds': float(self.finish[i] - self.admit[i]),
+                'kv_page_seconds': (0.0 if shed else
+                                    float(self.finish[i] - self.admit[i])),
                 'failovers': int(self.failovers[i]),
-                'replicas': ['sim://replica-%d' % self.replica_of[i]],
-                'outcome': 'ok'})
+                'replicas': ([] if shed else
+                             ['sim://replica-%d' % self.replica_of[i]]),
+                'outcome': ('rejected' if shed else 'ok')})
         return out
 
 
@@ -260,7 +307,7 @@ def _burn_rate(ttft_log, now, slo, window):
 
 def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
              autoscale_tick_s=None, kill_at=None, advance_every=None,
-             registry=None):
+             registry=None, qos=None):
     """Run `trace` through a simulated fleet of `replicas` engines.
 
     router: 'least_loaded' (the gateway's policy, replicas advanced to
@@ -274,6 +321,16 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
     advance_every: advance replicas every N arrivals instead of every
     arrival (default 1 when n <= 20k, else 1024 — the batching that
     keeps million-request sweeps in seconds).
+    qos: a capacity.qos.QosPolicy — the gateway's admission layer in
+    simulated time. Arrivals failing the per-tenant rate/quota check
+    shed at the front door (outcome 'rejected', no replica time), and
+    replica queues serve highest priority first, FIFO within a class.
+    The sim deliberately does NOT model KV preemption — admission +
+    priority ordering dominate fleet-level tails, and the pessimistic
+    error (a resident low-priority request holding its slot) is the
+    safe direction for capacity planning. NOTE: the policy object is
+    STATEFUL (buckets, inflight counts) and gets consumed by the run —
+    pass a fresh instance per simulate() call (sweep_qos does).
     """
     import time as _time
     host0 = _time.monotonic()
@@ -304,6 +361,17 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
     prefix_hits = [False] * n
     chunks_of = [0] * n
 
+    # QoS columns (only materialized when a policy is active)
+    tenant_of = prio = outcome = reason_of = None
+    if qos is not None:
+        names = trace.tenant_names
+        tids = trace.tenant_id.tolist()
+        prio_of_tid = [int(qos.priority_of(nm)) for nm in names]
+        tenant_of = [names[t] for t in tids]
+        prio = [prio_of_tid[t] for t in tids]
+        outcome = ['ok'] * n
+        reason_of = [None] * n
+
     pool = [_Replica(0.0, slots) for _ in range(int(replicas))]
     timeline = [(0.0, len(pool))]
     ttft_log = []
@@ -327,17 +395,36 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
                 if qh:
                     del queue[:qh]
                     qh = 0
-                if not queue:
+                if prio is not None and rep.ready:
+                    pass          # admissible work is already staged
+                elif not queue:
                     break
-                # idle: jump the local clock to the head arrival
-                t = max(t, queue[0][1])
+                else:
+                    # idle: jump the local clock to the head arrival
+                    t = max(t, queue[0][1])
             if t >= until:
                 break
-            # ADMIT arrived requests into free slots at the step top
-            while len(act) < rep.slots and qh < len(queue) \
-                    and queue[qh][1] <= t:
-                ri = queue[qh][0]
-                qh += 1
+            # ADMIT arrived requests into free slots at the step top.
+            # With a QoS policy, arrived entries stage through a
+            # priority heap first — highest class served first, trace
+            # order within a class — so the pick stays O(log n) even
+            # when deep overload piles up an arrived backlog (a linear
+            # best-scan goes quadratic exactly when QoS matters most).
+            if prio is not None:
+                while qh < len(queue) and queue[qh][1] <= t:
+                    e = queue[qh]
+                    qh += 1
+                    heapq.heappush(rep.ready, (-prio[e[0]], e[0]))
+            while len(act) < rep.slots:
+                if prio is None:
+                    if qh >= len(queue) or queue[qh][1] > t:
+                        break
+                    ri = queue[qh][0]
+                    qh += 1
+                else:
+                    if not rep.ready:
+                        break
+                    ri = heapq.heappop(rep.ready)[1]
                 admit[ri] = t
                 g = prefix_group[ri]
                 eff = prompt_len[ri]
@@ -387,6 +474,8 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
                             finish[ri] = t
                             replica_of[ri] = ridx
                             rep.outstanding -= 1
+                            if qos is not None:
+                                qos.finish(tenant_of[ri])
                             done_any = True
                 if done_any:
                     rep.active = [r for r in act if r[2] > 0]
@@ -419,8 +508,10 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
             return
         rep.alive = False
         orphans = [ri for (ri, _) in rep.queue]
+        orphans += [e[1] for e in rep.ready]
         orphans += [rec[0] for rec in rep.active if rec[2] > 0]
         rep.queue = []
+        rep.ready = []
         rep.active = []
         rep.outstanding = 0
         timeline.append((now, sum(1 for r in pool if r.alive)))
@@ -472,6 +563,15 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
                 stop = j
                 broke = True
                 break
+            if qos is not None:
+                ok, why = qos.admit(arrival[j], tenant_of[j])
+                if not ok:
+                    # shed at the front door: the request costs no
+                    # replica time and its "latency" is undefined
+                    outcome[j] = 'rejected'
+                    reason_of[j] = why
+                    admit[j] = first[j] = finish[j] = arrival[j]
+                    continue
             route(j, arrival[j], fo=0)
         if not broke and router == 'round_robin' and stop > i:
             advance_all(arrival[stop - 1])
@@ -489,7 +589,7 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
     while True:
         busy = False
         for ridx, r in enumerate(pool):
-            if r.alive and (r.queue or r.active):
+            if r.alive and (r.queue or r.ready or r.active):
                 advance(r, float('inf'), ridx)
                 busy = True
         if not busy:
@@ -503,7 +603,14 @@ def simulate(trace, model, replicas=2, router='least_loaded', policy=None,
                     np.asarray(replica_of, dtype=np.int64),
                     np.asarray(prefix_hits, dtype=bool),
                     np.asarray(chunks_of, dtype=np.int64),
-                    timeline, wall)
+                    timeline, wall,
+                    outcome=(None if outcome is None
+                             else np.asarray(outcome)),
+                    priority=(None if prio is None
+                              else np.asarray(prio, dtype=np.int64)),
+                    reject_reason=(None if reason_of is None
+                                   else np.asarray(reason_of,
+                                                   dtype=object)))
     if registry is not None:
         from ..monitor.telemetry import record_capacity_schema
         fams = record_capacity_schema(registry)
@@ -536,6 +643,44 @@ def sweep_replicas(trace, model, counts=(1, 2, 4, 8, 16), slo_ttft_s=1.0,
     return {'slo_ttft_s': float(slo_ttft_s), 'percentile': int(percentile),
             'requests': len(trace), 'points': points,
             'min_replicas': min_replicas}
+
+
+def sweep_qos(trace, model, policies, replicas=2, slo_ttft_s=1.0,
+              percentile=99, router='round_robin', advance_every=None):
+    """Simulate the same trace and fleet under each admission policy.
+
+    `policies`: [(name, QosPolicy-or-dict)] pairs (or a {name: policy}
+    dict). Policies are re-materialized per run via to_dict/from_dict —
+    QosPolicy instances are stateful, and a sweep must not leak bucket
+    levels across points. Each point reports the overall admitted-TTFT
+    tail, the shed rate, and the per-priority-class tail; `meets_slo`
+    asks whether the HIGHEST priority class holds the SLO — the
+    graceful-degradation question, not the aggregate one.
+    """
+    from .qos import QosPolicy
+    if isinstance(policies, dict):
+        policies = sorted(policies.items())
+    points = []
+    for name, pol in policies:
+        spec = pol if isinstance(pol, dict) else pol.to_dict()
+        res = simulate(trace, model, replicas=replicas, router=router,
+                       advance_every=advance_every,
+                       qos=QosPolicy.from_dict(spec))
+        s = res.summary()
+        by = res.ttft_percentiles_by_priority((percentile,))
+        top = max(by) if by else 0
+        points.append({
+            'policy': name,
+            'ttft_p%d_s' % percentile:
+                res.ttft_percentiles((percentile,))[percentile],
+            'rejected': s.get('rejected', 0),
+            'shed_rate': s.get('shed_rate', 0.0),
+            'by_priority': {str(p): v[percentile]
+                            for p, v in sorted(by.items())},
+            'meets_slo': bool(by and by[top][percentile] <= slo_ttft_s)})
+    return {'slo_ttft_s': float(slo_ttft_s), 'percentile': int(percentile),
+            'requests': len(trace), 'replicas': int(replicas),
+            'points': points}
 
 
 # ---------------------------------------------------------------------------

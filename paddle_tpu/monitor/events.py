@@ -51,6 +51,7 @@ __all__ = ['REQUEST_EVENT_FIELDS', 'FIELD_NAMES', 'RequestLog',
 REQUEST_EVENT_FIELDS = (
     ('request_id', 'engine- or gateway-level request id'),
     ('tenant', 'normalized tenant label (bounded cardinality)'),
+    ('priority', 'scheduling priority (int, higher preempts lower)'),
     ('trace_id', 'trace id of the span tree that completed the request'),
     ('arrival_t', 'wall-clock submission time'),
     ('admit_t', 'wall-clock KV-slot admission time (None: never admitted)'),
@@ -66,7 +67,8 @@ REQUEST_EVENT_FIELDS = (
     ('kv_page_seconds', 'integral of KV pages (slots) held x seconds'),
     ('failovers', 'times the request was re-placed after a replica loss'),
     ('replicas', 'replica endpoints traversed, in placement order'),
-    ('outcome', "terminal outcome: 'ok' | 'error'"),
+    ('outcome',
+     "terminal outcome: 'ok' | 'error' | 'rejected' | 'preempted'"),
 )
 
 FIELD_NAMES = tuple(name for name, _ in REQUEST_EVENT_FIELDS)

@@ -18,7 +18,7 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_train_loop_schema', 'record_fleet_schema',
            'record_alert_schema', 'record_supervisor_schema',
            'record_request_event_schema', 'record_tenant_schema',
-           'record_capacity_schema', 'snapshot_line',
+           'record_qos_schema', 'record_capacity_schema', 'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -490,6 +490,52 @@ def record_tenant_schema(registry):
     return out
 
 
+# the QoS enforcement families (serving/gateway/admission.py +
+# capacity/qos.py): admission decisions, preempt/resume traffic and the
+# token-bucket levels the admission layer runs on. Single-source rule:
+# the gateway's admission hooks, the engines' preemption path and the
+# schema baseline all register through record_qos_schema. Label budgets
+# (docs/observability.md): tenant is bounded by TenantLabeler exactly
+# like TENANT_FAMILIES; reason is the closed rejection vocabulary
+# {rate, quota, queue_full, deadline}; priority is the closed set of
+# priorities declared in the configured QosPolicy classes (stringified
+# ints — config-bounded, never per-request).
+QOS_FAMILIES = (
+    ('counter', 'qos_admitted_total',
+     'requests passed by the admission layer per tenant', ('tenant',)),
+    ('counter', 'qos_rejected_total',
+     'requests shed by the admission layer per reason and tenant',
+     ('reason', 'tenant')),
+    ('counter', 'qos_preempted_total',
+     'KV-page preemptions of low-priority residents per tenant',
+     ('tenant',)),
+    ('counter', 'qos_resumed_total',
+     'previously preempted requests re-admitted per tenant', ('tenant',)),
+    ('gauge', 'qos_token_bucket_level',
+     'remaining token-bucket credit per tenant at the last admission '
+     'decision', ('tenant',)),
+    ('histogram', 'qos_ttft_seconds',
+     'time to first token per priority class (premium vs background)',
+     ('priority',)),
+)
+
+
+def record_qos_schema(registry):
+    """Register the QoS enforcement families on `registry` and return
+    {name: family}. Used by the gateway admission layer / ServingMetrics
+    at construction and by dryrun_registry so the committed baseline
+    covers QoS."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in QOS_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # same ladder as the unlabeled TTFT families
+            kw['buckets'] = exponential_buckets(0.002, 2.0, 16)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw)
+    return out
+
+
 # the capacity-planning families (paddle_tpu/capacity/): trace replay
 # against the real gateway plus the discrete-event fleet simulator.
 # Single-source rule: replay.replay/simulator.simulate and the schema
@@ -551,6 +597,7 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_supervisor_schema(reg)
     record_request_event_schema(reg)
     record_tenant_schema(reg)
+    record_qos_schema(reg)
     record_capacity_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg

@@ -25,7 +25,8 @@ verify) and the thread-safe front door, metrics.py turns step
 timestamps into tok/s + latency percentiles. See docs/serving.md.
 """
 from .engine import ContinuousBatchingEngine
-from .gateway import (AutoscalePolicy, GatewayRequest, ServingGateway)
+from .gateway import (AutoscalePolicy, GatewayRequest, QosPolicy,
+                      ServingGateway, TenantClass)
 from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
                        build_paged_pools, build_slot_caches)
 from .metrics import ServingMetrics
@@ -36,4 +37,5 @@ __all__ = ['ContinuousBatchingEngine', 'PagedContinuousBatchingEngine',
            'SlotAllocator', 'PageAllocator', 'PrefixCache',
            'NGramProposer', 'build_slot_caches', 'build_paged_pools',
            'ServingMetrics', 'Request', 'Scheduler', 'PagedScheduler',
-           'ServingGateway', 'GatewayRequest', 'AutoscalePolicy']
+           'ServingGateway', 'GatewayRequest', 'AutoscalePolicy',
+           'QosPolicy', 'TenantClass']

@@ -158,17 +158,21 @@ class _EngineBase:
 
     def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
                     top_k=0, do_sample=False, seed=0, stream=False,
-                    tenant=None, emit_event=True):
+                    tenant=None, priority=0, emit_event=True):
         """Queue a generation request; returns the Request handle.
 
         `tenant` is the attribution dimension: it rides the request into
-        the per-tenant metric families and the wide event. `emit_event=
-        False` suppresses this engine's wide event — the gateway sets it
-        so a failed-over request still produces exactly ONE canonical
-        record (the gateway's, which knows the failover history)."""
+        the per-tenant metric families and the wide event. `priority`
+        (int, higher wins) orders admission and — on the paged engine
+        with preempt=True — marks lower-priority residents evictable.
+        `emit_event=False` suppresses this engine's wide event — the
+        gateway sets it so a failed-over request still produces exactly
+        ONE canonical record (the gateway's, which knows the failover
+        history)."""
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
-                      do_sample=do_sample, seed=seed, tenant=tenant)
+                      do_sample=do_sample, seed=seed, tenant=tenant,
+                      priority=priority)
         req._emit_event = bool(emit_event)
         req._tenant_label = self.metrics.tenant_label(tenant)
         # front-door guard, shared by BOTH engines (the paged subclass
@@ -340,6 +344,13 @@ class _EngineBase:
         for slot, req in self.scheduler.admit():
             req._admit_t = self.metrics.now()
             self.metrics.on_admitted(req.id)
+            if req._preempts:
+                # a previously preempted request coming back: the
+                # regenerated prefix is swallowed via req._replay, so
+                # the caller-visible stream resumes where it stopped
+                self.metrics.on_resumed(req._tenant_label)
+                if req._span is not None:
+                    req._span.add_event('resumed', preempts=req._preempts)
             if req._span is not None:
                 req._span.add_event('admitted', slot=slot)
                 req._phase = self._tracer.start_span(
@@ -377,6 +388,15 @@ class _EngineBase:
                 tags={'slot': req.slot})
 
     def _emit(self, req, tokens):
+        if req._replay:
+            # post-preemption regeneration: the first _replay tokens
+            # were already delivered before the eviction; determinism
+            # (same prompt, sampling, seed) makes the regenerated ones
+            # identical, so swallow them — no duplicates, no double
+            # counting in the token metrics
+            drop = min(req._replay, len(tokens))
+            req._replay -= drop
+            tokens = tokens[drop:]
         if not tokens:
             return
         req.tokens.extend(tokens)
@@ -394,6 +414,7 @@ class _EngineBase:
             trace_id=None if req._span is None else req._span.trace_id)
 
     def _retire(self, req, outcome='ok'):
+        req.outcome = outcome
         slot = req.slot
         self._active[slot] = False
         del self._requests[slot]
@@ -425,6 +446,7 @@ class _EngineBase:
         log.emit(
             request_id=req.id,
             tenant=req._tenant_label,
+            priority=req.priority,
             trace_id=None if req._span is None else req._span.trace_id,
             arrival_t=req._arrival_t,
             admit_t=req._admit_t,

@@ -15,6 +15,7 @@ router.py ranks replicas on the live serving gauges; autoscaler.py is
 the pure SLO-burn policy; gateway.py composes them behind one lock.
 See docs/serving.md#gateway.
 """
+from .admission import QosPolicy, TenantClass, TokenBucket
 from .autoscaler import AutoscalePolicy, Decision, slo_burn_rate
 from .gateway import GatewayRequest, ServingGateway
 from .replica import InprocReplica
@@ -22,4 +23,5 @@ from .router import LeastLoadedRouter, RoundRobinRouter
 
 __all__ = ['ServingGateway', 'GatewayRequest', 'InprocReplica',
            'LeastLoadedRouter', 'RoundRobinRouter', 'AutoscalePolicy',
-           'Decision', 'slo_burn_rate']
+           'Decision', 'slo_burn_rate', 'QosPolicy', 'TenantClass',
+           'TokenBucket']

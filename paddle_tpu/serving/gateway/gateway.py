@@ -6,13 +6,22 @@
     gw.run()                       # or gw.start() for driver threads
     req.tokens                     # identical to a single engine's output
 
-Three jobs, one lock:
+Four jobs, one lock:
 
+- **Admission.** With `admission=QosPolicy(...)` configured, submit()
+  first runs the per-tenant token bucket + concurrency quota; a shed
+  request finishes immediately with outcome='rejected' (one wide
+  event, `error` set — overload is data, not an exception). The
+  pending queue becomes bounded (`max_pending`: overflow sheds the
+  lowest-priority parked request) and deadline-aware
+  (`max_queue_wait_s`: parked past the deadline sheds on the next
+  drain). See admission.py for the contract.
 - **Routing.** submit() walks the router's ranked candidates and places
   the request on the first replica whose transport accepts; when none is
   routable the request parks in the gateway queue and is drained on the
-  next step. Routing emits a `gateway.route` span and per-replica
-  `gateway_route_total` counts.
+  next step (highest priority first, FIFO within a class). Routing
+  emits a `gateway.route` span and per-replica `gateway_route_total`
+  counts.
 - **Failover.** A replica lost mid-flight (chaos partition, driver
   exception, kill_replica) has every non-finished assigned request
   re-submitted elsewhere — full prompt, same seed. Engines are
@@ -45,7 +54,8 @@ import time
 from ...monitor import events as _events
 from ...monitor import tracing as _tracing
 from ...monitor.registry import default_registry
-from ...monitor.telemetry import record_gateway_schema, record_tenant_schema
+from ...monitor.telemetry import (record_gateway_schema, record_qos_schema,
+                                  record_tenant_schema)
 from .autoscaler import slo_burn_rate
 from .replica import DRAINING, READY, STATE_CODES, InprocReplica
 from .router import LeastLoadedRouter
@@ -74,8 +84,9 @@ class GatewayRequest:
         self.failovers = 0       # replica losses survived
         self.arrival_t = None
         self.first_token_t = None
-        self.error = None        # set iff rejected after being accepted
+        self.error = None        # set iff rejected/shed/failed
         self._eng_req = None     # current engine-side Request
+        self._qos_label = None   # admission slot held, iff admitted
         self._stream_q = _queue.Queue() if stream else None
         self._finished = threading.Event()
 
@@ -107,7 +118,8 @@ class GatewayRequest:
 class ServingGateway:
 
     def __init__(self, engine_factory, replicas=2, router=None,
-                 autoscaler=None, registry=None, clock=None):
+                 autoscaler=None, admission=None, registry=None,
+                 clock=None):
         if replicas < 1:
             raise ValueError('need at least one replica')
         self._factory = engine_factory
@@ -116,6 +128,7 @@ class ServingGateway:
             else default_registry()
         self.router = router if router is not None else LeastLoadedRouter()
         self.policy = autoscaler
+        self.admission = admission      # capacity.qos.QosPolicy or None
         self._lock = threading.RLock()
         self._tracer = _tracing.default_tracer()
         fams = record_gateway_schema(self.registry)
@@ -138,6 +151,12 @@ class ServingGateway:
         tfams = record_tenant_schema(self.registry)
         self._m_tenant_requests = tfams['tenant_requests_total']
         self._m_tenant_ttft = tfams['tenant_ttft_seconds']
+        qfams = record_qos_schema(self.registry)
+        self._m_qos_admitted = qfams['qos_admitted_total']
+        self._m_qos_rejected = qfams['qos_rejected_total']
+        self._m_qos_bucket = qfams['qos_token_bucket_level']
+        self._m_qos_ttft = qfams['qos_ttft_seconds']
+        self._n_rejected = 0
         self._labeler = _events.TenantLabeler()
         # wide-event log, cached at construction like the tracer
         self.events = _events.default_request_log()
@@ -161,25 +180,91 @@ class ServingGateway:
     # ---- front door ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, stream=False, tenant=None,
-               **sampling):
+               priority=None, **sampling):
         """Accept one request; returns the GatewayRequest handle.
         Raises ValueError for requests no replica could EVER admit (the
         engines' front-door guard) — those must fail the caller, not
         trip failover.
 
-        `tenant` folds into the sampling dict so a failover re-submit
-        carries it: attribution survives replica loss by construction."""
+        `tenant` and `priority` fold into the sampling dict so a
+        failover re-submit carries them: attribution and scheduling
+        class survive replica loss by construction. `priority` defaults
+        from the admission policy's tenant class (0 without one).
+
+        With an admission policy, a shed request comes back as an
+        already-finished handle (`error` set, outcome='rejected' in the
+        wide event) — never an exception: overload is data."""
+        adm = self.admission
+        if priority is None:
+            priority = adm.priority_of(tenant) if adm is not None else 0
         sampling = dict(sampling, max_new_tokens=max_new_tokens,
-                        tenant=tenant)
+                        tenant=tenant, priority=int(priority))
         gw = GatewayRequest(prompt, sampling, stream=stream)
         with self._lock:
             gw.arrival_t = self._clock()
-            routed = self._route_locked(gw)   # ValueError -> not accepted
+            if adm is not None:
+                label = self._labeler.label(tenant)
+                ok, reason = adm.admit(gw.arrival_t, label)
+                lvl = adm.bucket_level(label, gw.arrival_t)
+                if lvl is not None:
+                    self._m_qos_bucket.labels(label).set(lvl)
+                if not ok:
+                    self._reject_locked(gw, reason)
+                    return gw
+                gw._qos_label = label
+                self._m_qos_admitted.labels(label).inc()
+            try:
+                routed = self._route_locked(gw)  # ValueError: inadmissible
+            except ValueError:
+                self._qos_finish_locked(gw)
+                raise
             self._m_requests.inc()
             if not routed:
-                self._pending.append(gw)
+                self._park_locked(gw)
             self._m_queue.set(len(self._pending))
         return gw
+
+    def _park_locked(self, gw):
+        """Queue gw for the next drain. With a bounded queue
+        (admission.max_pending) an overflow sheds the lowest-priority
+        request — the newest of the lowest class already parked if one
+        sits strictly below gw, else gw itself."""
+        adm = self.admission
+        cap = None if adm is None else adm.max_pending
+        if cap is not None and len(self._pending) >= cap:
+            p_new = gw.sampling.get('priority') or 0
+            victim = None
+            for g in self._pending:      # keep the newest among equals
+                pg = g.sampling.get('priority') or 0
+                if pg < p_new and (victim is None or pg <=
+                                   (victim.sampling.get('priority') or 0)):
+                    victim = g
+            if victim is None:
+                self._reject_locked(gw, 'queue_full')
+                return
+            self._pending.remove(victim)
+            self._reject_locked(victim, 'queue_full')
+        self._pending.append(gw)
+
+    def _reject_locked(self, gw, reason):
+        """Finish gw as shed: exactly one wide event (outcome
+        'rejected'), error set, stream closed, admission slot (if one
+        was taken — queue sheds were admitted) released."""
+        self._m_qos_rejected.labels(
+            reason, self._labeler.label(gw.sampling.get('tenant'))).inc()
+        self._n_rejected += 1
+        self._qos_finish_locked(gw)
+        gw.error = RuntimeError('rejected: %s' % reason)
+        if gw._stream_q is not None:
+            gw._stream_q.put(None)
+        self._emit_wide_event_locked(gw, 'rejected')
+        gw._finished.set()
+
+    def _qos_finish_locked(self, gw):
+        """Release gw's admission concurrency slot, exactly once."""
+        if gw._qos_label is not None and self.admission is not None:
+            self.admission.finish(gw._qos_label)
+            gw._qos_label = None
 
     def generate(self, prompts, **sampling):
         """Blocking batch door, mirroring the engines' generate()."""
@@ -231,6 +316,27 @@ class ServingGateway:
             return False
 
     def _drain_pending_locked(self):
+        adm = self.admission
+        if adm is not None and self._pending:
+            if adm.max_queue_wait_s is not None:
+                # deadline-aware shedding: a request parked past the
+                # deadline will blow its SLO anyway — shed it now and
+                # spend the capacity on fresher work
+                now = self._clock()
+                keep = collections.deque()
+                while self._pending:
+                    gw = self._pending.popleft()
+                    if now - gw.arrival_t > adm.max_queue_wait_s:
+                        self._reject_locked(gw, 'deadline')
+                    else:
+                        keep.append(gw)
+                self._pending = keep
+            if len(self._pending) > 1:
+                # drain best-first; sorted() is stable, so FIFO holds
+                # within a priority class
+                self._pending = collections.deque(sorted(
+                    self._pending,
+                    key=lambda g: -(g.sampling.get('priority') or 0)))
         while self._pending:
             gw = self._pending.popleft()
             try:
@@ -323,6 +429,9 @@ class ServingGateway:
                     self._m_ttft.observe(ttft)
                     self._m_tenant_ttft.labels(self._labeler.label(
                         gw.sampling.get('tenant'))).observe(ttft)
+                    self._m_qos_ttft.labels(
+                        str(gw.sampling.get('priority') or 0)).observe(
+                            ttft)
                     self._ttfts.append((now, ttft))
                 gw.tokens.extend(new)
                 if gw._stream_q is not None:
@@ -331,9 +440,14 @@ class ServingGateway:
                 self._m_tokens.inc(len(new))
             if er.done and len(gw.tokens) >= len(er.tokens):
                 del rep.assigned[gw]
-                self._complete_locked(gw)
+                # a terminal engine-side outcome (e.g. 'preempted' when
+                # max_preempts ran out) surfaces through the gateway's
+                # canonical event
+                self._complete_locked(
+                    gw, getattr(er, 'outcome', None) or 'ok')
 
     def _complete_locked(self, gw, outcome='ok'):
+        self._qos_finish_locked(gw)
         if gw._stream_q is not None:
             gw._stream_q.put(None)
         self._m_tenant_requests.labels(self._labeler.label(
@@ -372,6 +486,7 @@ class ServingGateway:
         log.emit(
             request_id=gw.id,
             tenant=self._labeler.label(gw.sampling.get('tenant')),
+            priority=gw.sampling.get('priority', 0),
             trace_id=trace_id,
             arrival_t=gw.arrival_t,
             admit_t=admit_t,
@@ -556,4 +671,5 @@ class ServingGateway:
                 'failovers': int(self._m_failover.value()),
                 'retries': int(self._m_retries.value()),
                 'pending': len(self._pending),
+                'rejected': self._n_rejected,
             }

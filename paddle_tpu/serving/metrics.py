@@ -20,7 +20,8 @@ import time
 from ..monitor import tracing as _tracing
 from ..monitor.events import TenantLabeler
 from ..monitor.registry import default_registry
-from ..monitor.telemetry import (record_serving_schema,
+from ..monitor.telemetry import (record_qos_schema,
+                                 record_serving_schema,
                                  record_serving_request_schema,
                                  record_tenant_schema)
 
@@ -88,6 +89,12 @@ class ServingMetrics:
         self._m_tenant_tokens = tenant['tenant_tokens_total']
         self._m_tenant_ttft = tenant['tenant_ttft_seconds']
         self._m_tenant_kv = tenant['tenant_kv_byte_seconds_total']
+        # QoS families (preempt/resume counters); the admission-side
+        # members of the same table are driven by the gateway — both
+        # register the full schema so scrapes agree regardless of layer
+        qos = record_qos_schema(r)
+        self._m_qos_preempted = qos['qos_preempted_total']
+        self._m_qos_resumed = qos['qos_resumed_total']
         self._labeler = TenantLabeler()
         self._prefill_tokens = 0
         self._prefix_hits = 0
@@ -196,6 +203,16 @@ class ServingMetrics:
         self._m_tenant_requests.labels(label).inc()
         if kv_byte_seconds > 0:
             self._m_tenant_kv.labels(label).inc(kv_byte_seconds)
+
+    def on_preempted(self, label):
+        """One resident of tenant `label` had its KV pages evicted to
+        make room for a higher-priority request."""
+        self._m_qos_preempted.labels(label).inc()
+
+    def on_resumed(self, label):
+        """One previously preempted request of tenant `label` was
+        re-admitted (fast-forwarded through the prefix cache)."""
+        self._m_qos_resumed.labels(label).inc()
 
     def on_spec(self, proposed, accepted):
         """One speculative verify pass: `proposed` draft tokens went in,

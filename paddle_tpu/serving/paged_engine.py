@@ -94,7 +94,8 @@ class PagedContinuousBatchingEngine(_EngineBase):
 
     def __init__(self, model, num_seqs=8, max_len=None, page_size=16,
                  num_pages=None, prefill_chunk=16, decode_block=4,
-                 spec_k=0, ngram=2, prefix_cache=True, donate=None):
+                 spec_k=0, ngram=2, prefix_cache=True, preempt=False,
+                 max_preempts=None, donate=None):
         super().__init__(model, num_seqs, max_len)
         if self.max_len > model.config.max_position_embeddings:
             raise ValueError(
@@ -126,6 +127,16 @@ class PagedContinuousBatchingEngine(_EngineBase):
         self.scheduler = PagedScheduler(self.allocator, self.pages,
                                         self.max_len, prefill_chunk,
                                         self.page_size, self.prefix)
+        # priority preemption: a page-blocked high-priority arrival may
+        # evict strictly-lower-priority residents (scheduler policy);
+        # this engine's hook clears the freed lane and accounts the
+        # eviction. max_preempts bounds how often one request may lose
+        # its pages before it is finished terminally (outcome
+        # 'preempted') instead of requeued.
+        self.scheduler.preempt_enabled = bool(preempt)
+        self.scheduler.max_preempts = (None if max_preempts is None
+                                       else int(max_preempts))
+        self.scheduler.on_preempt = self._on_preempt
         # billing unit for kv_byte_seconds: one physical page
         self._kv_page_bytes = _kv_row_bytes(model) * self.page_size
         # per-row KV length (rows written), the block-table companion to
@@ -188,6 +199,37 @@ class PagedContinuousBatchingEngine(_EngineBase):
         slot = req.slot
         super()._retire(req, outcome)
         self._lens[slot] = 0
+
+    def _on_preempt(self, slot, req, dropped):
+        """PagedScheduler eviction hook (lock held): the victim's pages
+        and slot are already released — freeze the lane so the next
+        decode burst cannot advance it (the freed pages may belong to
+        someone else by then) and close the victim's phase span. A
+        `dropped` victim burned its preemption budget: retire it here
+        with outcome='preempted' (the scheduler already closed its
+        billing window and sets the finished flag after this returns)."""
+        self._active[slot] = False
+        self._lens[slot] = 0
+        self._requests.pop(slot, None)
+        self.metrics.on_preempted(req._tenant_label)
+        if req._phase is not None:
+            req._phase.finish()
+            req._phase = None
+        if req._span is not None:
+            req._span.add_event('preempted', count=req._preempts,
+                                dropped=dropped)
+        if not dropped:
+            return
+        req.outcome = 'preempted'
+        req._finish_t = self.metrics.now()
+        self.metrics.on_retired(req.id)
+        self.metrics.on_tenant_retired(
+            req._tenant_label, req.kv_page_seconds * self._kv_page_bytes)
+        if req._span is not None:
+            req._span.set_tag('tokens', len(req.tokens))
+            req._span.add_event('retired')
+            req._span.finish()
+        self._emit_wide_event(req, 'preempted')
 
     # ---- the three compiled programs ----------------------------------
 

@@ -43,7 +43,8 @@ class Request:
     """
 
     def __init__(self, prompt, max_new_tokens=32, temperature=1.0,
-                 top_k=0, do_sample=False, seed=0, tenant=None):
+                 top_k=0, do_sample=False, seed=0, tenant=None,
+                 priority=0):
         self.id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -52,6 +53,8 @@ class Request:
         self.do_sample = bool(do_sample)
         self.seed = int(seed)
         self.tenant = tenant      # attribution dimension (opaque string)
+        self.priority = int(priority)   # higher preempts lower; FIFO ties
+        self.outcome = None       # terminal outcome, set at retirement
         self.tokens = []          # generated ids (prompt NOT included)
         self.state = QUEUED
         # wide-event lifecycle fields (monitor/events.py): the engine
@@ -72,6 +75,12 @@ class Request:
         self._prefix_hit = 0      # prompt tokens served by the prefix
         #                           cache (paged engine; 0 elsewhere)
         self._published = 0       # prompt blocks already in the cache
+        self._seq = None          # submission order, set by the scheduler
+        self._preempts = 0        # times this request lost its KV pages
+        self._replay = 0          # already-delivered tokens to swallow
+        #                           while regenerating after a preemption
+        self._kv_acc = 0.0        # page·seconds from closed-out holding
+        #                           windows (accumulated at preemption)
         self._span = None         # 'serving.request' lifecycle span
         self._phase = None        # current prefill/decode child span
         self._finished = threading.Event()
@@ -104,6 +113,7 @@ class Scheduler:
         self.prefill_chunk = int(prefill_chunk)
         self.queue = deque()
         self.resident = {}        # slot -> Request (PREFILL or DECODE)
+        self._submit_seq = itertools.count()
 
     def submit(self, req):
         """Validate capacity and enqueue. Raises on impossible requests —
@@ -125,13 +135,29 @@ class Scheduler:
                 'request needs %d cache rows (prompt %d + %d new tokens, '
                 'prefill padding to %d) but slots hold %d'
                 % (need, n0, req.max_new_tokens, padded, self.max_len))
+        req._seq = next(self._submit_seq)
         self.queue.append(req)
+
+    def _pick_index(self):
+        """Index of the next request to admit: highest priority first,
+        submission order (_seq) within a class — so with uniform
+        priorities this is index 0, the exact historical FIFO, and a
+        preempted request (which keeps its original _seq) resumes ahead
+        of later arrivals of its own class."""
+        best = 0
+        for i in range(1, len(self.queue)):
+            r, b = self.queue[i], self.queue[best]
+            if (r.priority, -r._seq) > (b.priority, -b._seq):
+                best = i
+        return best
 
     def admit(self):
         """Bind queued requests to free slots; returns [(slot, req)]."""
         admitted = []
         while self.queue and self.allocator.available:
-            req = self.queue.popleft()
+            i = self._pick_index()
+            req = self.queue[i]
+            del self.queue[i]
             slot = self.allocator.alloc(req.id)
             req.slot = slot
             req.state = PREFILL
@@ -198,12 +224,24 @@ class PagedScheduler(Scheduler):
       blocks are shared via incref, the rest freshly allocated). Because
       every resident request already holds everything it will ever
       write, residents always run to completion — no mid-flight
-      allocation failure, no preemption, no deadlock. When the HEAD
-      request cannot get its pages (even after evicting idle prefix-
-      cache entries) admission stops for the step rather than skipping
-      ahead: FIFO order is what makes waiting bounded.
+      allocation failure, no deadlock. When the HEAD request cannot get
+      its pages (even after evicting idle prefix-cache entries)
+      admission stops for the step rather than skipping ahead: FIFO
+      order is what makes waiting bounded.
     - A prefix-cache hit fast-forwards `_consumed` to the shared length,
       so prefill work is paid only for the unshared tail.
+    - With `preempt_enabled` (the engine's `preempt=True`), a blocked
+      head may EVICT a strictly-lower-priority resident: the victim's
+      pages decref back to the pool, its slot frees, and it requeues
+      with its original submission order. Its run-to-completion
+      guarantee is deliberately traded away — that is the QoS deal for
+      low priority. Resumption re-admits it like any queued request;
+      its own published prompt blocks usually fast-forward the
+      re-prefill through the prefix cache, and the engine regenerates
+      the already-delivered tokens deterministically (same prompt,
+      sampling, seed — the gateway-failover invariant), swallowing them
+      via Request._replay so the caller-visible stream has no duplicate
+      and no gap.
 
     Block tables live here as one host numpy array [num_slots,
     max_blocks] (int32 page ids, SCRATCH_PAGE where unmapped); the
@@ -222,6 +260,13 @@ class PagedScheduler(Scheduler):
         self.block_tables = np.full(
             (allocator.num_slots, self.num_blocks), SCRATCH_PAGE, np.int32)
         self._nblocks = {}        # slot -> mapped block count
+        self.preempt_enabled = False
+        self.max_preempts = None  # per-request eviction budget (None: ∞)
+        # engine hook, called with (slot, req, dropped) after the pages
+        # and slot are released: clears per-slot engine state; `dropped`
+        # means the request burned its preemption budget and is terminal
+        self.on_preempt = None
+        self.preempted = 0        # evictions (monotonic, for reports)
 
     def submit(self, req):
         """Front-door capacity check, page-aware: the worst padded
@@ -246,16 +291,29 @@ class PagedScheduler(Scheduler):
             raise ValueError(
                 'request needs %d pages but the pool only has %d'
                 % (-(-need // self.page_size), total))
+        req._seq = next(self._submit_seq)
         self.queue.append(req)
 
     def admit(self):
         admitted = []
-        while self.queue and self.allocator.available:
-            req = self.queue[0]
+        while self.queue:
+            i = self._pick_index()
+            req = self.queue[i]
+            if not self.allocator.available:
+                # every SLOT is held: a high-priority head may still
+                # enter by evicting a strictly-lower-priority resident
+                # (which also returns its pages); otherwise stop
+                if not (self.preempt_enabled and self._preempt_for(req)):
+                    break
             plan = self._reserve(req)
+            if plan is None and self.preempt_enabled:
+                # the head is blocked on PAGES: evict strictly-lower-
+                # priority residents until it fits or none are left
+                while plan is None and self._preempt_for(req):
+                    plan = self._reserve(req)
             if plan is None:
                 break                          # head blocked => stop: FIFO
-            self.queue.popleft()
+            del self.queue[i]
             pages, hit_len = plan
             # the request's page-holding window opens here (shared
             # prefix pages were increfed inside _reserve moments ago)
@@ -300,6 +358,72 @@ class PagedScheduler(Scheduler):
         return hit_pages + [self.pages.alloc() for _ in range(want)], \
             hit_len
 
+    def _preempt_for(self, req):
+        """Evict ONE resident strictly below req's priority; False when
+        none exists. Victim choice: lowest priority first, and within a
+        class the most recently admitted (largest holding-window start)
+        — the resident with the least sunk work."""
+        victim = None
+        for r in self.resident.values():
+            if r.priority >= req.priority:
+                continue
+            if victim is None or \
+                    (r.priority, -(r._kv_hold_t or 0.0)) < \
+                    (victim.priority, -(victim._kv_hold_t or 0.0)):
+                victim = r
+        if victim is None:
+            return False
+        self.preempt(victim)
+        return True
+
+    def preempt(self, req):
+        """Evict a resident request: close its page·seconds billing
+        window, decref every mapped page back to the pool (its own
+        published prompt blocks survive under the prefix cache's ref —
+        the fast-forward on resume), free the slot, and requeue it with
+        its original submission order — or, past `max_preempts`, finish
+        it terminally (the engine hook emits outcome='preempted').
+        Returns True when requeued, False when dropped."""
+        slot = req.slot
+        row = self.block_tables[slot]
+        nblocks = self._nblocks.pop(slot, 0)
+        now = self.pages.touch()
+        held = (now - req._kv_hold_t) if req._kv_hold_t is not None \
+            else 0.0
+        req._kv_acc += nblocks * held
+        for b in range(nblocks):
+            if row[b] != SCRATCH_PAGE:
+                self.pages.decref(int(row[b]))
+        row[:] = SCRATCH_PAGE
+        del self.resident[slot]
+        self.allocator.free(slot)
+        req.slot = None
+        req._kv_hold_t = None
+        req._preempts += 1
+        self.preempted += 1
+        dropped = self.max_preempts is not None and \
+            req._preempts > self.max_preempts
+        if dropped:
+            req.kv_page_seconds = req._kv_acc
+            req.state = DONE
+        else:
+            # regeneration restarts from the prompt; the ledger
+            # (req.tokens) is what the caller already saw, so exactly
+            # that many regenerated tokens get swallowed on resume
+            req.state = QUEUED
+            req._consumed = 0
+            req._prefix_hit = 0
+            req._published = 0
+            req._replay = len(req.tokens)
+            self.queue.append(req)
+        if self.on_preempt is not None:
+            self.on_preempt(slot, req, dropped)
+        if dropped:
+            if req._stream_q is not None:
+                req._stream_q.put(None)
+            req._finished.set()
+        return not dropped
+
     def mark_prefilled(self, req, consumed):
         super().mark_prefilled(req, consumed)
         if self.prefix is None:
@@ -329,5 +453,6 @@ class PagedScheduler(Scheduler):
         # every reserved page, shared prefix hits included (the tenant
         # pinned them for its whole residency even if another tenant
         # also mapped them — see PageAllocator._advance for why the
-        # per-request sum can exceed the pool integral under sharing)
-        req.kv_page_seconds = nblocks * held
+        # per-request sum can exceed the pool integral under sharing).
+        # _kv_acc carries windows closed out by earlier preemptions.
+        req.kv_page_seconds = req._kv_acc + nblocks * held

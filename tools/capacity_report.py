@@ -24,7 +24,11 @@ Inputs (all offline — no jax, no gateway):
 Report: overall + per-tenant TTFT p50/p99 sim-vs-real divergence
 (K-S statistic, relative errors), the simulator summary, and — with
 --sweep — the replica-count sweep and its minimum-replica answer for
---slo-ms.
+--slo-ms. --qos-policy (repeatable JSON, a capacity.qos.QosPolicy
+to_dict blob with an optional "name" key) runs each admission policy
+over the trace at --replicas and reports shed rate plus per-priority
+TTFT tails and SLO verdicts side by side — the million-request policy
+sweep, offline.
 
 Gate (tools/gate_common protocol, like check_bench_regression): a
 sim-vs-real comparison whose p50 or p99 relative error exceeds
@@ -122,6 +126,10 @@ def main(argv=None):
                     choices=('least_loaded', 'round_robin'))
     ap.add_argument('--sweep', help='comma list of replica counts to '
                                     'sweep, e.g. 1,2,4,8')
+    ap.add_argument('--qos-policy', action='append', default=[],
+                    help='admission policy JSON to sweep (repeatable): '
+                         'a QosPolicy.to_dict blob, optional "name" key '
+                         'labels the result row')
     ap.add_argument('--slo-ms', type=float, default=1000.0,
                     help='TTFT SLO for the sweep (default %(default)s)')
     ap.add_argument('--percentile', type=float, default=99.0,
@@ -147,9 +155,18 @@ def main(argv=None):
                             'spec_hash': trace.spec_hash,
                             'tenants': trace.tenant_mix()}
 
-    if (args.simulate or args.calibrate or args.sweep) and trace is None:
+    if (args.simulate or args.calibrate or args.sweep
+            or args.qos_policy) and trace is None:
         return gate_common.nothing_to_check(
             'simulation requested but no trace/spec given')
+
+    policies = []
+    for i, blob in enumerate(args.qos_policy):
+        d = json.loads(blob)
+        if not isinstance(d, dict):
+            raise SystemExit('--qos-policy must be a JSON object, got: %r'
+                             % (blob,))
+        policies.append((d.pop('name', 'policy%d' % i), d))
 
     model = None
     if args.calibrate:
@@ -160,7 +177,7 @@ def main(argv=None):
             real_events, prefill_chunk=args.prefill_chunk,
             decode_block=args.decode_block, num_slots=args.num_slots,
             trace=trace, replicas=args.replicas, router=args.router)
-    elif args.simulate or args.sweep:
+    elif args.simulate or args.sweep or policies:
         model = simulator.ServiceModel(
             args.prefill_chunk_s, args.decode_burst_s,
             prefill_chunk=args.prefill_chunk,
@@ -180,13 +197,20 @@ def main(argv=None):
             trace, model, counts=counts, slo_ttft_s=args.slo_ms / 1e3,
             percentile=args.percentile)
 
+    if policies:
+        summary['qos_sweep'] = simulator.sweep_qos(
+            trace, model, policies, replicas=args.replicas,
+            slo_ttft_s=args.slo_ms / 1e3, percentile=args.percentile,
+            router=args.router)
+
     findings = []
     if sim_events and real_events:
         cmp = simulator.compare_events(sim_events, real_events)
         summary['divergence'] = cmp
         findings = check_divergence(cmp, args.max_p50_err,
                                     args.max_p99_err, max_ks=args.max_ks)
-    elif not sim_events and not real_events and 'sweep' not in summary:
+    elif not sim_events and not real_events \
+            and 'sweep' not in summary and 'qos_sweep' not in summary:
         return gate_common.nothing_to_check(
             'no simulated or real events to compare '
             '(give --trace/--spec with --simulate, or --sim/--real '

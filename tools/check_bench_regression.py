@@ -93,6 +93,13 @@ def higher_is_better(row):
     text = '%s %s' % (row.get('metric', ''), row.get('unit', ''))
     if 'hit_rate' in text:
         return True
+    if 'completed_ratio' in text:
+        # QoS rung: premium requests finishing is the whole contract
+        return True
+    if 'shed_rate' in text:
+        # QoS rung: more shedding on the same workload = policy or
+        # capacity regression, even though shedding itself is by design
+        return False
     if 'mttr' in text:
         # recovery time: a faster supervisor is a better supervisor
         return False

@@ -129,18 +129,28 @@ def slowest(events, n=10):
 
 def rollup_by_tenant(events):
     """{tenant: {requests, tokens, ttft_p50_ms, ttft_p99_ms,
-    kv_page_seconds, failovers, errors}} — the attribution table."""
+    kv_page_seconds, failovers, rejected, preempted, errors}} — the
+    attribution table. QoS outcomes get their own columns: a shed
+    request (outcome='rejected') or a preemption-budget kill
+    (outcome='preempted') is policy doing its job, not an engine error,
+    and capacity review needs them countable per tenant."""
     by = {}
     for ev in events:
         t = ev.get('tenant') or 'default'
         row = by.setdefault(t, {'requests': 0, 'tokens': 0,
                                 'kv_page_seconds': 0.0, 'failovers': 0,
+                                'rejected': 0, 'preempted': 0,
                                 'errors': 0, '_ttfts': []})
         row['requests'] += 1
         row['tokens'] += int(ev.get('output_tokens') or 0)
         row['kv_page_seconds'] += float(ev.get('kv_page_seconds') or 0.0)
         row['failovers'] += int(ev.get('failovers') or 0)
-        if ev.get('outcome') not in (None, 'ok'):
+        outcome = ev.get('outcome')
+        if outcome == 'rejected':
+            row['rejected'] += 1
+        elif outcome == 'preempted':
+            row['preempted'] += 1
+        elif outcome not in (None, 'ok'):
             row['errors'] += 1
         ttft = _ttft_s(ev)
         if ttft is not None:

@@ -1078,6 +1078,163 @@ def bench_capacity_calibration(on_tpu):
     return rows
 
 
+def bench_ingest(on_tpu):
+    """Streaming-ingestion rung (ISSUE 18): the async double-buffered
+    IngestPipeline vs the repo's synchronous baseline — io.DataLoader
+    doing sampler-driven random access over the SAME disk-resident
+    shard set — feeding an identical device step.
+
+    The baseline is what training disk-resident data looked like before
+    the ingestion plane: DataLoader(shuffle=True) indexes records one at
+    a time (ShardReader.at pays the strided seek + skip every access)
+    and nothing overlaps the step. The pipeline streams shards
+    sequentially, window-shuffles, and prefetches batch k+1 while step
+    k runs. The device step is calibrated to the pipeline's measured
+    producer cost (the balance point where overlap matters most) and
+    emulated host-idle on CPU (time.sleep — a dispatched TPU step keeps
+    the host free, which one CPU core cannot also fake with real
+    compute); on TPU it is a real jitted matmul stack.
+
+    Gated rows: ingest_examples_per_sec (async, higher-is-better) with
+    the DataLoader-sync and pipeline-sync numbers + speedups as fields,
+    and ingest_data_wait_frac (async, lower-is-better) with the sync
+    fraction alongside — near-zero async data_wait is the point.
+    """
+    import bisect
+    import shutil
+    import tempfile
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.data import write_shards, IngestPipeline
+    from paddle_tpu.data.shards import ShardReader, decode_sample
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.monitor.registry import MetricRegistry
+
+    if on_tpu:
+        n_records, n_shards, batch, dim, window = 65536, 8, 512, 256, 4096
+    else:
+        n_records, n_shards, batch, dim, window = 8192, 4, 256, 128, 1024
+    tmp = tempfile.mkdtemp(prefix='bench_ingest_')
+    try:
+        rng = np.random.RandomState(0)
+        paths = write_shards(
+            ({'x': rng.randn(dim).astype(np.float32),
+              'y': np.int64(i % 10)} for i in range(n_records)),
+            tmp, n_shards)
+
+        class ShardDataset(Dataset):
+            """Random-access view the synchronous baseline indexes."""
+
+            def __init__(self):
+                self.readers = [ShardReader(p, decode=decode_sample)
+                                for p in paths]
+                self.cum = list(np.cumsum([r.records
+                                           for r in self.readers]))
+
+            def __len__(self):
+                return self.cum[-1]
+
+            def __getitem__(self, i):
+                s = bisect.bisect_right(self.cum, i)
+                return self.readers[s].at(
+                    i - (self.cum[s - 1] if s else 0))
+
+        def pipeline(prefetch):
+            return IngestPipeline(paths, batch_size=batch,
+                                  shuffle_window=window, seed=0,
+                                  prefetch=prefetch, device_put=on_tpu,
+                                  registry=MetricRegistry())
+
+        # producer-only epoch: read + decode + shuffle + collate — the
+        # per-batch input cost, which also calibrates the device step
+        p = pipeline(0)
+        t0 = time.time()
+        n_batches = sum(1 for _ in p)
+        step_s = (time.time() - t0) / max(n_batches, 1)
+
+        if on_tpu:
+            w = jnp.asarray(rng.randn(dim, dim).astype(np.float32) * 0.01)
+
+            @jax.jit
+            def unit_step(x, w):
+                return jnp.tanh(x @ w).sum()
+
+            x0 = jnp.zeros((batch, dim), jnp.float32)
+            unit_step(x0, w).block_until_ready()        # compile
+            t0 = time.time()
+            for _ in range(8):
+                unit_step(x0, w).block_until_ready()
+            repeats = max(1, int(round(step_s * 8 / (time.time() - t0))))
+
+            def device_step(b):
+                for _ in range(repeats):
+                    out = unit_step(b['x']._data, w)
+                out.block_until_ready()
+        else:
+            repeats = 0
+
+            def device_step(b):
+                time.sleep(step_s)
+
+        def drive_pipeline(prefetch):
+            pipe = pipeline(prefetch)
+            t0 = time.time()
+            for b in pipe:
+                device_step(b)
+            wall = time.time() - t0
+            return n_records / wall, pipe.last_epoch_stats[
+                'data_wait_frac'], wall
+
+        def drive_dataloader():
+            loader = DataLoader(ShardDataset(), batch_size=batch,
+                                shuffle=True, num_workers=0)
+            t0 = time.time()
+            wait = 0.0
+            it = iter(loader)
+            while True:
+                w0 = time.time()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                wait += time.time() - w0
+                device_step(b)
+            wall = time.time() - t0
+            return n_records / wall, wait / wall, wall
+
+        drive_pipeline(0)                               # warm the path
+        dl_eps, dl_wait, dl_wall = drive_dataloader()
+        sync_eps, sync_wait, sync_wall = drive_pipeline(0)
+        async_eps, async_wait, async_wall = drive_pipeline(2)
+
+        base = {'unit': 'examples/sec', 'records': n_records,
+                'shards': n_shards, 'batch': batch, 'dim': dim,
+                'shuffle_window': window, 'prefetch': 2,
+                'baseline': 'random_access_dataloader',
+                'step_s': round(step_s, 6), 'step_repeats': repeats,
+                'degraded': not on_tpu}
+        return [
+            dict(base, metric='ingest_examples_per_sec',
+                 value=round(async_eps, 2),
+                 dataloader_sync_examples_per_sec=round(dl_eps, 2),
+                 pipeline_sync_examples_per_sec=round(sync_eps, 2),
+                 speedup_vs_dataloader=round(async_eps / dl_eps, 3),
+                 speedup_vs_pipeline_sync=round(async_eps / sync_eps, 3),
+                 async_wall_s=round(async_wall, 4),
+                 sync_wall_s=round(sync_wall, 4),
+                 dataloader_wall_s=round(dl_wall, 4),
+                 # rides on the throughput row so perf_report's bench
+                 # table surfaces input-boundedness alongside examples/s
+                 data_wait_frac=round(async_wait, 4)),
+            dict(base, metric='ingest_data_wait_frac',
+                 value=round(async_wait, 4), unit='ratio',
+                 pipeline_sync_data_wait_frac=round(sync_wait, 4),
+                 dataloader_data_wait_frac=round(dl_wait, 4)),
+        ]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     try:
         _enable_cache()
@@ -1087,7 +1244,8 @@ def main():
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
                bench_serving_gateway_tenants, bench_serving_gateway_qos,
-               bench_supervisor_recovery, bench_capacity_calibration):
+               bench_supervisor_recovery, bench_capacity_calibration,
+               bench_ingest):
         try:
             res = fn(on_tpu)
             for row in (res if isinstance(res, list) else [res]):

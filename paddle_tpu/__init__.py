@@ -64,6 +64,7 @@ from . import utils  # noqa: F401
 from . import dataset  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import monitor  # noqa: F401
+from . import data  # noqa: F401
 
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .framework.io_save import save, load  # noqa: F401

@@ -1,0 +1,17 @@
+"""Streaming ingestion plane: splittable shard format + deterministic
+sharded readers + window shuffle + async double-buffered host->device
+prefetch (docs/data.md).
+
+``shards`` is the storage layer (ShardWriter/ShardReader, canonical
+interleave arithmetic); ``pipeline`` composes it into the checkpointable
+``IngestPipeline`` that Model.fit accepts wherever a DataLoader is.
+"""
+from . import shards
+from .shards import (ShardWriter, ShardReader, ShardCorruptError,
+                     write_shards, list_shards, read_index)
+from .pipeline import (IngestPipeline, IngestCursor, ShardInterleave,
+                       window_shuffle)
+
+__all__ = ['shards', 'ShardWriter', 'ShardReader', 'ShardCorruptError',
+           'write_shards', 'list_shards', 'read_index', 'IngestPipeline',
+           'IngestCursor', 'ShardInterleave', 'window_shuffle']

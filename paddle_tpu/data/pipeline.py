@@ -1,0 +1,558 @@
+"""Streaming ingestion pipeline: sharded readers -> window shuffle ->
+collation -> async double-buffered host->device prefetch.
+
+The stages compose into ONE deterministic stream per ``(seed, epoch)``:
+
+* ``ShardInterleave`` merges per-shard readers in canonical record-level
+  round robin. The order is pure arithmetic over the shard record counts
+  (``shards.interleave_locate``), so reader threads can race on IO while
+  the merged order never moves, and a resume cursor can SEEK every
+  reader to its exact record instead of draining the trained prefix.
+* ``window_shuffle`` permutes fixed windows of the canonical stream with
+  an RNG derived from ``(seed, epoch, window)`` — reproducible, bounded
+  memory (one window of decoded samples), and resumable: emitted
+  position ``r`` lives in window ``r // window`` whose permutation (and
+  pre-draw RNG state, which the cursor checkpoints) is re-derivable
+  without replaying anything before the window.
+* ``_Prefetcher`` runs the whole producer chain (read + decode + shuffle
+  + collate + ``jax.device_put``) on a background thread behind a
+  bounded queue (default depth 2 = double buffering): batch k+1 is
+  decoded and already on device while the dispatched step k runs, so the
+  consumer's ``data_wait`` collapses to a queue pop. Backpressure
+  (producer blocked on a full queue) and consumer wait both land in the
+  ``ingest_*`` metric families.
+
+``IngestPipeline`` is the user-facing object: iterable like a
+``DataLoader`` (one epoch per ``__iter__``, ``len()`` in batches),
+accepted by ``Model.fit`` anywhere a loader is, and checkpointable —
+``cursor()`` / ``restore()`` round-trip the exact stream position
+through the elastic supervisor's ``ResumeCursor`` (docs/data.md).
+"""
+import hashlib
+import queue
+import threading
+import time
+
+import numpy as np
+
+from ..monitor.registry import default_registry
+from ..monitor.telemetry import record_ingest_schema
+from . import shards as _shards
+
+__all__ = ['ShardInterleave', 'window_shuffle', 'IngestCursor',
+           'IngestPipeline']
+
+_CURSOR_FORMAT = 1
+
+
+class ShardInterleave:
+    """Deterministic record-level round-robin merge over a shard set,
+    starting at canonical stream position ``start``.
+
+    ``reader_threads > 0`` assigns shards round robin to that many
+    reader threads (shard i -> thread i % K), each filling its shards'
+    bounded queues one record per round; the merge consumes the queues
+    in canonical order, so thread timing never changes the stream. With
+    0 threads the merge reads inline (the prefetch stage already runs
+    the whole chain off the consumer thread).
+
+    ``trace`` (a list, test hook) records every (shard_index,
+    record_index) in merge order — the record-access log the resume
+    determinism tests pin. ``bytes_read`` returns payload bytes consumed
+    so far (feeds ``ingest_bytes_read_total``).
+    """
+
+    def __init__(self, paths, start=0, reader_threads=0, queue_records=64,
+                 trace=None):
+        self.paths = list(paths)
+        if not self.paths:
+            raise ValueError('ShardInterleave needs at least one shard')
+        self.readers = [_shards.ShardReader(p) for p in self.paths]
+        self.counts = [r.records for r in self.readers]
+        self.total = _shards.interleave_total(self.counts)
+        self.start = int(start)
+        self.reader_threads = max(int(reader_threads), 0)
+        self.queue_records = max(int(queue_records), 1)
+        self.trace = trace
+        self._bytes = 0
+
+    def bytes_read(self):
+        return self._bytes
+
+    def _start_state(self):
+        """Per-shard start record + first round/slot for stream position
+        ``start`` — pure arithmetic, no IO."""
+        if self.start >= self.total:
+            return None
+        shard0, round0 = _shards.interleave_locate(self.counts, self.start)
+        offsets = []
+        for s, c in enumerate(self.counts):
+            if c > round0:
+                offsets.append(round0 + (1 if s < shard0 else 0))
+            else:
+                offsets.append(c)
+        return offsets, round0, shard0
+
+    def __iter__(self):
+        state = self._start_state()
+        if state is None:
+            return
+        offsets, round0, shard0 = state
+        if self.reader_threads:
+            sources = self._threaded_sources(offsets)
+        else:
+            sources = [iter(r.iter_from(off))
+                       for r, off in zip(self.readers, offsets)]
+        try:
+            r = round0
+            max_count = max(self.counts)
+            first = True
+            while r < max_count:
+                for s, c in enumerate(self.counts):
+                    if c <= r:
+                        continue
+                    if first and s < shard0:
+                        continue        # consumed before the start position
+                    first = False
+                    payload = next(sources[s])
+                    self._bytes += len(payload)
+                    if self.trace is not None:
+                        self.trace.append((s, r))
+                    yield payload
+                if first:
+                    # start round had no shard at/after shard0 (can't
+                    # happen — locate() guarantees shard0 is active)
+                    first = False
+                r += 1
+        finally:
+            for src in sources:
+                close = getattr(src, 'close', None)
+                if close is not None:
+                    close()
+
+    # -- threaded readers ---------------------------------------------------
+    def _threaded_sources(self, offsets):
+        """One bounded queue per shard, filled by reader_threads threads
+        (shard i -> thread i % K, each thread round-robining its own
+        shards one record per round so no queue can starve another)."""
+        stop = threading.Event()
+        queues = [queue.Queue(maxsize=self.queue_records)
+                  for _ in self.counts]
+
+        def _fill(shard_ids):
+            its = {s: self.readers[s].iter_from(offsets[s])
+                   for s in shard_ids}
+            remaining = {s: self.counts[s] - offsets[s] for s in shard_ids}
+            while its and not stop.is_set():
+                for s in list(its):
+                    if remaining[s] <= 0:
+                        del its[s]
+                        continue
+                    payload = next(its[s])
+                    remaining[s] -= 1
+                    while not stop.is_set():
+                        try:
+                            queues[s].put(payload, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+
+        threads = []
+        for t in range(min(self.reader_threads, len(self.counts))):
+            ids = list(range(t, len(self.counts), self.reader_threads))
+            th = threading.Thread(target=_fill, args=(ids,), daemon=True,
+                                  name='ingest-reader-%d' % t)
+            th.start()
+            threads.append(th)
+
+        class _Source:
+            def __init__(self, q):
+                self._q = q
+
+            def __next__(self):
+                return self._q.get()
+
+            def close(self):
+                stop.set()
+                # drain so blocked producers can observe the stop flag
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except queue.Empty:
+                    pass
+
+        return [_Source(q) for q in queues]
+
+
+def _window_rng(seed, epoch, window):
+    """The shuffle RNG for one window — re-derivable from coordinates,
+    checkpointable as a state dict (np.random.Generator over PCG64)."""
+    ss = np.random.SeedSequence([0x1D6E57 & 0xFFFFFF, int(seed) & (2**63 - 1),
+                                 int(epoch), int(window)])
+    return np.random.Generator(np.random.PCG64(ss))
+
+
+def window_shuffle(stream, total, window, seed, epoch, start=0,
+                   rng_state=None):
+    """Permute fixed windows of `stream` reproducibly per
+    ``(seed, epoch)``. `stream` must already be positioned at the first
+    record of window ``start // window``; the first ``start % window``
+    entries of that window's permutation are skipped (they were emitted
+    before the checkpoint). ``rng_state`` (cursor-checkpointed pre-draw
+    state of the resumed window) overrides the derived RNG for the first
+    window when given."""
+    window = int(window)
+    if window <= 0:
+        for item in stream:
+            yield item
+        return
+    w = int(start) // window
+    skip = int(start) % window
+    pos = w * window
+    it = iter(stream)
+    while pos < total:
+        size = min(window, total - pos)
+        buf = []
+        for _ in range(size):
+            buf.append(next(it))
+        if rng_state is not None:
+            rng = np.random.Generator(np.random.PCG64())
+            rng.bit_generator.state = rng_state
+            rng_state = None
+        else:
+            rng = _window_rng(seed, epoch, w)
+        for i in rng.permutation(size)[skip:]:
+            yield buf[i]
+        skip = 0
+        pos += size
+        w += 1
+
+
+class IngestCursor:
+    """Exact stream position of an ``IngestPipeline``: epoch, records
+    and batches DELIVERED to the consumer, the pre-draw RNG state of the
+    shuffle window the position lives in, and a fingerprint of the shard
+    set so a cursor can never silently replay against different data."""
+
+    def __init__(self, epoch=0, records=0, batches=0, rng_state=None,
+                 fingerprint=None):
+        self.epoch = int(epoch)
+        self.records = int(records)
+        self.batches = int(batches)
+        self.rng_state = rng_state
+        self.fingerprint = fingerprint
+
+    def to_state(self):
+        return {'format': _CURSOR_FORMAT, 'epoch': self.epoch,
+                'records': self.records, 'batches': self.batches,
+                'rng_state': self.rng_state,
+                'fingerprint': self.fingerprint}
+
+    @classmethod
+    def from_state(cls, state):
+        return cls(epoch=state['epoch'], records=state['records'],
+                   batches=state.get('batches', 0),
+                   rng_state=state.get('rng_state'),
+                   fingerprint=state.get('fingerprint'))
+
+    def __repr__(self):
+        return ('IngestCursor(epoch=%d, records=%d, batches=%d)'
+                % (self.epoch, self.records, self.batches))
+
+
+class _Halt(Exception):
+    """Producer-side stop signal (consumer closed the epoch early)."""
+
+
+class _Prefetcher:
+    """Bounded hand-off queue between the producer chain (background
+    thread) and the consumer. Depth 2 is double buffering: one batch in
+    the consumer's hands, one staged on device, producer working on the
+    third. Exceptions cross the queue and re-raise at the consumer."""
+
+    _DONE = object()
+
+    def __init__(self, producer_iter, depth, fams):
+        self._q = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop = threading.Event()
+        self._fams = fams
+        self._thread = threading.Thread(target=self._run,
+                                        args=(producer_iter,),
+                                        daemon=True, name='ingest-prefetch')
+        self._thread.start()
+
+    def _run(self, it):
+        backpressure = self._fams['ingest_backpressure_seconds_total']
+        try:
+            for item in it:
+                self._put(('item', item), backpressure)
+            self._put(('done', None), backpressure)
+        except _Halt:
+            pass
+        except BaseException as e:                 # noqa: BLE001
+            try:
+                self._put(('error', e), backpressure)
+            except _Halt:
+                pass
+
+    def _put(self, msg, backpressure):
+        t0 = None
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.05)
+                if t0 is not None:
+                    backpressure.inc(time.monotonic() - t0)
+                return
+            except queue.Full:
+                if t0 is None:
+                    t0 = time.monotonic()
+        raise _Halt()
+
+    def get(self):
+        """(kind, payload) — blocks until the producer delivers."""
+        msg = self._q.get()
+        self._fams['ingest_queue_depth'].set(self._q.qsize())
+        return msg
+
+    def close(self):
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+
+def _np_stack_collate(batch):
+    from ..io.dataloader import _np_collate
+    return _np_collate(batch)
+
+
+def _tensorize(tree, device_put):
+    """numpy tree -> Tensor tree, optionally staging arrays on device in
+    the producer thread (so the consumer's step never pays the
+    host->device copy)."""
+    from ..framework.core import Tensor
+    if isinstance(tree, np.ndarray):
+        if device_put:
+            import jax
+            return Tensor(jax.device_put(tree))
+        return Tensor(tree)
+    if isinstance(tree, list):
+        return [_tensorize(t, device_put) for t in tree]
+    if isinstance(tree, tuple):
+        return tuple(_tensorize(t, device_put) for t in tree)
+    if isinstance(tree, dict):
+        return {k: _tensorize(v, device_put) for k, v in tree.items()}
+    return tree
+
+
+class IngestPipeline:
+    """High-throughput streaming loader over a shard set.
+
+    Parameters mirror the stages: ``shuffle_window`` (records; 0 = no
+    shuffle) and ``seed`` drive the reproducible window shuffle,
+    ``prefetch`` is the hand-off queue depth (0 = fully synchronous —
+    the baseline the bench rung compares against), ``device_put`` stages
+    batches on device from the producer thread, ``reader_threads``
+    parallelizes shard IO, ``decode`` turns record bytes into a sample
+    (default: the pickle codec ``shards.decode_sample``).
+
+    One epoch per ``__iter__`` (the ``DataLoader`` contract). After each
+    full epoch the pipeline advances its epoch counter, so consecutive
+    iterations see different shuffles; ``set_epoch`` pins it (elastic
+    schedulers, evaluation replays).
+    """
+
+    def __init__(self, shard_paths, batch_size=1, shuffle_window=0,
+                 seed=0, drop_last=False, collate_fn=None, decode=None,
+                 prefetch=2, device_put=True, reader_threads=0,
+                 registry=None, record_trace=None):
+        if isinstance(shard_paths, str):
+            shard_paths = _shards.list_shards(shard_paths)
+        self.paths = list(shard_paths)
+        if not self.paths:
+            raise ValueError('IngestPipeline needs at least one shard')
+        self.counts = [int(_shards.read_index(p)['records'])
+                       for p in self.paths]
+        self.total = sum(self.counts)
+        self.batch_size = int(batch_size)
+        self.shuffle_window = int(shuffle_window)
+        self.seed = int(seed)
+        self.drop_last = bool(drop_last)
+        self.collate_fn = collate_fn
+        self.decode = decode if decode is not None \
+            else _shards.decode_sample
+        self.prefetch = max(int(prefetch), 0)
+        self.device_put = bool(device_put)
+        self.reader_threads = max(int(reader_threads), 0)
+        self.record_trace = record_trace
+        self._fams = record_ingest_schema(
+            registry if registry is not None else default_registry())
+        self._epoch = 0
+        self._delivered_records = 0
+        self._delivered_batches = 0
+        self._resume = None
+        self.last_wait_s = 0.0
+        self.last_epoch_stats = None
+
+    # -- identity ----------------------------------------------------------
+    def fingerprint(self):
+        h = hashlib.sha1()
+        for p, c in zip(self.paths, self.counts):
+            h.update(('%s:%d|' % (p.rsplit('/', 1)[-1], c)).encode())
+        return h.hexdigest()
+
+    def __len__(self):
+        if self.drop_last:
+            return self.total // self.batch_size
+        return -(-self.total // self.batch_size)
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def set_epoch(self, epoch):
+        self._epoch = int(epoch)
+
+    # -- checkpointing ------------------------------------------------------
+    def cursor(self):
+        """Exact position AFTER the last batch the consumer took from
+        ``__iter__``. The RNG state is the pre-draw generator state of
+        the shuffle window the next record lives in — checkpointed so a
+        restore replays the identical permutation even if RNG-derivation
+        details drift."""
+        rng_state = None
+        if self.shuffle_window > 0 and self._delivered_records < self.total:
+            w = self._delivered_records // self.shuffle_window
+            rng_state = _window_rng(self.seed, self._epoch,
+                                    w).bit_generator.state
+        return IngestCursor(epoch=self._epoch,
+                            records=self._delivered_records,
+                            batches=self._delivered_batches,
+                            rng_state=rng_state,
+                            fingerprint=self.fingerprint())
+
+    def restore(self, cursor):
+        """Stage a cursor (or its ``to_state()`` dict): the NEXT
+        ``__iter__`` seeks to the exact stream position instead of
+        starting the epoch from the top."""
+        if isinstance(cursor, dict):
+            cursor = IngestCursor.from_state(cursor)
+        if cursor.fingerprint and cursor.fingerprint != self.fingerprint():
+            raise ValueError(
+                'ingest cursor fingerprint %s does not match this shard '
+                'set (%s) — refusing to resume against different data'
+                % (cursor.fingerprint[:12], self.fingerprint()[:12]))
+        if not 0 <= cursor.records <= self.total:
+            raise ValueError('cursor records %d out of range (total %d)'
+                             % (cursor.records, self.total))
+        self._resume = cursor
+        return cursor
+
+    # -- the stream ---------------------------------------------------------
+    def _producer(self, epoch, start_records, trace):
+        """Decoded-sample stream -> batches -> collate -> tensorize.
+        Runs entirely on the producer side of the hand-off queue."""
+        if self.shuffle_window > 0:
+            stream_start = (start_records // self.shuffle_window) \
+                * self.shuffle_window
+        else:
+            stream_start = start_records
+        rng_state = None
+        if self._resume_rng_state is not None:
+            rng_state = self._resume_rng_state
+            self._resume_rng_state = None
+        inter = ShardInterleave(self.paths, start=stream_start,
+                                reader_threads=self.reader_threads,
+                                trace=trace)
+        records = window_shuffle(inter, self.total, self.shuffle_window,
+                                 self.seed, epoch, start=start_records,
+                                 rng_state=rng_state)
+        bytes_fam = self._fams['ingest_bytes_read_total']
+        batch, seen_bytes = [], 0
+        for payload in records:
+            batch.append(self.decode(payload))
+            if len(batch) == self.batch_size:
+                yield self._finish_batch(batch)
+                batch = []
+                nb = inter.bytes_read()
+                bytes_fam.inc(nb - seen_bytes)
+                seen_bytes = nb
+        if batch and not self.drop_last:
+            yield self._finish_batch(batch)
+        bytes_fam.inc(inter.bytes_read() - seen_bytes)
+
+    def _finish_batch(self, samples):
+        n = len(samples)
+        if self.collate_fn is not None:
+            return n, self.collate_fn(samples)
+        return n, _tensorize(_np_stack_collate(samples), self.device_put)
+
+    def __iter__(self):
+        cursor, self._resume = self._resume, None
+        start_records = 0
+        self._resume_rng_state = None
+        if cursor is not None:
+            self._epoch = cursor.epoch
+            start_records = cursor.records
+            self._resume_rng_state = cursor.rng_state
+            self._fams['ingest_resumes_total'].inc()
+        epoch = self._epoch
+        self._delivered_records = start_records
+        self._delivered_batches = cursor.batches if cursor is not None \
+            else 0
+        trace = self.record_trace
+        producer = self._producer(epoch, start_records, trace)
+        rec_fam = self._fams['ingest_records_total']
+        batch_fam = self._fams['ingest_batches_total']
+        wait_fam = self._fams['ingest_wait_seconds_total']
+        prefetcher = _Prefetcher(producer, self.prefetch, self._fams) \
+            if self.prefetch else None
+        wait_s = 0.0
+        t_epoch = time.monotonic()
+        try:
+            while True:
+                t0 = time.monotonic()
+                if prefetcher is not None:
+                    kind, payload = prefetcher.get()
+                    if kind == 'done':
+                        break
+                    if kind == 'error':
+                        raise payload
+                    n, batch = payload
+                else:
+                    try:
+                        n, batch = next(producer)
+                    except StopIteration:
+                        break
+                dt = time.monotonic() - t0
+                self.last_wait_s = dt
+                wait_s += dt
+                wait_fam.inc(dt)
+                self._delivered_records += n
+                self._delivered_batches += 1
+                rec_fam.inc(n)
+                batch_fam.inc()
+                yield batch
+            # epoch completed in full: advance and publish epoch stats
+            wall = time.monotonic() - t_epoch
+            delivered = self._delivered_records - start_records
+            self.last_epoch_stats = {
+                'epoch': epoch, 'records': delivered,
+                'batches': self._delivered_batches,
+                'wall_s': wall, 'wait_s': wait_s,
+                'data_wait_frac': (wait_s / wall) if wall > 0 else 0.0,
+                'examples_per_sec': (delivered / wall) if wall > 0
+                else 0.0,
+            }
+            self._fams['ingest_examples_per_second'].set(
+                self.last_epoch_stats['examples_per_sec'])
+            self._fams['ingest_epochs_total'].inc()
+            self._epoch = epoch + 1
+            self._delivered_records = 0
+            self._delivered_batches = 0
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()

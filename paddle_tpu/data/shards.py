@@ -1,0 +1,348 @@
+"""Splittable on-disk shard format for the streaming ingestion plane.
+
+A shard is a flat file of length-prefixed records:
+
+    magic b'PTSHARD1' | u32 count-placeholder | records...
+    record = u32 LE payload length | payload bytes
+
+plus a small JSON index sidecar (``<path>.idx``) written through
+``framework.io_save.write_bytes_atomic`` (write-temp + fsync + rename,
+so a preempted writer never tears a sidecar a reader trusts). The
+sidecar carries the record count, total payload bytes, a CRC32 of the
+data file and byte offsets every ``index_stride`` records — enough to
+seek a reader to ANY record in O(stride) without scanning the file,
+which is what makes shards splittable across workers and resumable from
+a checkpointed ``(shard, record)`` cursor.
+
+The shard file itself is also renamed into place atomically: readers
+only ever see complete shards. Records are raw bytes; ``encode_sample``
+/ ``decode_sample`` are the default pickle codec for structured samples
+(numpy-tree-safe), and callers with fixed-layout records (the bench
+rung's raw float32 rows) pass their own ``decode=``.
+"""
+import glob
+import json
+import os
+import pickle
+import struct
+import zlib
+
+from ..framework.io_save import write_bytes_atomic
+
+__all__ = ['MAGIC', 'ShardWriter', 'ShardReader', 'ShardCorruptError',
+           'encode_sample', 'decode_sample', 'index_path', 'read_index',
+           'list_shards', 'write_shards', 'interleave_total',
+           'interleave_locate']
+
+MAGIC = b'PTSHARD1'
+_LEN = struct.Struct('<I')
+_INDEX_FORMAT = 1
+
+
+class ShardCorruptError(IOError):
+    """Shard bytes disagree with the index sidecar (truncated / torn /
+    bit-flipped shard)."""
+
+
+def encode_sample(sample):
+    """Default record codec: pickle with numpy leaves (io_save's wire
+    shape, minus the Tensor wrapping — samples are host data)."""
+    return pickle.dumps(sample, protocol=4)
+
+
+def decode_sample(record):
+    return pickle.loads(record)
+
+
+def index_path(path):
+    return path + '.idx'
+
+
+class ShardWriter:
+    """Append records, then ``close()`` (or use as a context manager) to
+    rename the shard into place and publish its index sidecar. Nothing
+    is visible at `path` until close — a died writer leaves only temp
+    droppings, never a half-shard."""
+
+    def __init__(self, path, index_stride=128):
+        self.path = path
+        self.index_stride = max(int(index_stride), 1)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._tmp = '%s.tmp.%d' % (path, os.getpid())
+        self._f = open(self._tmp, 'wb')
+        self._f.write(MAGIC)
+        self._offsets = []            # byte offset of records 0, S, 2S...
+        self._count = 0
+        self._payload_bytes = 0
+        self._crc = 0
+        self._closed = False
+
+    def append(self, record):
+        """Append one record. Bytes pass through; anything else goes
+        through encode_sample."""
+        if self._closed:
+            raise ValueError('ShardWriter already closed')
+        if not isinstance(record, (bytes, bytearray, memoryview)):
+            record = encode_sample(record)
+        record = bytes(record)
+        if self._count % self.index_stride == 0:
+            self._offsets.append(self._f.tell())
+        header = _LEN.pack(len(record))
+        self._f.write(header)
+        self._f.write(record)
+        self._crc = zlib.crc32(record, zlib.crc32(header, self._crc))
+        self._count += 1
+        self._payload_bytes += len(record)
+        return self._count - 1
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        # data first, then index: a crash between the two leaves a shard
+        # without a sidecar, which readers refuse by default — the
+        # conservative outcome (same ordering rule as io_save.save).
+        os.replace(self._tmp, self.path)
+        index = {'format': _INDEX_FORMAT,
+                 'records': self._count,
+                 'payload_bytes': self._payload_bytes,
+                 'crc32': self._crc & 0xFFFFFFFF,
+                 'index_stride': self.index_stride,
+                 'offsets': self._offsets}
+        write_bytes_atomic(index_path(self.path),
+                           json.dumps(index, sort_keys=True).encode())
+
+    def abort(self):
+        """Drop the in-progress shard without publishing it."""
+        if self._closed:
+            return
+        self._closed = True
+        self._f.close()
+        try:
+            os.remove(self._tmp)
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()
+
+    def __len__(self):
+        return self._count
+
+
+def read_index(path, verify=False):
+    """The shard's index sidecar dict. ``verify=True`` additionally
+    CRCs the record stream against it (full file read — restore-time
+    paranoia, not per-iterator overhead)."""
+    try:
+        with open(index_path(path)) as f:
+            index = json.load(f)
+    except (OSError, ValueError) as e:
+        raise ShardCorruptError('shard %s has no readable index sidecar '
+                                '(%s) — writer died before publishing, '
+                                'or a foreign file' % (path, e))
+    if verify:
+        crc = 0
+        with open(path, 'rb') as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ShardCorruptError('%s: bad magic' % path)
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+        if crc & 0xFFFFFFFF != index.get('crc32'):
+            raise ShardCorruptError('%s does not match its index CRC — '
+                                    'truncated or torn shard' % path)
+    return index
+
+
+class ShardReader:
+    """Sequential + seekable reader over one shard.
+
+    ``iter_from(record)`` seeks via the strided offset table (O(stride)
+    skip, no scan) — the door the resume cursor and worker splits use.
+    """
+
+    def __init__(self, path, decode=None):
+        self.path = path
+        self.decode = decode
+        self.index = read_index(path)
+        self.records = int(self.index['records'])
+        self._stride = int(self.index.get('index_stride') or 1)
+        self._offsets = self.index.get('offsets') or []
+        self._rf = None               # lazy persistent handle for at()
+
+    def __len__(self):
+        return self.records
+
+    def _open(self):
+        f = open(self.path, 'rb')
+        if f.read(len(MAGIC)) != MAGIC:
+            f.close()
+            raise ShardCorruptError('%s: bad magic' % self.path)
+        return f
+
+    def _read_record(self, f):
+        header = f.read(_LEN.size)
+        if len(header) < _LEN.size:
+            raise ShardCorruptError('%s: truncated record header'
+                                    % self.path)
+        (n,) = _LEN.unpack(header)
+        payload = f.read(n)
+        if len(payload) < n:
+            raise ShardCorruptError('%s: truncated record payload'
+                                    % self.path)
+        return payload
+
+    def iter_from(self, record=0):
+        """Yield records starting at index `record` (decoded when the
+        reader has a codec)."""
+        record = int(record)
+        if record >= self.records:
+            return
+        with self._open() as f:
+            if self._offsets:
+                slot = min(record // self._stride, len(self._offsets) - 1)
+                f.seek(self._offsets[slot])
+                skip = record - slot * self._stride
+            else:
+                skip = record
+            for _ in range(skip):
+                self._read_record(f)
+            for _ in range(record, self.records):
+                payload = self._read_record(f)
+                yield self.decode(payload) if self.decode else payload
+
+    def __iter__(self):
+        return self.iter_from(0)
+
+    def read(self, record):
+        """One record by index."""
+        for payload in self.iter_from(record):
+            return payload
+        raise IndexError('record %d out of range (shard has %d)'
+                         % (record, self.records))
+
+    def at(self, record):
+        """Random-access one record through a lazily-opened persistent
+        handle: seek to the strided offset, skip to the record, read.
+        This is what sampler-driven random access over a record stream
+        costs — O(stride/2) records skipped per call, the read
+        amplification the streaming interleave exists to avoid."""
+        record = int(record)
+        if not 0 <= record < self.records:
+            raise IndexError('record %d out of range (shard has %d)'
+                             % (record, self.records))
+        if self._rf is None:
+            self._rf = self._open()
+        f = self._rf
+        if self._offsets:
+            slot = min(record // self._stride, len(self._offsets) - 1)
+            f.seek(self._offsets[slot])
+            skip = record - slot * self._stride
+        else:
+            f.seek(len(MAGIC))
+            skip = record
+        for _ in range(skip):
+            self._read_record(f)
+        payload = self._read_record(f)
+        return self.decode(payload) if self.decode else payload
+
+    def close(self):
+        f, self._rf = self._rf, None
+        if f is not None:
+            f.close()
+
+
+def list_shards(pattern_or_dir):
+    """Sorted shard paths from a directory (every *.shard with a
+    sidecar) or a glob pattern."""
+    if os.path.isdir(pattern_or_dir):
+        pattern = os.path.join(pattern_or_dir, '*.shard')
+    else:
+        pattern = pattern_or_dir
+    out = []
+    for p in sorted(glob.glob(pattern)):
+        if os.path.exists(index_path(p)):
+            out.append(p)
+    return out
+
+
+def write_shards(samples, directory, num_shards, prefix='part',
+                 index_stride=128):
+    """Split an in-memory iterable round-robin across `num_shards` shard
+    files (the same record-level round robin ShardInterleave reads back,
+    so write-then-stream round-trips in order). Returns the paths."""
+    num_shards = max(int(num_shards), 1)
+    paths = [os.path.join(directory, '%s-%05d-of-%05d.shard'
+                          % (prefix, i, num_shards))
+             for i in range(num_shards)]
+    writers = [ShardWriter(p, index_stride=index_stride) for p in paths]
+    try:
+        for i, sample in enumerate(samples):
+            writers[i % num_shards].append(sample)
+        for w in writers:
+            w.close()
+    except BaseException:
+        for w in writers:
+            w.abort()
+        raise
+    return paths
+
+
+# -- canonical interleave arithmetic -----------------------------------------
+#
+# The pipeline's canonical stream order over a shard set is record-level
+# round robin in shard order: round r takes one record from every shard
+# that still has more than r records. The order is a pure function of
+# the per-shard record counts, so "global position p" maps to a concrete
+# (shard, record) without reading anything — that is what lets a resume
+# cursor seek instead of draining, and lets reader threads fill
+# per-shard queues in any timing while the merge stays deterministic.
+
+def interleave_total(counts):
+    return int(sum(counts))
+
+
+def _consumed_before_round(counts, r):
+    """Records emitted by all rounds strictly before round r."""
+    return int(sum(min(int(c), r) for c in counts))
+
+
+def interleave_locate(counts, position):
+    """(shard_index, record_index) of canonical stream `position` for a
+    shard set with per-shard record `counts`."""
+    position = int(position)
+    total = interleave_total(counts)
+    if not 0 <= position < total:
+        raise IndexError('position %d out of range (total %d)'
+                         % (position, total))
+    # binary search the round: largest r with consumed_before(r) <= position
+    lo, hi = 0, max(int(c) for c in counts)
+    while lo < hi:
+        mid = (lo + hi + 1) // 2
+        if _consumed_before_round(counts, mid) <= position:
+            lo = mid
+        else:
+            hi = mid - 1
+    r = lo
+    within = position - _consumed_before_round(counts, r)
+    for shard, c in enumerate(counts):
+        if int(c) > r:
+            if within == 0:
+                return shard, r
+            within -= 1
+    raise AssertionError('interleave_locate arithmetic broke: '
+                         'position=%d counts=%r' % (position, counts))

@@ -78,15 +78,20 @@ class ResumeCursor:
     (before the loader iterator was built — replaying it re-draws the
     identical shuffle permutation); ``rng`` is the capture at the
     checkpoint instant (re-seated after fast-forwarding the loader).
+    ``ingest`` is the attached streaming pipeline's ``IngestCursor``
+    state dict (exact shard/record/shuffle position) when training reads
+    from ``data.IngestPipeline`` — resume then SEEKS the stream instead
+    of draining the trained prefix batch by batch.
     """
 
     def __init__(self, epoch=0, step=0, global_step=0, epoch_rng=None,
-                 rng=None):
+                 rng=None, ingest=None):
         self.epoch = int(epoch)
         self.step = int(step)
         self.global_step = int(global_step)
         self.epoch_rng = epoch_rng
         self.rng = rng
+        self.ingest = ingest
 
     @staticmethod
     def capture_rng():
@@ -104,14 +109,16 @@ class ResumeCursor:
     def to_state(self):
         return {'epoch': self.epoch, 'step': self.step,
                 'global_step': self.global_step,
-                'epoch_rng': self.epoch_rng, 'rng': self.rng}
+                'epoch_rng': self.epoch_rng, 'rng': self.rng,
+                'ingest': self.ingest}
 
     @classmethod
     def from_state(cls, state):
         return cls(epoch=state['epoch'], step=state['step'],
                    global_step=state['global_step'],
                    epoch_rng=state.get('epoch_rng'),
-                   rng=state.get('rng'))
+                   rng=state.get('rng'),
+                   ingest=state.get('ingest'))
 
     def __repr__(self):
         return ('ResumeCursor(epoch=%d, step=%d, global_step=%d)'
@@ -253,7 +260,16 @@ class TrainingSupervisor:
         self._m_preempt = fams['supervisor_preemptions_total']
         self._epoch_rng = None
         self._cursor = None           # pending resume cursor
+        self._pipeline = None         # attached data.IngestPipeline
         self.last_saved_step = None
+
+    def attach_pipeline(self, pipeline):
+        """Register the streaming pipeline feeding the supervised fit:
+        checkpoints then embed its exact stream cursor, and resume SEEKS
+        the pipeline (shard/record/shuffle-window position) instead of
+        draining the trained prefix through ``fast_forward``."""
+        self._pipeline = pipeline
+        return pipeline
 
     # -- checkpoint side ----------------------------------------------------
     def _state_dict(self, model, cursor):
@@ -267,10 +283,14 @@ class TrainingSupervisor:
         """Write a checkpoint capturing model + optimizer + cursor. The
         cursor's RNG pair is captured HERE — at a step boundary — so a
         resumed run re-enters the exact RNG stream."""
+        ingest = None
+        if self._pipeline is not None:
+            ingest = self._pipeline.cursor().to_state()
         cursor = ResumeCursor(epoch=epoch, step=step,
                               global_step=global_step,
                               epoch_rng=self._epoch_rng,
-                              rng=ResumeCursor.capture_rng())
+                              rng=ResumeCursor.capture_rng(),
+                              ingest=ingest)
         self.manager.save(global_step, self._state_dict(model, cursor))
         self._m_ckpts.labels(kind).inc()
         self.last_saved_step = global_step
@@ -296,6 +316,10 @@ class TrainingSupervisor:
         if model._optimizer is not None and 'optimizer' in state:
             model._optimizer.set_state_dict(state['optimizer'])
         self._cursor = ResumeCursor.from_state(state['cursor'])
+        if self._cursor.ingest is not None and self._pipeline is not None:
+            # stage the seek NOW: the pipeline's next __iter__ resumes
+            # at the exact stream position, so fast_forward won't drain
+            self._pipeline.restore(self._cursor.ingest)
         return self._cursor
 
     def begin_epoch(self, epoch):
@@ -316,8 +340,13 @@ class TrainingSupervisor:
         cursor, self._cursor = self._cursor, None
         if cursor is None:
             return 0
-        for _ in range(cursor.step):
-            next(data_iter)
+        if cursor.ingest is None or self._pipeline is None:
+            # plain loaders re-shuffle from epoch_rng, so the trained
+            # prefix must be drained to reach the right position
+            for _ in range(cursor.step):
+                next(data_iter)
+        # pipelines were staged in restore(): their iterator is already
+        # seeking to cursor.ingest — nothing to drain
         if cursor.rng is not None:
             ResumeCursor.restore_rng(cursor.rng)
         return cursor.step

@@ -22,7 +22,7 @@ import numpy as np
 from .core import Tensor, Parameter
 
 __all__ = ['save', 'load', 'CheckpointCorruptError', 'manifest_path',
-           'verify_checkpoint']
+           'verify_checkpoint', 'write_bytes_atomic']
 
 _PROTOCOL = 4
 _MANIFEST_FORMAT = 1
@@ -97,6 +97,17 @@ def _write_atomic(path, data):
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def write_bytes_atomic(path, data):
+    """Public door to the atomic byte-writer for small non-pickle
+    artifacts that ride next to data files (shard index sidecars,
+    JSON manifests): same write-temp + fsync + rename discipline as
+    save(), so readers never observe a torn sidecar."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    _write_atomic(path, data)
 
 
 def save(obj, path, protocol=_PROTOCOL, **configs):

@@ -183,6 +183,17 @@ class Model:
                 logs = {}
                 if supervisor is not None:
                     supervisor.begin_epoch(epoch)
+                if hasattr(train_loader, 'set_epoch'):
+                    # pin streaming pipelines (data.IngestPipeline) to
+                    # fit's epoch counter so their per-epoch shuffle
+                    # tracks the loop, not their own iteration count. A
+                    # staged resume cursor overrides this inside iter().
+                    train_loader.set_epoch(epoch)
+                # pipelines that prefetch overlap producer work with the
+                # dispatched step, so raw next() time would under- or
+                # over-charge input: take their measured queue-wait
+                # instead (the honest data_wait under overlap)
+                pipe_wait = hasattr(train_loader, 'last_wait_s')
                 data_iter = iter(train_loader)
                 step = 0
                 if cursor is not None and epoch == cursor.epoch:
@@ -190,8 +201,13 @@ class Model:
                     cursor = None
                 while True:
                     try:
-                        with tl.phase('data_wait'):
+                        if pipe_wait:
                             batch = next(data_iter)
+                            tl.record('data_wait',
+                                      train_loader.last_wait_s)
+                        else:
+                            with tl.phase('data_wait'):
+                                batch = next(data_iter)
                     except StopIteration:
                         tl.discard()
                         break

@@ -18,7 +18,8 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_train_loop_schema', 'record_fleet_schema',
            'record_alert_schema', 'record_supervisor_schema',
            'record_request_event_schema', 'record_tenant_schema',
-           'record_qos_schema', 'record_capacity_schema', 'snapshot_line',
+           'record_qos_schema', 'record_capacity_schema',
+           'record_ingest_schema', 'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -575,6 +576,45 @@ def record_capacity_schema(registry):
     return out
 
 
+# the streaming ingestion plane's families (paddle_tpu/data/). Single-
+# source rule: IngestPipeline and the schema baseline both register
+# through record_ingest_schema. Unlabeled — a pipeline is a per-process
+# object; per-shard and per-epoch detail lives in bench rows and the
+# cursor, never in labels.
+INGEST_FAMILIES = (
+    ('counter', 'ingest_records_total',
+     'records emitted downstream by the ingestion pipeline'),
+    ('counter', 'ingest_batches_total',
+     'collated batches delivered to the consumer'),
+    ('counter', 'ingest_bytes_read_total',
+     'shard payload bytes read off disk'),
+    ('gauge', 'ingest_queue_depth',
+     'prefetched batches parked in the bounded hand-off queue'),
+    ('counter', 'ingest_backpressure_seconds_total',
+     'producer seconds blocked on a full prefetch queue '
+     '(consumer is the bottleneck)'),
+    ('counter', 'ingest_wait_seconds_total',
+     'consumer seconds blocked waiting for a batch '
+     '(the data_wait the StepTimeline charges to input)'),
+    ('gauge', 'ingest_examples_per_second',
+     'examples/s over the last completed epoch'),
+    ('counter', 'ingest_epochs_total',
+     'epochs fully streamed by the pipeline'),
+    ('counter', 'ingest_resumes_total',
+     'mid-epoch cursor restores (seek, not drain)'),
+)
+
+
+def record_ingest_schema(registry):
+    """Register the streaming-ingestion families on `registry` and
+    return {name: family}. Used by IngestPipeline at construction and by
+    dryrun_registry so the committed baseline covers ingestion."""
+    out = {}
+    for kind, name, doc in INGEST_FAMILIES:
+        out[name] = getattr(registry, kind)(name, doc)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -599,6 +639,7 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_tenant_schema(reg)
     record_qos_schema(reg)
     record_capacity_schema(reg)
+    record_ingest_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

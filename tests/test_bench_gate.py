@@ -270,6 +270,48 @@ def test_repo_cache_rows_pin_cold_start_win():
     assert gate.check(rows, rows, trust_degraded=True) == []
 
 
+def test_ingest_metric_directions():
+    """The ingest rung's two gated metrics regress in opposite
+    directions: examples/s down, data_wait_frac up."""
+    eps = _row(50000.0, metric='ingest_examples_per_sec',
+               unit='examples/sec')
+    frac = _row(0.05, metric='ingest_data_wait_frac', unit='ratio')
+    assert gate.higher_is_better(eps)
+    assert not gate.higher_is_better(frac)
+    slower = [_row(30000.0, metric='ingest_examples_per_sec',
+                   unit='examples/sec')]
+    assert gate.check(slower, [eps])           # -40% throughput fails
+    starved = [_row(0.2, metric='ingest_data_wait_frac', unit='ratio')]
+    assert gate.check(starved, [frac])         # 4x more waiting fails
+    better = [_row(0.04, metric='ingest_data_wait_frac', unit='ratio')]
+    assert gate.check(better, [frac]) == []    # less waiting passes
+
+
+def test_repo_ingest_rows_pin_async_win():
+    """The committed CPU ingest capture (docs/bench_ingest_cpu.jsonl,
+    measured by bench_extra.bench_ingest against a synchronous
+    random-access DataLoader over the same disk-resident shards): the
+    async pipeline holds >=2x throughput with near-zero data_wait, the
+    rows are invisible to the default (TPU-only) gate, and the file
+    self-gates under --trust-degraded."""
+    path = os.path.join(_REPO, 'docs', 'bench_ingest_cpu.jsonl')
+    rows = gate._load_jsonl(path)
+    assert rows, 'missing committed ingest bench rows'
+    assert all(gate.eligible(r, trust_degraded=True) for r in rows)
+    assert not any(gate.eligible(r) for r in rows)
+    by_metric = {r['metric']: r for r in rows}
+    eps = by_metric['ingest_examples_per_sec']
+    frac = by_metric['ingest_data_wait_frac']
+    assert eps['speedup_vs_dataloader'] >= 2.0
+    assert eps['speedup_vs_pipeline_sync'] > 1.0
+    assert frac['value'] <= 0.15               # near-zero async data_wait
+    assert frac['value'] < frac['pipeline_sync_data_wait_frac']
+    assert frac['value'] < frac['dataloader_data_wait_frac']
+    # the frac also rides the throughput row for perf_report's table
+    assert eps['data_wait_frac'] == frac['value']
+    assert gate.check(rows, rows, trust_degraded=True) == []
+
+
 def test_repo_stored_best_passes_gate():
     """In-suite rung: the stored in-window logs, replayed as a 'new'
     capture against themselves, must pass — if this fails the stored

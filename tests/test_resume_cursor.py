@@ -142,3 +142,62 @@ def test_resumed_run_consumes_identical_batch_order(tmp_path):
     # drains the trained prefix) — so its access log must equal the
     # reference run's from the top of epoch 1
     assert data_b.accessed == data_ref.accessed[per_epoch:]
+
+
+def test_pipeline_kill_resume_is_bit_identical(tmp_path):
+    """ISSUE 18: mid-epoch kill with a LIVE shuffle buffer (the resume
+    position is not window aligned) through the streaming IngestPipeline
+    + supervisor. Unlike the DataLoader path above, the pipeline does
+    not re-read the trained prefix: the cursor SEEKS every shard reader,
+    so the resumed record-access log must equal the reference run's
+    suffix from the resumed window — and the final weights must be
+    bit-identical to the uninterrupted run's."""
+    from paddle_tpu.data import IngestPipeline, write_shards
+
+    rng = np.random.RandomState(3)
+    samples = [(x, y) for x, y in zip(rng.randn(48, 4).astype(np.float32),
+                                      rng.randn(48, 1).astype(np.float32))]
+    paths = write_shards(samples, str(tmp_path / 'shards'), 4)
+    window, bs = 16, 4
+
+    def run(trace, supervisor=None, callbacks=None):
+        pipe = IngestPipeline(paths, batch_size=bs, shuffle_window=window,
+                              seed=11, record_trace=trace)
+        if supervisor is not None:
+            supervisor.attach_pipeline(pipe)
+        m = _build_model()
+        m.fit(pipe, epochs=2, verbose=0, supervisor=supervisor,
+              callbacks=callbacks)
+        return m
+
+    ref_trace = []
+    m_ref = run(ref_trace)
+    ref_params = [np.asarray(p) for p in m_ref.network.parameters()]
+
+    class _Kill(Callback):
+        seen = 0
+
+        def on_train_batch_end(self, step, logs=None):
+            _Kill.seen += 1
+            if _Kill.seen == 19:         # 7 steps into epoch 1
+                raise KeyboardInterrupt()
+
+    trace_a = []
+    sup_a = TrainingSupervisor(str(tmp_path / 'ckpt'), save_every_steps=1)
+    with pytest.raises(KeyboardInterrupt):
+        run(trace_a, supervisor=sup_a, callbacks=[_Kill()])
+    # last on_step ran after batch 18 = epoch 1 step 6 -> 24 records
+    assert sup_a.last_saved_step == 18
+
+    trace_b = []
+    np.random.seed(999)                  # wrong seed: the cursor must win
+    sup_b = TrainingSupervisor(str(tmp_path / 'ckpt'), save_every_steps=1)
+    m_b = run(trace_b, supervisor=sup_b)
+
+    for a, b in zip(ref_params,
+                    [np.asarray(p) for p in m_b.network.parameters()]):
+        assert np.array_equal(a, b)
+    # access log: 24 delivered records live in window 1, so the resumed
+    # pipeline seeks the readers to stream position 16 of epoch 1 — the
+    # reference epoch-1 trace (after its 48-record epoch 0) from there
+    assert trace_b == ref_trace[48 + 16:]

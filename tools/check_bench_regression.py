@@ -113,6 +113,14 @@ def higher_is_better(row):
     if 'min_replicas' in text:
         # capacity answer: fewer replicas for the same SLO is better
         return False
+    if 'data_wait' in text:
+        # ingest rung: fraction of step wall blocked on input — the
+        # number the async prefetcher exists to drive to zero
+        return False
+    if 'examples_per_sec' in text:
+        # ingest throughput (explicit so a future unit rename can't
+        # flip it into the latency default)
+        return True
     return not ('ms' in text.split() or 'latency' in text
                 or text.endswith('_ms') or 'compile' in text)
 

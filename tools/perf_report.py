@@ -46,9 +46,16 @@ from paddle_tpu.monitor.telemetry import parse_snapshot_lines  # noqa: E402
 __all__ = ['snapshot_perf', 'flight_spans', 'flight_recompiles',
            'bench_perf_rows', 'report', 'main']
 
-# bench row fields that form the perf table (satellite keys first)
+# bench row fields that form the perf table (satellite keys first).
+# data_wait_frac rides on the ingest rung's throughput row: a step loop
+# whose input fraction creeps up is regressing even if examples/s holds.
 _BENCH_COLS = ('compile_s_cold', 'compile_s_warm', 'recompiles',
-               'mfu_est', 'arithmetic_intensity', 'roofline_bound')
+               'mfu_est', 'arithmetic_intensity', 'roofline_bound',
+               'data_wait_frac')
+
+# data_wait share of the summed phase means above which a config is
+# called out as input-bound in the snapshot section
+_INPUT_BOUND_FRAC = 0.25
 
 
 def _sample_value(fam, **labels):
@@ -246,10 +253,16 @@ def report(snap_text=None, flight_dir=None, bench_paths=(), trace=None,
             if 'steps' in perf:
                 out.append('  steps: %d  stragglers: %d'
                            % (perf['steps'], perf.get('stragglers', 0)))
-            for phase, (n, mean) in sorted(
-                    perf.get('phases', {}).items()):
-                out.append('  phase %-14s mean %.6fs over %d steps'
-                           % (phase, mean, n))
+            phases = perf.get('phases', {})
+            step_mean = sum(m for _, m in phases.values())
+            for phase, (n, mean) in sorted(phases.items()):
+                flag = ''
+                if phase == 'data_wait' and step_mean > 0 and \
+                        mean / step_mean >= _INPUT_BOUND_FRAC:
+                    flag = ('  <-- input-bound (%d%% of step)'
+                            % round(100 * mean / step_mean))
+                out.append('  phase %-14s mean %.6fs over %d steps%s'
+                           % (phase, mean, n, flag))
             if 'mfu_est' in perf:
                 out.append('  mfu_est: %.4f' % perf['mfu_est'])
             if 'arithmetic_intensity' in perf:

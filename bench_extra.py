@@ -907,6 +907,198 @@ def bench_serving_gateway_qos(on_tpu):
     ]
 
 
+def bench_serving_gateway_multimodel(on_tpu):
+    """Multi-model serving rung (ISSUE 19): N models behind one
+    2-replica gateway of ModelHost replicas, a zipf-mixed Poisson burst
+    routed by model affinity, and a zero-downtime `rollout()` of the
+    head model's weights fired MID-burst from the replay hook.
+
+    Acceptance, asserted inline (a broken swap must fail the rung, not
+    ship a row):
+      * completed_ratio == 1.0 — every request before, during and
+        after the weight swap finishes (drain-never-kill applied to
+        weights instead of replicas);
+      * per-model wide-event attribution matches the workload's model
+        mix EXACTLY (the trace is the oracle for who asked for what);
+      * the warm bring-up of the new version reports zero persistent
+        compile-cache misses — same program shapes, new weights;
+      * weight paging proof on a budgeted host: resident bytes never
+        exceed the byte budget and the eviction counters match the LRU
+        oracle replayed in plain python.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.capacity.replay import replay as replay_trace
+    from paddle_tpu.framework import io_save
+    from paddle_tpu.monitor.events import (RequestLog,
+                                           set_default_request_log)
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    ModelAffinityRouter, ModelHost,
+                                    ModelRegistry, ServingGateway)
+    from paddle_tpu.text.models import GPTConfig, GPTForCausalLM
+    import shutil
+    import tempfile
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=30528, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dropout=0.0)
+        lens, mnt, n_req = (32, 64, 96, 128), 64, 32
+        max_len, chunk, block, num_slots = 256, 32, 8, 8
+        mean_gap = 0.02
+    else:
+        # smaller than the other gateway rungs: the rung builds
+        # n_models+1 engine instances, so weights are kept light
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=128,
+                        dropout=0.0)
+        lens, mnt, n_req = (8, 16, 24, 32), 16, 24
+        max_len, chunk, block, num_slots = 64, 32, 8, 8
+        mean_gap = 0.002
+    n_models, swap_frac = 3, 0.5
+    swap_at = int(n_req * swap_frac)
+    head = 'model_000'
+
+    root = tempfile.mkdtemp(prefix='bench_registry_')
+    try:
+        # publish one distinctly-seeded artifact per model, plus the
+        # head model's v2 (the weights the mid-burst rollout ships)
+        reg = ModelRegistry(root=root)
+        for i in range(n_models):
+            paddle.seed(100 + i)
+            m = GPTForCausalLM(cfg)
+            reg.publish('model_%03d' % i, 'v1', m.state_dict())
+        paddle.seed(200)
+        reg.publish(head, 'v2', GPTForCausalLM(cfg).state_dict())
+        nbytes = reg.entry(head, 'v1').nbytes
+
+        def engine_for(entry):
+            m = GPTForCausalLM(cfg)
+            m.set_state_dict(io_save.load(entry.path))
+            if on_tpu:
+                m.bfloat16()
+            m.eval()
+            return ContinuousBatchingEngine(
+                m, num_slots=num_slots, max_len=max_len,
+                prefill_chunk=chunk, decode_block=block)
+
+        spec = _serving_workload(
+            n_req, lens, mnt, mean_gap, cfg.vocab_size)
+        spec.models = {'mode': 'zipf', 'count': n_models}
+        trace = spec.generate()
+
+        def host_factory():
+            # serving hosts get headroom: every model plus the rollout's
+            # incoming version must be co-resident under load
+            return ModelHost(reg, engine_for,
+                             byte_budget=(n_models + 2) * nbytes,
+                             max_len=max_len)
+
+        log = RequestLog(capacity=4 * n_req)
+        prev_log = set_default_request_log(log)
+        try:
+            mreg = MetricRegistry()
+            gw = ServingGateway(host_factory, replicas=2, registry=mreg,
+                                router=ModelAffinityRouter())
+            t0c = time.time()
+            gw.generate(trace.prompts()[:2], max_new_tokens=2,
+                        model=head, tenant='warmup')          # compile
+            t_cold = time.time() - t0c
+            gw.start()
+            rollout = {}
+
+            def swap(i):
+                if i == swap_at:
+                    rollout.update(gw.rollout(head, 'v2'))
+
+            res = replay_trace(gw, trace, max_new_tokens=mnt,
+                               timeout=600, before_submit=swap)
+            gw.shutdown()
+            events = [e for e in log.events() if e['tenant'] != 'warmup']
+        finally:
+            set_default_request_log(prev_log)
+
+        if res.completed_ratio != 1.0:
+            raise AssertionError(
+                'rollout lost requests: completed_ratio %.4f != 1.0'
+                % res.completed_ratio)
+        if not rollout or rollout.get('to_version') != 'v2':
+            raise AssertionError('mid-burst rollout did not run: %r'
+                                 % (rollout,))
+        if int(rollout.get('cache_misses') or 0) > 0:
+            raise AssertionError(
+                'warm bring-up missed the compile cache: %r' % (rollout,))
+        # the trace is the attribution oracle: wide events per model
+        # must equal the workload's model mix exactly
+        ev_mix = {}
+        for e in events:
+            ev_mix[e['model']] = ev_mix.get(e['model'], 0) + 1
+        if ev_mix != trace.model_mix():
+            raise AssertionError(
+                'wide-event attribution %r != trace model mix %r'
+                % (ev_mix, trace.model_mix()))
+
+        # ---- weight paging proof: budget holds 2 of the 3 models ----
+        pager = ModelHost(reg, engine_for,
+                          byte_budget=2 * nbytes + nbytes // 2)
+        oracle_resident, oracle_evicted = [], []
+        max_resident = 0
+        for i in list(range(n_models)) * 2:
+            key = ('model_%03d' % i, 'v1')
+            pager.load(*key)
+            if key in oracle_resident:
+                oracle_resident.remove(key)
+            while len(oracle_resident) >= 2:
+                oracle_evicted.append(oracle_resident.pop(0))
+            oracle_resident.append(key)
+            if pager.resident_bytes > pager.byte_budget:
+                raise AssertionError(
+                    'resident bytes %d exceed budget %d'
+                    % (pager.resident_bytes, pager.byte_budget))
+            max_resident = max(max_resident, len(pager.resident_models()))
+        evictions = {
+            'model_%03d' % i: int(pager._m_evictions.labels(
+                model='model_%03d' % i).value())
+            for i in range(n_models)}
+        want = {'model_%03d' % i:
+                sum(1 for k in oracle_evicted if k[0] == 'model_%03d' % i)
+                for i in range(n_models)}
+        if evictions != want:
+            raise AssertionError('eviction counters %r != LRU oracle %r'
+                                 % (evictions, want))
+        pager.shutdown()
+
+        base = {'trace': 'poisson', 'mean_gap_s': mean_gap,
+                'requests': n_req, 'new_tokens': mnt,
+                'num_slots': num_slots, 'replicas': 2,
+                'n_models': n_models, 'swap_at': swap_frac,
+                'policy': 'model_affinity', 'workload_spec': spec.hash,
+                'degraded': not on_tpu}
+        toks = sum(int(e['output_tokens'] or 0) for e in events)
+        rows = [
+            dict(base, metric='serving_gateway_multimodel_tokens_per_sec',
+                 value=round(res.tokens_per_sec, 2), unit='tokens/sec',
+                 compile_s_cold=round(t_cold, 3),
+                 model_mix=trace.model_mix(), event_tokens=toks),
+            dict(base,
+                 metric='serving_gateway_multimodel_completed_ratio',
+                 value=round(res.completed_ratio, 4), unit='ratio'),
+            dict(base, metric='serving_gateway_rollout_warm_load_s',
+                 value=round(float(rollout.get('load_s') or 0.0), 3),
+                 unit='s', model=head,
+                 cache_hits=int(rollout.get('cache_hits') or 0),
+                 cache_misses=int(rollout.get('cache_misses') or 0)),
+            dict(base, metric='registry_paging_evictions',
+                 value=sum(evictions.values()), unit='count',
+                 byte_budget=pager.byte_budget,
+                 artifact_bytes=nbytes, max_models_resident=max_resident,
+                 resident_bytes_final=pager.resident_bytes),
+        ]
+        return rows
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_supervisor_recovery(on_tpu):
     """Elastic-supervisor MTTR rung (ISSUE 14): a journaled PS shard is
     snapshotted, hard-killed, and recovered by the ShardSupervisor
@@ -1244,6 +1436,7 @@ def main():
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
                bench_serving_gateway_tenants, bench_serving_gateway_qos,
+               bench_serving_gateway_multimodel,
                bench_supervisor_recovery, bench_capacity_calibration,
                bench_ingest):
         try:

@@ -73,6 +73,7 @@ def replay(gateway, trace, speed=1.0, max_new_tokens=None, seed=0,
         raise ValueError('speed must be positive')
     prompts = trace.prompts()
     tenants = trace.tenants()
+    models = trace.models() if hasattr(trace, 'models') else None
     new_tokens = trace.new_tokens.tolist()
     arrival = trace.arrival.tolist()
 
@@ -95,8 +96,10 @@ def replay(gateway, trace, speed=1.0, max_new_tokens=None, seed=0,
             before_submit(i)
         mnt = int(max_new_tokens if max_new_tokens is not None
                   else new_tokens[i])
+        extra = {} if models is None else {'model': models[i]}
         handles.append(gateway.submit(prompts[i], max_new_tokens=mnt,
-                                      tenant=tenants[i], seed=seed))
+                                      tenant=tenants[i], seed=seed,
+                                      **extra))
     for h in handles:
         h.wait(timeout)
     wall = time.monotonic() - t0

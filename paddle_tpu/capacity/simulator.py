@@ -222,6 +222,22 @@ class SimResult:
         t = self.ttft()
         return {q: float(np.percentile(t, q)) for q in qs}
 
+    def ttft_percentiles_by_model(self, qs=(50, 99)):
+        """{model name: {q: ttft}} over admitted requests; empty for a
+        single-model trace (no named models to break down by)."""
+        names = getattr(self.trace, 'model_names', None)
+        if not names:
+            return {}
+        t = self.first - self.trace.arrival
+        m = self.ok_mask()
+        out = {}
+        for idx, name in enumerate(names):
+            mask = m & (self.trace.model_id == idx)
+            if mask.any():
+                out[name] = {q: float(np.percentile(t[mask], q))
+                             for q in qs}
+        return out
+
     def ttft_percentiles_by_priority(self, qs=(50, 99)):
         """{priority: {q: ttft}} over admitted requests — the graceful-
         degradation read: premium classes should hold their tail while
@@ -265,6 +281,7 @@ class SimResult:
         point."""
         tr = self.trace
         names = tr.tenant_names
+        mnames = getattr(tr, 'model_names', None)
         out = []
         for i in range(len(tr)):
             shed = (self.outcome is not None
@@ -272,6 +289,8 @@ class SimResult:
             out.append({
                 'request_id': 'sim-%d' % i,
                 'tenant': names[tr.tenant_id[i]],
+                'model': (mnames[tr.model_id[i]]
+                          if mnames is not None else None),
                 'priority': (int(self.priority[i])
                              if self.priority is not None else 0),
                 'trace_id': None,
@@ -634,10 +653,17 @@ def sweep_replicas(trace, model, counts=(1, 2, 4, 8, 16), slo_ttft_s=1.0,
                        advance_every=advance_every, registry=registry)
         p = res.ttft_percentiles((50, percentile))
         ok = p[percentile] <= slo_ttft_s
-        points.append({'replicas': c, 'ttft_p50_s': p[50],
-                       'ttft_p%d_s' % percentile: p[percentile],
-                       'sim_wall_s': round(res.wall_s, 3),
-                       'meets_slo': bool(ok)})
+        point = {'replicas': c, 'ttft_p50_s': p[50],
+                 'ttft_p%d_s' % percentile: p[percentile],
+                 'sim_wall_s': round(res.wall_s, 3),
+                 'meets_slo': bool(ok)}
+        by_model = res.ttft_percentiles_by_model((percentile,))
+        if by_model:
+            # only multi-model traces carry the column, so single-model
+            # sweep output stays byte-stable for downstream parsers
+            point['ttft_by_model'] = {m: v[percentile]
+                                      for m, v in sorted(by_model.items())}
+        points.append(point)
         if ok and min_replicas is None:
             min_replicas = c
     return {'slo_ttft_s': float(slo_ttft_s), 'percentile': int(percentile),

@@ -88,6 +88,9 @@ class WorkloadSpec:
                 'tenants': [{'name': n, ['weight': w],
                              ['lengths': {...}]}, ...]}
              | {'mode': 'zipf', 'count': K, 'a': a}
+      models:  {'mode': 'zipf', 'count': K, 'a': a}   # 'model_%03d' names
+             | {'mode': 'round_robin' | 'weighted',
+                'models': [{'name': n, ['weight': w]}, ...]}
       prefix:  {'len': P, 'groups': G, 'prob': p}  # shared-prefix heads
 
     `lengths` draws the TAIL length when a request carries a shared
@@ -96,7 +99,8 @@ class WorkloadSpec:
     """
 
     def __init__(self, requests, seed=0, vocab_size=512, arrival=None,
-                 lengths=None, output=None, tenants=None, prefix=None):
+                 lengths=None, output=None, tenants=None, prefix=None,
+                 models=None):
         if requests < 1:
             raise ValueError('requests must be >= 1')
         self.requests = int(requests)
@@ -108,13 +112,20 @@ class WorkloadSpec:
         self.output = dict(output or {'dist': 'fixed', 'len': 32})
         self.tenants = dict(tenants) if tenants else None
         self.prefix = dict(prefix) if prefix else None
+        self.models = dict(models) if models else None
 
     def to_dict(self):
-        return _canon({'requests': self.requests, 'seed': self.seed,
-                       'vocab_size': self.vocab_size,
-                       'arrival': self.arrival, 'lengths': self.lengths,
-                       'output': self.output, 'tenants': self.tenants,
-                       'prefix': self.prefix})
+        d = {'requests': self.requests, 'seed': self.seed,
+             'vocab_size': self.vocab_size,
+             'arrival': self.arrival, 'lengths': self.lengths,
+             'output': self.output, 'tenants': self.tenants,
+             'prefix': self.prefix}
+        # only when set: a single-model spec must hash identically to
+        # specs serialized before the models knob existed, or every
+        # stored bench best would silently orphan
+        if self.models:
+            d['models'] = self.models
+        return _canon(d)
 
     @classmethod
     def from_dict(cls, d):
@@ -122,7 +133,7 @@ class WorkloadSpec:
                    vocab_size=d.get('vocab_size', 512),
                    arrival=d.get('arrival'), lengths=d.get('lengths'),
                    output=d.get('output'), tenants=d.get('tenants'),
-                   prefix=d.get('prefix'))
+                   prefix=d.get('prefix'), models=d.get('models'))
 
     def canonical_json(self):
         return json.dumps(self.to_dict(), sort_keys=True,
@@ -250,6 +261,35 @@ def _gen_tenants(spec):
     return names, tid, per_len
 
 
+def _gen_models(spec):
+    """(model_names tuple or None, model_id array). Own RNG stream
+    ('model') so adding a model mix never shifts tenant/length draws —
+    the same discipline as every other knob."""
+    n, cfg = spec.requests, getattr(spec, 'models', None)
+    if not cfg:
+        return None, np.zeros(n, dtype=np.int64)
+    mode = cfg.get('mode', 'zipf')
+    if mode == 'zipf':
+        count = int(cfg['count'])
+        names = tuple('model_%03d' % i for i in range(count))
+        rng = np.random.RandomState(_stream_seed(spec.seed, 'model'))
+        mid = np.minimum(rng.zipf(float(cfg.get('a', 1.2)), size=n) - 1,
+                         count - 1).astype(np.int64)
+        return names, mid
+    entries = list(cfg['models'])
+    names = tuple(e['name'] for e in entries)
+    if mode == 'round_robin':
+        mid = np.arange(n, dtype=np.int64) % len(names)
+    elif mode == 'weighted':
+        w = np.asarray([float(e.get('weight', 1.0)) for e in entries])
+        rng = np.random.RandomState(_stream_seed(spec.seed, 'model'))
+        mid = rng.choice(len(names), size=n, p=w / w.sum())
+        mid = mid.astype(np.int64)
+    else:
+        raise ValueError('unknown model mode %r' % (mode,))
+    return names, mid
+
+
 def generate(spec):
     """Spec -> Trace. Columnar and prompt-free: generating a
     million-request trace for the simulator takes well under a second
@@ -257,6 +297,7 @@ def generate(spec):
     n = spec.requests
     arrival = _gen_arrivals(spec)
     names, tid, per_len = _gen_tenants(spec)
+    model_names, mid = _gen_models(spec)
 
     len_rng = np.random.RandomState(_stream_seed(spec.seed, 'lengths'))
     if per_len:
@@ -295,6 +336,7 @@ def generate(spec):
                  new_tokens=new_tokens[order], tenant_id=tid[order],
                  tenant_names=names, prefix_group=group[order],
                  prefix_len=prefix_len[order],
+                 model_id=mid[order], model_names=model_names,
                  meta={'spec': spec.to_dict(), 'spec_hash': spec.hash,
                        'vocab_size': spec.vocab_size, 'source': 'spec'})
 
@@ -309,7 +351,8 @@ class Trace:
     prompt length (shared prefix included)."""
 
     def __init__(self, arrival, prompt_len, new_tokens, tenant_id,
-                 tenant_names, prefix_group, prefix_len, meta=None):
+                 tenant_names, prefix_group, prefix_len, meta=None,
+                 model_id=None, model_names=None):
         self.arrival = np.asarray(arrival, dtype=np.float64)
         self.prompt_len = np.asarray(prompt_len, dtype=np.int64)
         self.new_tokens = np.asarray(new_tokens, dtype=np.int64)
@@ -317,6 +360,12 @@ class Trace:
         self.tenant_names = tuple(tenant_names)
         self.prefix_group = np.asarray(prefix_group, dtype=np.int64)
         self.prefix_len = np.asarray(prefix_len, dtype=np.int64)
+        # model_names None == single-model trace (every request targets
+        # the deployment default); model_id is then all zeros
+        self.model_names = tuple(model_names) if model_names else None
+        self.model_id = (np.asarray(model_id, dtype=np.int64)
+                         if model_id is not None
+                         else np.zeros(len(self.arrival), dtype=np.int64))
         self.meta = dict(meta or {})
         self._prompts = None
 
@@ -342,6 +391,22 @@ class Trace:
         mix = {}
         for t in self.tenant_id:
             name = self.tenant_names[t]
+            mix[name] = mix.get(name, 0) + 1
+        return mix
+
+    def models(self):
+        """Per-request model names, or None for a single-model trace."""
+        if self.model_names is None:
+            return None
+        names = self.model_names
+        return [names[m] for m in self.model_id]
+
+    def model_mix(self):
+        if self.model_names is None:
+            return {}
+        mix = {}
+        for m in self.model_id:
+            name = self.model_names[m]
             mix[name] = mix.get(name, 0) + 1
         return mix
 
@@ -382,14 +447,18 @@ class Trace:
                             sort_keys=True, separators=(',', ':'))]
         names = self.tenant_names
         for i in range(len(self)):
-            lines.append(json.dumps(
-                {'request_id': i, 'arrival_t': float(self.arrival[i]),
-                 'tenant': names[self.tenant_id[i]],
-                 'prompt_tokens': int(self.prompt_len[i]),
-                 'output_tokens': int(self.new_tokens[i]),
-                 'prefix_group': int(self.prefix_group[i]),
-                 'prefix_len': int(self.prefix_len[i])},
-                sort_keys=True, separators=(',', ':')))
+            row = {'request_id': i, 'arrival_t': float(self.arrival[i]),
+                   'tenant': names[self.tenant_id[i]],
+                   'prompt_tokens': int(self.prompt_len[i]),
+                   'output_tokens': int(self.new_tokens[i]),
+                   'prefix_group': int(self.prefix_group[i]),
+                   'prefix_len': int(self.prefix_len[i])}
+            # only multi-model traces carry the column — single-model
+            # JSONL stays byte-identical to pre-models output
+            if self.model_names is not None:
+                row['model'] = self.model_names[self.model_id[i]]
+            lines.append(json.dumps(row, sort_keys=True,
+                                    separators=(',', ':')))
         return '\n'.join(lines) + '\n'
 
     @classmethod
@@ -418,7 +487,10 @@ def _rows_to_trace(rows, meta):
     rows.sort(key=lambda r: (float(r.get('arrival_t') or 0.0)))
     t0 = float(rows[0].get('arrival_t') or 0.0)
     names, name_idx = [], {}
+    mnames, mname_idx = [], {}
+    multi_model = any(r.get('model') is not None for r in rows)
     tid = np.empty(len(rows), dtype=np.int64)
+    mid = np.zeros(len(rows), dtype=np.int64)
     arrival = np.empty(len(rows), dtype=np.float64)
     plen = np.empty(len(rows), dtype=np.int64)
     ntok = np.empty(len(rows), dtype=np.int64)
@@ -430,6 +502,12 @@ def _rows_to_trace(rows, meta):
             name_idx[t] = len(names)
             names.append(t)
         tid[i] = name_idx[t]
+        if multi_model:
+            m = r.get('model')
+            if m not in mname_idx:
+                mname_idx[m] = len(mnames)
+                mnames.append(m)
+            mid[i] = mname_idx[m]
         arrival[i] = float(r.get('arrival_t') or 0.0) - t0
         plen[i] = max(1, int(r.get('prompt_tokens') or 1))
         ntok[i] = max(1, int(r.get('output_tokens') or 1))
@@ -437,7 +515,10 @@ def _rows_to_trace(rows, meta):
         pfx[i] = int(r.get('prefix_len', 0) or 0)
     return Trace(arrival=arrival, prompt_len=plen, new_tokens=ntok,
                  tenant_id=tid, tenant_names=tuple(names),
-                 prefix_group=group, prefix_len=pfx, meta=meta)
+                 prefix_group=group, prefix_len=pfx,
+                 model_id=mid if multi_model else None,
+                 model_names=tuple(mnames) if multi_model else None,
+                 meta=meta)
 
 
 def trace_from_events(events, meta=None):
@@ -455,6 +536,7 @@ def trace_from_events(events, meta=None):
     m.setdefault('source', 'events')
     return _rows_to_trace(
         [{'arrival_t': e['arrival_t'], 'tenant': e.get('tenant'),
+          'model': e.get('model'),
           'prompt_tokens': e.get('prompt_tokens'),
           'output_tokens': e.get('output_tokens')} for e in rows], m)
 

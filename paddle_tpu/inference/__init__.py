@@ -37,11 +37,32 @@ class PredictorPool:
     """reference paddle_infer::services::PredictorPool: `size` predictors
     over one config for concurrent serving. The jitted executable cache is
     shared per-process by XLA, so the pool is cheap; each Retrieve(i)
-    hands an independent Predictor (its own IO buffers)."""
+    hands an independent Predictor (its own IO buffers).
 
-    def __init__(self, config, size=1):
+    Registry-backed construction: `PredictorPool(registry=reg,
+    model='m', version='v2')` resolves the artifact through a
+    serving.registry.ModelRegistry instead of a hand-built Config —
+    version=None follows the serving pointer, so a hot-swapped rollout
+    changes what the NEXT pool loads without touching callers. The
+    entry's content fingerprint is recorded on `self.fingerprint` (the
+    compile-cache key dimension; same fingerprint == warm bring-up)."""
+
+    def __init__(self, config=None, size=1, registry=None, model=None,
+                 version=None):
         if size < 1:
             raise ValueError('pool size must be >= 1')
+        self.fingerprint = None
+        if registry is not None:
+            if model is None:
+                raise ValueError('registry-backed pool needs model=')
+            entry = registry.resolve(model, version)
+            self.fingerprint = entry.fingerprint
+            if config is None:
+                config = Config(model_path=entry.path)
+            else:
+                config.set_model(entry.path, config.params_file())
+        if config is None:
+            raise ValueError('need a Config or registry= + model=')
         self._preds = [create_predictor(config) for _ in range(size)]
 
     def retrive(self, idx):  # (sic) the reference binding's spelling

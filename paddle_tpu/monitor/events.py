@@ -39,7 +39,7 @@ from .registry import default_registry
 from .telemetry import record_request_event_schema
 
 __all__ = ['REQUEST_EVENT_FIELDS', 'FIELD_NAMES', 'RequestLog',
-           'TenantLabeler', 'default_request_log',
+           'TenantLabeler', 'ModelLabeler', 'default_request_log',
            'set_default_request_log', 'event_line', 'parse_event_lines',
            'EVENT_LINE_RE']
 
@@ -51,6 +51,8 @@ __all__ = ['REQUEST_EVENT_FIELDS', 'FIELD_NAMES', 'RequestLog',
 REQUEST_EVENT_FIELDS = (
     ('request_id', 'engine- or gateway-level request id'),
     ('tenant', 'normalized tenant label (bounded cardinality)'),
+    ('model', 'normalized model label (bounded cardinality; None when '
+     'the request did not target a named model)'),
     ('priority', 'scheduling priority (int, higher preempts lower)'),
     ('trace_id', 'trace id of the span tree that completed the request'),
     ('arrival_t', 'wall-clock submission time'),
@@ -165,8 +167,9 @@ class RequestLog:
         self._sink_bytes = 0
         self._m_rotations.inc()
 
-    def events(self, tenant=None, outcome=None, min_failovers=None,
-               since_ts=None, until_ts=None, limit=None):
+    def events(self, tenant=None, model=None, outcome=None,
+               min_failovers=None, since_ts=None, until_ts=None,
+               limit=None):
         """Snapshot of the ring (oldest first), optionally filtered.
         ``since_ts``/``until_ts`` select the half-open arrival-time
         window [since, until) in the log's own clock (the gateway's
@@ -177,6 +180,8 @@ class RequestLog:
             out = list(self._ring)
         if tenant is not None:
             out = [e for e in out if e['tenant'] == tenant]
+        if model is not None:
+            out = [e for e in out if e.get('model') == model]
         if outcome is not None:
             out = [e for e in out if e['outcome'] == outcome]
         if min_failovers is not None:
@@ -237,6 +242,20 @@ class TenantLabeler:
                 return t
         return 'overflow_%d' % (zlib.crc32(t.encode('utf-8'))
                                 % self.buckets)
+
+
+class ModelLabeler(TenantLabeler):
+    """TenantLabeler's bounded-cardinality discipline applied to model
+    names, with one semantic difference: None stays None — a request
+    that never targeted a named model (every single-model deployment)
+    records a null `model` field rather than inventing a default, so
+    per-model rollups only ever contain models callers actually named.
+    """
+
+    def label(self, model):
+        if model is None:
+            return None
+        return super().label(model)
 
 
 def _env_enabled():

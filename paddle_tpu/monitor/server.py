@@ -7,7 +7,7 @@ liveness body on /healthz, the tracer's flight-recorder ring on
 federated fleet view on /fleet (?scrape=1 to force a cycle, ?format=prom
 for text exposition of the merge), alert state on /alerts when a
 FleetCollector / AlertManager is attached, and the wide-event request
-log on /requests (?tenant= / ?outcome= / ?min_failovers= /
+log on /requests (?tenant= / ?model= / ?outcome= / ?min_failovers= /
 ?since_ts= / ?until_ts= / ?limit= filters) when a RequestLog is
 attached, 404 elsewhere. HEAD is
 answered on every route (load-balancer probes use it and must not see
@@ -97,6 +97,7 @@ class _Handler(http.server.BaseHTTPRequestHandler):
 
             try:
                 evs = log.events(tenant=_one('tenant'),
+                                 model=_one('model'),
                                  outcome=_one('outcome'),
                                  min_failovers=_one('min_failovers', int),
                                  since_ts=_one('since_ts', float),

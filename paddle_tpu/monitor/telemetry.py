@@ -19,7 +19,8 @@ __all__ = ['record_dryrun_step', 'record_serving_schema',
            'record_alert_schema', 'record_supervisor_schema',
            'record_request_event_schema', 'record_tenant_schema',
            'record_qos_schema', 'record_capacity_schema',
-           'record_ingest_schema', 'snapshot_line',
+           'record_ingest_schema', 'record_registry_schema',
+           'snapshot_line',
            'parse_snapshot_lines', 'LINE_RE']
 
 LINE_RE = re.compile(r'telemetry_snapshot\((?P<n>\d+)\)'
@@ -615,6 +616,56 @@ def record_ingest_schema(registry):
     return out
 
 
+# the multi-model serving registry/weight-paging families
+# (paddle_tpu/serving/registry/). Single-source rule: ModelHost and the
+# schema baseline both register through record_registry_schema. Label
+# budget (docs/observability.md): `model` is bounded by ModelLabeler —
+# the TenantLabeler discipline applied to model names, so a caller
+# spraying model ids can never explode cardinality.
+REGISTRY_FAMILIES = (
+    ('gauge', 'registry_resident_bytes',
+     'artifact bytes of models currently paged in on this host', ()),
+    ('gauge', 'registry_models_resident',
+     'model versions currently resident on this host', ()),
+    ('counter', 'registry_loads_total',
+     'model loads (weight page-ins) per model', ('model',)),
+    ('counter', 'registry_evictions_total',
+     'model evictions (weight page-outs) per model', ('model',)),
+    ('counter', 'registry_evictions_deferred_total',
+     'evictions deferred because in-flight requests still referenced '
+     'the weights', ()),
+    ('histogram', 'registry_load_seconds',
+     'wall seconds to bring a model resident (artifact load + engine '
+     'build, warmup included when performed)', ()),
+    ('counter', 'registry_warm_load_cache_hits_total',
+     'persistent-compile-cache hits observed during warm model '
+     'bring-ups (rollout warmups)', ()),
+    ('counter', 'registry_warm_load_cache_misses_total',
+     'persistent-compile-cache misses observed during warm model '
+     'bring-ups (a rollout that recompiled)', ()),
+    ('counter', 'registry_rollouts_total',
+     'version rollouts completed per model', ('model',)),
+)
+
+
+def record_registry_schema(registry):
+    """Register the model-registry/weight-paging families on `registry`
+    and return {name: family}. Used by ModelHost at construction and by
+    dryrun_registry so the committed baseline covers multi-model
+    serving."""
+    from .registry import exponential_buckets
+    out = {}
+    for kind, name, doc, labels in REGISTRY_FAMILIES:
+        kw = {}
+        if kind == 'histogram':
+            # spans a stub-engine reload (~ms) through a cold multi-GB
+            # artifact load + compile (~minutes)
+            kw['buckets'] = exponential_buckets(0.001, 2.0, 18)
+        out[name] = getattr(registry, kind)(name, doc, labels, **kw) \
+            if labels else getattr(registry, kind)(name, doc, **kw)
+    return out
+
+
 def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     """Fresh per-config registry holding the full dryrun telemetry
     schema: training gauges + serving + tracing + perf families + one
@@ -640,6 +691,7 @@ def dryrun_registry(step_seconds, loss, batch=None, registry=None):
     record_qos_schema(reg)
     record_capacity_schema(reg)
     record_ingest_schema(reg)
+    record_registry_schema(reg)
     RuntimeSampler(registry=reg, jax_metrics=True).sample_once()
     return reg
 

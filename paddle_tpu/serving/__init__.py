@@ -25,12 +25,13 @@ verify) and the thread-safe front door, metrics.py turns step
 timestamps into tok/s + latency percentiles. See docs/serving.md.
 """
 from .engine import ContinuousBatchingEngine
-from .gateway import (AutoscalePolicy, GatewayRequest, QosPolicy,
-                      ServingGateway, TenantClass)
+from .gateway import (AutoscalePolicy, GatewayRequest, ModelAffinityRouter,
+                      QosPolicy, ServingGateway, TenantClass)
 from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
                        build_paged_pools, build_slot_caches)
 from .metrics import ServingMetrics
 from .paged_engine import NGramProposer, PagedContinuousBatchingEngine
+from .registry import ModelHost, ModelRegistry, RegistryEntry
 from .scheduler import PagedScheduler, Request, Scheduler
 
 __all__ = ['ContinuousBatchingEngine', 'PagedContinuousBatchingEngine',
@@ -38,4 +39,5 @@ __all__ = ['ContinuousBatchingEngine', 'PagedContinuousBatchingEngine',
            'NGramProposer', 'build_slot_caches', 'build_paged_pools',
            'ServingMetrics', 'Request', 'Scheduler', 'PagedScheduler',
            'ServingGateway', 'GatewayRequest', 'AutoscalePolicy',
-           'QosPolicy', 'TenantClass']
+           'QosPolicy', 'TenantClass', 'ModelAffinityRouter',
+           'ModelRegistry', 'RegistryEntry', 'ModelHost']

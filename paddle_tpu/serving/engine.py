@@ -158,13 +158,15 @@ class _EngineBase:
 
     def add_request(self, prompt, max_new_tokens=32, temperature=1.0,
                     top_k=0, do_sample=False, seed=0, stream=False,
-                    tenant=None, priority=0, emit_event=True):
+                    tenant=None, priority=0, model=None, emit_event=True):
         """Queue a generation request; returns the Request handle.
 
         `tenant` is the attribution dimension: it rides the request into
-        the per-tenant metric families and the wide event. `priority`
-        (int, higher wins) orders admission and — on the paged engine
-        with preempt=True — marks lower-priority residents evictable.
+        the per-tenant metric families and the wide event. `model` is
+        the second attribution dimension (multi-model gateways route on
+        it; a single-model engine just records it). `priority` (int,
+        higher wins) orders admission and — on the paged engine with
+        preempt=True — marks lower-priority residents evictable.
         `emit_event=False` suppresses this engine's wide event — the
         gateway sets it so a failed-over request still produces exactly
         ONE canonical record (the gateway's, which knows the failover
@@ -172,9 +174,22 @@ class _EngineBase:
         req = Request(prompt, max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k,
                       do_sample=do_sample, seed=seed, tenant=tenant,
-                      priority=priority)
+                      priority=priority, model=model)
         req._emit_event = bool(emit_event)
-        req._tenant_label = self.metrics.tenant_label(tenant)
+        if stream:
+            req._stream_q = _queue.Queue()
+        return self.enqueue(req)
+
+    def enqueue(self, req):
+        """Admit a pre-built scheduler.Request through the front door —
+        the ModelHost path: a multi-model host constructs the Request at
+        submission (stamping its arrival time), parks it while weights
+        load, then enqueues it here without re-timestamping. All
+        validation, metrics and tracing of add_request happen here."""
+        req._emit_event = getattr(req, '_emit_event', True)
+        req._tenant_label = self.metrics.tenant_label(req.tenant)
+        req._model_label = self.metrics.model_label(
+            getattr(req, 'model', None))
         # front-door guard, shared by BOTH engines (the paged subclass
         # overrides _validate without chaining): a request whose worst
         # case — prompt plus every generated token but the last — cannot
@@ -187,8 +202,6 @@ class _EngineBase:
                 'max_new_tokens=%d needs %d cache rows but max_len=%d'
                 % (len(req.prompt), req.max_new_tokens, worst,
                    self.max_len))
-        if stream:
-            req._stream_q = _queue.Queue()
         with self._lock:
             if self._closed:
                 raise RuntimeError(
@@ -196,15 +209,18 @@ class _EngineBase:
             self._validate(req)
             self.scheduler.submit(req)
             t = self.metrics.now()
-            req._arrival_t = t
-            self.metrics.on_arrival(req.id, t)
+            if req._arrival_t is None:
+                req._arrival_t = t
+            self.metrics.on_arrival(req.id, req._arrival_t)
             tr = self._tracer
             if tr.enabled:
                 tags = {'request_id': req.id,
                         'prompt_len': len(req.prompt),
                         'max_new_tokens': req.max_new_tokens}
-                if tenant is not None:
+                if req.tenant is not None:
                     tags['tenant'] = req._tenant_label
+                if getattr(req, 'model', None) is not None:
+                    tags['model'] = req._model_label
                 # root=True: the request owns its trace even when
                 # submitted inside a gateway routing/failover span —
                 # tail retention decides at THIS span's finish, and the
@@ -446,6 +462,7 @@ class _EngineBase:
         log.emit(
             request_id=req.id,
             tenant=req._tenant_label,
+            model=getattr(req, '_model_label', None),
             priority=req.priority,
             trace_id=None if req._span is None else req._span.trace_id,
             arrival_t=req._arrival_t,

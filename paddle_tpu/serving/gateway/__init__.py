@@ -19,9 +19,10 @@ from .admission import QosPolicy, TenantClass, TokenBucket
 from .autoscaler import AutoscalePolicy, Decision, slo_burn_rate
 from .gateway import GatewayRequest, ServingGateway
 from .replica import InprocReplica
-from .router import LeastLoadedRouter, RoundRobinRouter
+from .router import (LeastLoadedRouter, ModelAffinityRouter,
+                     RoundRobinRouter)
 
 __all__ = ['ServingGateway', 'GatewayRequest', 'InprocReplica',
-           'LeastLoadedRouter', 'RoundRobinRouter', 'AutoscalePolicy',
-           'Decision', 'slo_burn_rate', 'QosPolicy', 'TenantClass',
-           'TokenBucket']
+           'LeastLoadedRouter', 'ModelAffinityRouter', 'RoundRobinRouter',
+           'AutoscalePolicy', 'Decision', 'slo_burn_rate', 'QosPolicy',
+           'TenantClass', 'TokenBucket']

@@ -52,7 +52,7 @@ class AutoscalePolicy:
 
     def __init__(self, slo_ttft_s, min_replicas=1, max_replicas=8,
                  burn_threshold=0.5, idle_occupancy=0.25, sustain_s=3.0,
-                 cooldown_s=15.0, window_s=30.0):
+                 cooldown_s=15.0, window_s=30.0, premium_tenants=()):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError('need 1 <= min_replicas <= max_replicas')
         self.slo_ttft_s = float(slo_ttft_s)
@@ -63,16 +63,34 @@ class AutoscalePolicy:
         self.sustain_s = float(sustain_s)
         self.cooldown_s = float(cooldown_s)
         self.window_s = float(window_s)
+        # tenants whose PRIVATE burn triggers scale-up even while the
+        # aggregate looks healthy: a small premium tenant drowned by a
+        # large batch tenant's fast requests never moves the pool-wide
+        # burn fraction, so the aggregate alone under-scales exactly
+        # when the highest-value SLO is burning
+        self.premium_tenants = tuple(premium_tenants)
         self._burn_since = None
         self._idle_since = None
         self._last_action_t = None
 
-    def decide(self, now, burn_rate, occupancy, queue_depth, replicas):
+    def decide(self, now, burn_rate, occupancy, queue_depth, replicas,
+               tenant_burns=None):
         """One policy evaluation; returns Decision(delta in {-1, 0, +1},
         reason). The caller applies the delta (and may refuse — the
-        policy's own min/max clamp already makes refusal rare)."""
+        policy's own min/max clamp already makes refusal rare).
+        `tenant_burns` (label -> burn fraction, optional) feeds the
+        premium_tenants early trigger; the gateway passes it only when
+        premium tenants are configured."""
         hot = burn_rate >= self.burn_threshold
-        idle = (burn_rate == 0.0 and occupancy <= self.idle_occupancy
+        hot_tenant = None
+        if tenant_burns and self.premium_tenants:
+            for t in self.premium_tenants:
+                if tenant_burns.get(t, 0.0) >= self.burn_threshold:
+                    hot_tenant = t
+                    hot = True
+                    break
+        idle = (not hot and burn_rate == 0.0
+                and occupancy <= self.idle_occupancy
                 and queue_depth == 0)
         if hot:
             if self._burn_since is None:
@@ -94,6 +112,12 @@ class AutoscalePolicy:
                                 % self.max_replicas)
             self._last_action_t = now
             self._burn_since = None
+            if hot_tenant is not None:
+                return Decision(+1, 'premium tenant %r burn %.2f >= '
+                                '%.2f for %.1fs'
+                                % (hot_tenant,
+                                   tenant_burns.get(hot_tenant, 0.0),
+                                   self.burn_threshold, self.sustain_s))
             return Decision(+1, 'burn %.2f >= %.2f for %.1fs'
                             % (burn_rate, self.burn_threshold,
                                self.sustain_s))

@@ -158,11 +158,15 @@ class ServingGateway:
         self._m_qos_ttft = qfams['qos_ttft_seconds']
         self._n_rejected = 0
         self._labeler = _events.TenantLabeler()
+        self._model_labeler = _events.ModelLabeler()
         # wide-event log, cached at construction like the tracer
         self.events = _events.default_request_log()
         self.pool = []                      # never shrinks; index == id
         self._pending = collections.deque()
         self._ttfts = collections.deque(maxlen=4096)   # (t, ttft_s)
+        # per-tenant TTFT windows for premium-burn autoscaling (bounded:
+        # labeler caps tenant cardinality, deque caps window length)
+        self._tenant_ttfts = {}             # label -> deque of (t, ttft_s)
         self.failover_log = []
         self._started = False
         # fleet telemetry (attach_fleet): replicas self-register as
@@ -180,7 +184,7 @@ class ServingGateway:
     # ---- front door ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=32, stream=False, tenant=None,
-               priority=None, **sampling):
+               priority=None, model=None, **sampling):
         """Accept one request; returns the GatewayRequest handle.
         Raises ValueError for requests no replica could EVER admit (the
         engines' front-door guard) — those must fail the caller, not
@@ -190,6 +194,9 @@ class ServingGateway:
         failover re-submit carries them: attribution and scheduling
         class survive replica loss by construction. `priority` defaults
         from the admission policy's tenant class (0 without one).
+        `model` rides the same way (routed like tenant: the router
+        prefers replicas already hosting it, the wide event records it);
+        None means the deployment's single/default model.
 
         With an admission policy, a shed request comes back as an
         already-finished handle (`error` set, outcome='rejected' in the
@@ -199,6 +206,8 @@ class ServingGateway:
             priority = adm.priority_of(tenant) if adm is not None else 0
         sampling = dict(sampling, max_new_tokens=max_new_tokens,
                         tenant=tenant, priority=int(priority))
+        if model is not None:
+            sampling['model'] = model
         gw = GatewayRequest(prompt, sampling, stream=stream)
         with self._lock:
             gw.arrival_t = self._clock()
@@ -284,9 +293,14 @@ class ServingGateway:
         replica loss (in-proc transports don't blip — see replica.py),
         so one walk both fails over the dead replica's in-flight work
         and still places gw if anyone is left."""
+        model = gw.sampling.get('model')
+        if model is not None and hasattr(self.router, 'candidates_for'):
+            candidates = self.router.candidates_for(self.pool, model)
+        else:
+            candidates = self.router.candidates(self.pool)
         with self._tracer.start_span(
                 'gateway.route', tags={'request_id': gw.id}) as span:
-            for rep in self.router.candidates(self.pool):
+            for rep in candidates:
                 if not rep.routable():     # lost earlier in this walk
                     continue
                 try:
@@ -410,6 +424,63 @@ class ServingGateway:
                 self._refresh_gauges_locked()
             return rep
 
+    # ---- hot-swap -----------------------------------------------------
+
+    def rollout(self, model, new_version):
+        """Zero-downtime version swap for `model` across the pool.
+
+        Three phases, ordered so no request is ever lost:
+
+        1. **Warm.** Every routable multi-model replica (its engine is a
+           registry.ModelHost) loads + pins the new version NEXT TO the
+           old one — a warm bring-up that must hit the compile cache
+           (same program shapes, new weights). In-flight requests on the
+           old version keep their weights: they hold refcounts.
+        2. **Flip.** Each distinct ModelRegistry's serving pointer moves
+           to `new_version` atomically — from this instant every new
+           submit(model=...) resolves to the new version.
+        3. **Drain.** The old version is unpinned and evicted ONCE its
+           refcount drops to zero (deferred eviction — the PR 8
+           drain-never-kill discipline applied to weights instead of
+           replicas). Nothing is cancelled.
+
+        Returns a summary dict; `cache_hits`/`cache_misses` are the
+        compile-cache delta across all warm loads (a correct rollout
+        warms entirely from cache). Raises ValueError when no replica
+        hosts models (the pool is single-model) or the version is
+        unknown."""
+        with self._lock:
+            hosts = [r for r in self.pool if r.routable()
+                     and hasattr(r.engine, 'prepare_rollout')]
+        if not hosts:
+            raise ValueError('no routable replica hosts models — '
+                             'rollout needs ModelHost-backed replicas')
+        with self._tracer.start_span(
+                'gateway.rollout',
+                tags={'model': model, 'version': new_version,
+                      'replicas': len(hosts)}):
+            registries = []
+            for r in hosts:
+                reg = r.engine.registry
+                if all(reg is not g for g in registries):
+                    registries.append(reg)
+            old = registries[0].serving_version(model)
+            infos = [r.engine.prepare_rollout(model, new_version)
+                     for r in hosts]
+            for reg in registries:
+                reg.set_serving(model, new_version)
+            for r in hosts:
+                r.engine.finish_rollout(model, old)
+        return {
+            'model': model,
+            'from_version': old,
+            'to_version': new_version,
+            'replicas': [r.index for r in hosts],
+            'cache_hits': sum(i.get('cache_hits', 0) for i in infos),
+            'cache_misses': sum(i.get('cache_misses', 0) for i in infos),
+            'load_s': sum(i.get('load_s', 0.0) for i in infos),
+        }
+
     # ---- delivery -----------------------------------------------------
 
     def _collect(self, rep):
@@ -427,12 +498,18 @@ class ServingGateway:
                     gw.first_token_t = now
                     ttft = now - gw.arrival_t
                     self._m_ttft.observe(ttft)
-                    self._m_tenant_ttft.labels(self._labeler.label(
-                        gw.sampling.get('tenant'))).observe(ttft)
+                    label = self._labeler.label(
+                        gw.sampling.get('tenant'))
+                    self._m_tenant_ttft.labels(label).observe(ttft)
                     self._m_qos_ttft.labels(
                         str(gw.sampling.get('priority') or 0)).observe(
                             ttft)
                     self._ttfts.append((now, ttft))
+                    win = self._tenant_ttfts.get(label)
+                    if win is None:
+                        win = self._tenant_ttfts[label] = \
+                            collections.deque(maxlen=1024)
+                    win.append((now, ttft))
                 gw.tokens.extend(new)
                 if gw._stream_q is not None:
                     for t in new:
@@ -486,6 +563,7 @@ class ServingGateway:
         log.emit(
             request_id=gw.id,
             tenant=self._labeler.label(gw.sampling.get('tenant')),
+            model=self._model_labeler.label(gw.sampling.get('model')),
             priority=gw.sampling.get('priority', 0),
             trace_id=trace_id,
             arrival_t=gw.arrival_t,
@@ -599,8 +677,22 @@ class ServingGateway:
                    if ready else 0.0)
             depth = len(self._pending) + sum(
                 int(r.queue_depth()) for r in ready)
-            decision = self.policy.decide(now, burn, occ, depth,
-                                          len(ready))
+            if getattr(self.policy, 'premium_tenants', None):
+                # per-tenant burn: the policy scales up when a premium
+                # tenant is burning even while the aggregate looks fine.
+                # Passed as a kwarg only when configured, so policies
+                # with the positional-only decide() keep working.
+                tenant_burns = {
+                    label: slo_burn_rate(win, now,
+                                         self.policy.slo_ttft_s,
+                                         self.policy.window_s)
+                    for label, win in self._tenant_ttfts.items()}
+                decision = self.policy.decide(now, burn, occ, depth,
+                                              len(ready),
+                                              tenant_burns=tenant_burns)
+            else:
+                decision = self.policy.decide(now, burn, occ, depth,
+                                              len(ready))
             if decision.delta > 0:
                 self._add_replica_locked()
                 self._m_scale.labels('up').inc()

@@ -11,7 +11,7 @@ from gateway-side shadow accounting: whatever a scrape of the replica
 would show is exactly what the router balances on.
 """
 
-__all__ = ['LeastLoadedRouter', 'RoundRobinRouter']
+__all__ = ['LeastLoadedRouter', 'ModelAffinityRouter', 'RoundRobinRouter']
 
 
 class LeastLoadedRouter:
@@ -27,6 +27,30 @@ class LeastLoadedRouter:
     def candidates(self, pool):
         rs = [r for r in pool if r.routable()]
         rs.sort(key=lambda r: (r.load(), r.index))
+        return rs
+
+
+class ModelAffinityRouter(LeastLoadedRouter):
+    """LeastLoaded with a model-residency tier in front: replicas whose
+    engine already hosts the requested model's weights rank before ones
+    that would have to page them in, least-loaded within each tier.
+
+    The gateway calls `candidates_for(pool, model)` when a request names
+    a model; requests without one (and single-model pools) fall through
+    to the plain least-loaded ranking. Residency is read through the
+    engine's `hosts_model` when it exists (registry.ModelHost); an
+    ordinary engine has no residency notion and ranks in the cold tier —
+    harmless, since a single-model pool never names models.
+    """
+
+    name = 'model_affinity'
+
+    def candidates_for(self, pool, model):
+        def hosts(r):
+            fn = getattr(r.engine, 'hosts_model', None)
+            return bool(fn(model)) if fn is not None else False
+        rs = [r for r in pool if r.routable()]
+        rs.sort(key=lambda r: (0 if hosts(r) else 1, r.load(), r.index))
         return rs
 
 

@@ -18,7 +18,7 @@ Two outputs from the same events:
 import time
 
 from ..monitor import tracing as _tracing
-from ..monitor.events import TenantLabeler
+from ..monitor.events import ModelLabeler, TenantLabeler
 from ..monitor.registry import default_registry
 from ..monitor.telemetry import (record_qos_schema,
                                  record_serving_schema,
@@ -96,6 +96,7 @@ class ServingMetrics:
         self._m_qos_preempted = qos['qos_preempted_total']
         self._m_qos_resumed = qos['qos_resumed_total']
         self._labeler = TenantLabeler()
+        self._model_labeler = ModelLabeler()
         self._prefill_tokens = 0
         self._prefix_hits = 0
         self._prefix_misses = 0
@@ -187,6 +188,11 @@ class ServingMetrics:
     def tenant_label(self, tenant):
         """The bounded metric label for `tenant` (None -> 'default')."""
         return self._labeler.label(tenant)
+
+    def model_label(self, model):
+        """The bounded metric label for `model` (None stays None — a
+        request without a named model is unattributed, not 'default')."""
+        return self._model_labeler.label(model)
 
     def on_tenant_tokens(self, label, count):
         """`count` generated tokens attributed to tenant `label` (a

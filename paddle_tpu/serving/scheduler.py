@@ -44,7 +44,7 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens=32, temperature=1.0,
                  top_k=0, do_sample=False, seed=0, tenant=None,
-                 priority=0):
+                 priority=0, model=None):
         self.id = next(_req_ids)
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
@@ -53,6 +53,7 @@ class Request:
         self.do_sample = bool(do_sample)
         self.seed = int(seed)
         self.tenant = tenant      # attribution dimension (opaque string)
+        self.model = model        # target model name (multi-model hosts)
         self.priority = int(priority)   # higher preempts lower; FIFO ties
         self.outcome = None       # terminal outcome, set at retirement
         self.tokens = []          # generated ids (prompt NOT included)

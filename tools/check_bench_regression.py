@@ -43,8 +43,8 @@ from tools import gate_common  # noqa: E402
 # different contracts and must gate separately.
 _AUX_CONFIG = ('replicas', 'kill_at', 'policy',
                'num_slots', 'new_tokens', 'prompt_len', 'image_size',
-               'trace', 'model', 'scan_steps', 'page_size', 'spec_k',
-               'workload', 'tenant')
+               'trace', 'model', 'n_models', 'swap_at', 'scan_steps',
+               'page_size', 'spec_k', 'workload', 'tenant')
 
 __all__ = ['eligible', 'config_key', 'higher_is_better', 'expand_derived',
            'check', 'main']

@@ -1,0 +1,180 @@
+"""Multi-model serving gate: registry metrics joined with per-model
+wide events (monitor/events.py + REGISTRY_FAMILIES in telemetry.py).
+
+Inputs:
+
+    --jsonl   FILE     a RequestLog sink (repeatable) — the per-model
+                       wide events of the run under review;
+    --rollout FILE     the JSON summary a `ServingGateway.rollout()`
+                       returned (the bench writes it next to its rows),
+                       optionally extended with the replay's
+                       'requests' / 'completed' counts;
+    --metrics FILE     a monitor export.to_dict() JSON snapshot — the
+                       registry_* families are read out of it.
+
+The gate asks the two questions a hot-swap must answer:
+
+  * **Did the rollout lose requests?** `completed < requests` in the
+    rollout summary (or any wide event for the swapped model with a
+    non-ok outcome when --model is given) is a finding — the whole
+    point of drain-never-kill weight swaps is completed_ratio == 1.0.
+  * **Did the warm bring-up miss the compile cache?** `cache_misses >
+    0` in the rollout summary means the new version recompiled instead
+    of hitting the content-fingerprint-keyed persistent cache — a
+    finding, because a recompiling rollout stalls the pool for the
+    compile time it was designed to avoid.
+
+Metrics cross-checks (when --metrics is given): evictions counted while
+registry_evictions_deferred_total stayed zero AND in-flight refcounts
+were claimed is fine; what the gate flags is a negative residency gauge
+or resident bytes above --byte-budget — both impossible states that
+mean the paging accounting broke.
+
+Exit codes (tools/gate_common): 0 ok, 1 findings, 2 nothing to check.
+"""
+import argparse
+import json
+import os
+import sys
+import types
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# monitor/ is stdlib-only but the package __init__ pulls in jax: load
+# the subpackage without executing the parent (request_report's pattern)
+if 'paddle_tpu' not in sys.modules:
+    _pkg = types.ModuleType('paddle_tpu')
+    _pkg.__path__ = [os.path.join(_REPO_ROOT, 'paddle_tpu')]
+    sys.modules['paddle_tpu'] = _pkg
+
+from tools import gate_common  # noqa: E402
+from tools.request_report import (load_events,  # noqa: E402
+                                  rollup_by_model)
+
+__all__ = ['registry_values', 'check', 'main']
+
+
+def registry_values(metrics_doc):
+    """{metric_name: scalar or {label_tuple: scalar}} for the
+    registry_* families of an export.to_dict() snapshot. Histograms
+    reduce to their sample count (the gate only needs 'how many loads
+    were observed')."""
+    out = {}
+    for name, fam in (metrics_doc or {}).items():
+        if not name.startswith('registry_'):
+            continue
+        samples = fam.get('samples') or ()
+        vals = {}
+        for s in samples:
+            labels = tuple(sorted((s.get('labels') or {}).items()))
+            vals[labels] = (s['count'] if 'count' in s
+                            else s.get('value', 0))
+        if list(vals) == [()]:
+            out[name] = vals[()]
+        else:
+            out[name] = vals
+    return out
+
+
+def check(events, rollout=None, metrics=None, model=None,
+          byte_budget=None):
+    """Pure gate: findings list (empty == pass)."""
+    findings = []
+    if rollout:
+        req = rollout.get('requests')
+        done = rollout.get('completed')
+        if req is not None and done is not None and done < req:
+            findings.append({
+                'problem': 'rollout_lost_requests',
+                'model': rollout.get('model'),
+                'from_version': rollout.get('from_version'),
+                'to_version': rollout.get('to_version'),
+                'requests': req, 'completed': done,
+                'note': 'a zero-downtime rollout must complete every '
+                        'in-flight and queued request (drain-never-kill '
+                        'applied to weights)'})
+        if int(rollout.get('cache_misses') or 0) > 0:
+            findings.append({
+                'problem': 'rollout_compile_cache_miss',
+                'model': rollout.get('model'),
+                'to_version': rollout.get('to_version'),
+                'cache_misses': int(rollout['cache_misses']),
+                'cache_hits': int(rollout.get('cache_hits') or 0),
+                'note': 'warm bring-up recompiled — the new version '
+                        'should hit the persistent compile cache (same '
+                        'program shapes, new weights)'})
+    if model is not None:
+        for ev in events:
+            if ev.get('model') == model and \
+                    ev.get('outcome') not in (None, 'ok'):
+                findings.append({
+                    'problem': 'model_request_not_ok',
+                    'model': model,
+                    'request_id': ev.get('request_id'),
+                    'outcome': ev.get('outcome')})
+    vals = registry_values(metrics)
+    resident = vals.get('registry_resident_bytes')
+    if isinstance(resident, (int, float)):
+        if resident < 0:
+            findings.append({'problem': 'negative_resident_bytes',
+                             'registry_resident_bytes': resident})
+        elif byte_budget is not None and resident > byte_budget:
+            findings.append({
+                'problem': 'resident_bytes_over_budget',
+                'registry_resident_bytes': resident,
+                'byte_budget': byte_budget,
+                'note': 'weight paging must hold the residency gauge '
+                        'at or under the configured byte budget'})
+    n_models = vals.get('registry_models_resident')
+    if isinstance(n_models, (int, float)) and n_models < 0:
+        findings.append({'problem': 'negative_models_resident',
+                         'registry_models_resident': n_models})
+    return findings
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--jsonl', action='append', default=[],
+                    help='RequestLog JSONL sink (repeatable)')
+    ap.add_argument('--rollout',
+                    help='rollout summary JSON (gateway.rollout() '
+                         'return value, + optional requests/completed)')
+    ap.add_argument('--metrics',
+                    help='export.to_dict() JSON snapshot to cross-check '
+                         'registry_* families')
+    ap.add_argument('--model',
+                    help='gate: fail on any non-ok wide event for this '
+                         'model (the swapped one)')
+    ap.add_argument('--byte-budget', type=int,
+                    help='gate: registry_resident_bytes must not '
+                         'exceed this')
+    args = ap.parse_args(argv)
+
+    events, skipped = load_events(args.jsonl, ())
+    rollout = metrics = None
+    if args.rollout:
+        with open(args.rollout, errors='replace') as f:
+            rollout = json.load(f)
+    if args.metrics:
+        with open(args.metrics, errors='replace') as f:
+            metrics = json.load(f)
+    if not events and rollout is None and metrics is None:
+        return gate_common.nothing_to_check(
+            'no wide events, rollout summary or metrics snapshot',
+            skipped=skipped)
+
+    findings = check(events, rollout=rollout, metrics=metrics,
+                     model=args.model, byte_budget=args.byte_budget)
+    summary = {'events': len(events), 'skipped_lines': skipped,
+               'models': rollup_by_model(events)}
+    if rollout is not None:
+        summary['rollout'] = rollout
+    if metrics is not None:
+        summary['registry_metrics'] = registry_values(metrics)
+    return gate_common.finish(findings, summary)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -52,7 +52,8 @@ from paddle_tpu.monitor.events import (FIELD_NAMES,  # noqa: E402
                                        parse_event_lines)
 from tools import gate_common  # noqa: E402
 
-__all__ = ['load_events', 'rollup_by_tenant', 'slowest', 'check', 'main']
+__all__ = ['load_events', 'rollup_by_tenant', 'rollup_by_model',
+           'slowest', 'check', 'main']
 
 
 def _percentile(values, q):
@@ -164,6 +165,37 @@ def rollup_by_tenant(events):
     return by
 
 
+def rollup_by_model(events):
+    """{model: {requests, tokens, ttft_p50_ms, ttft_p99_ms, failovers,
+    rejected, errors}} — the multi-model attribution table. Events
+    without a model field (single-model deployments, pre-schema logs)
+    fold under '(none)': they are unattributed, not a named model."""
+    by = {}
+    for ev in events:
+        m = ev.get('model') or '(none)'
+        row = by.setdefault(m, {'requests': 0, 'tokens': 0,
+                                'failovers': 0, 'rejected': 0,
+                                'errors': 0, '_ttfts': []})
+        row['requests'] += 1
+        row['tokens'] += int(ev.get('output_tokens') or 0)
+        row['failovers'] += int(ev.get('failovers') or 0)
+        outcome = ev.get('outcome')
+        if outcome == 'rejected':
+            row['rejected'] += 1
+        elif outcome not in (None, 'ok', 'preempted'):
+            row['errors'] += 1
+        ttft = _ttft_s(ev)
+        if ttft is not None:
+            row['_ttfts'].append(ttft)
+    for row in by.values():
+        ttfts = row.pop('_ttfts')
+        row['ttft_p50_ms'] = (None if not ttfts
+                              else _percentile(ttfts, 50) * 1e3)
+        row['ttft_p99_ms'] = (None if not ttfts
+                              else _percentile(ttfts, 99) * 1e3)
+    return by
+
+
 def _trace_ids_in_file(path):
     """Every trace_id mentioned in a flight dump ({'spans': [...]}) or a
     Chrome trace ({'traceEvents': [...]}, ids under args)."""
@@ -223,6 +255,7 @@ def main(argv=None):
     ap.add_argument('--top', type=int, default=10,
                     help='slowest requests to list (default %(default)s)')
     ap.add_argument('--tenant', help='restrict the report to one tenant')
+    ap.add_argument('--model', help='restrict the report to one model')
     ap.add_argument('--flight-dump', action='append', default=[],
                     help='flight-recorder dump JSON to join by trace_id')
     ap.add_argument('--chrome-trace', action='append', default=[],
@@ -244,6 +277,8 @@ def main(argv=None):
     events, skipped = load_events(args.jsonl, texts)
     if args.tenant:
         events = [e for e in events if e.get('tenant') == args.tenant]
+    if args.model:
+        events = [e for e in events if e.get('model') == args.model]
     if not events:
         return gate_common.nothing_to_check('no wide events found',
                                             skipped=skipped)
@@ -262,6 +297,7 @@ def main(argv=None):
         'events': len(events), 'skipped_lines': skipped,
         'fields': list(FIELD_NAMES),
         'tenants': rollup_by_tenant(events),
+        'models': rollup_by_model(events),
         'slowest': top,
         'joined_trace_ids': len(known)})
 

@@ -1099,6 +1099,128 @@ def bench_serving_gateway_multimodel(on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_serving_fabric(on_tpu):
+    """Serving-fabric rung (ISSUE 20): the gateway fronting REAL worker
+    processes over the socket transport.
+
+    Three measurements, each on a fresh 2-process worker pair:
+
+    - clean Poisson burst tok/s (the cross-process tax vs the in-proc
+      bench_serving_gateway rung is this row's whole point);
+    - the same burst with one worker SIGKILLed mid-run — the chaos
+      acceptance: completed_ratio must stay 1.0 (token parity of
+      failed-over requests is pinned in tests/test_serving_fabric.py);
+    - a shared-system-prompt workload routed by LeastLoaded vs the
+      gateway's PrefixAffinityRouter over paged workers: the prefix
+      directory's hit-rate win is the tracked value.
+
+    Rows are keyed by transport/n_procs (+ policy for the router pair)
+    in the regression gate's aux config.
+    """
+    from paddle_tpu.capacity import workload
+    from paddle_tpu.capacity.replay import replay as replay_trace
+    from paddle_tpu.monitor import events as _events
+    from paddle_tpu.monitor.registry import MetricRegistry
+    from paddle_tpu.serving import ServingGateway
+    from paddle_tpu.serving.fabric import (PrefixAffinityRouter,
+                                           SocketReplica, spawn_worker)
+
+    n_procs = 2
+    vocab = 211                      # the preset zoo's vocab
+    # prompt + 16 new tokens must fit the gpt-nano preset's max_len=32
+    spec = _serving_workload(16, (4, 8, 12, 14), 16, 0.002, vocab)
+    trace = spec.generate()
+    prompts = trace.prompts()
+
+    def fabric_gateway(handles, router=None):
+        gw = ServingGateway(None, replicas=0, router=router,
+                            registry=MetricRegistry())
+        for h in handles:
+            gw.adopt_replica(SocketReplica(
+                h.endpoint, metrics_url=h.metrics_url,
+                poll_interval=0.002).connect())
+        return gw
+
+    def drive(preset, wl_trace, mnt, kill_at=None, router=None):
+        handles = [spawn_worker(preset=preset) for _ in range(n_procs)]
+        log = _events.RequestLog(capacity=4096)
+        prev = _events.set_default_request_log(log)
+        try:
+            gw = fabric_gateway(handles, router=router)
+            t0c = time.time()
+            gw.generate(wl_trace.prompts()[:n_procs],
+                        max_new_tokens=2)             # compile workers
+            t_cold = time.time() - t0c
+            log.clear()
+            gw.start()
+            kill_i = None if kill_at is None else \
+                int(len(wl_trace) * kill_at)
+
+            def maybe_kill(i):
+                if kill_i is not None and i == kill_i:
+                    handles[0].kill()                 # SIGKILL, no drain
+
+            res = replay_trace(gw, wl_trace, max_new_tokens=mnt,
+                               timeout=600, before_submit=maybe_kill)
+            failovers = int(gw.registry.get(
+                'gateway_failover_total').value())
+            gw.shutdown()
+            evs = log.events()
+            hit = sum(e.get('prefix_hit_tokens') or 0 for e in evs)
+            prompt_toks = sum(e.get('prompt_tokens') or 0 for e in evs)
+            return (res, failovers, t_cold,
+                    hit / prompt_toks if prompt_toks else 0.0)
+        finally:
+            _events.set_default_request_log(prev)
+            for h in handles:
+                h.cleanup()
+
+    base = {'unit': 'tokens/sec', 'trace': 'poisson',
+            'transport': 'socket', 'n_procs': n_procs, 'requests': 16,
+            'new_tokens': 16, 'policy': 'least_loaded',
+            'workload_spec': spec.hash, 'degraded': not on_tpu}
+    rows = []
+    res, fo, t_cold, _ = drive('gpt-nano', trace, 16)
+    rows.append(dict(base, metric='serving_fabric_tokens_per_sec',
+                     value=round(res.tokens_per_sec, 2), kill_at='none',
+                     failovers=fo, compile_s_cold=round(t_cold, 3),
+                     completed_ratio=round(res.completed_ratio, 4)))
+    res, fo, t_cold, _ = drive('gpt-nano', trace, 16, kill_at=0.5)
+    rows.append(dict(base, metric='serving_fabric_tokens_per_sec_chaos',
+                     value=round(res.tokens_per_sec, 2), kill_at=0.5,
+                     failovers=fo, compile_s_cold=round(t_cold, 3),
+                     completed_ratio=round(res.completed_ratio, 4)))
+    rows.append(dict(base, metric='serving_fabric_completed_ratio',
+                     value=round(res.completed_ratio, 4), unit='ratio',
+                     kill_at=0.5, failovers=fo))
+
+    # shared-system-prompt workload over paged workers: 90% of requests
+    # share a 24-token system prefix (3 pages at the preset's page
+    # size 8) in 4 groups — more groups than replicas, so least-loaded
+    # pays a cold miss per (group, replica) pair while affinity pays
+    # one per group; short tails keep the prefix dominant. Max prompt
+    # 24 + 8 = 32, + 8 new tokens fits gpt-nano-paged's max_len=64.
+    pspec = workload.WorkloadSpec(
+        requests=24, seed=1, vocab_size=vocab,
+        arrival={'process': 'poisson', 'mean_gap_s': 0.002},
+        lengths={'dist': 'ladder', 'lens': [4, 8]},
+        output={'dist': 'fixed', 'len': 8},
+        prefix={'len': 24, 'groups': 4, 'prob': 0.9})
+    ptrace = pspec.generate()
+    for router, policy in ((None, 'least_loaded'),
+                           (PrefixAffinityRouter(page_size=8),
+                            'prefix_affinity')):
+        res, _, _, hit_rate = drive('gpt-nano-paged', ptrace, 8,
+                                    router=router)
+        rows.append(dict(base, metric='serving_fabric_prefix_hit_rate',
+                         value=round(hit_rate, 4), unit='ratio',
+                         policy=policy, kill_at='none', requests=24,
+                         new_tokens=8, workload_spec=pspec.hash,
+                         tokens_per_sec=round(res.tokens_per_sec, 2),
+                         completed_ratio=round(res.completed_ratio, 4)))
+    return rows
+
+
 def bench_supervisor_recovery(on_tpu):
     """Elastic-supervisor MTTR rung (ISSUE 14): a journaled PS shard is
     snapshotted, hard-killed, and recovered by the ShardSupervisor
@@ -1436,7 +1558,7 @@ def main():
     for fn in (bench_resnet, bench_yolo_infer, bench_gpt_decode,
                bench_serving, bench_serving_paged, bench_serving_gateway,
                bench_serving_gateway_tenants, bench_serving_gateway_qos,
-               bench_serving_gateway_multimodel,
+               bench_serving_gateway_multimodel, bench_serving_fabric,
                bench_supervisor_recovery, bench_capacity_calibration,
                bench_ingest):
         try:

@@ -30,7 +30,8 @@ from ..monitor import tracing as _tracing
 
 __all__ = ['RetryPolicy', 'Deadline', 'CircuitBreaker', 'ResilientChannel',
            'RpcError', 'RetryableError', 'DeadlineExceeded',
-           'CircuitOpenError', 'fire_fault_points', 'DEFAULT_CALL_TIMEOUT',
+           'CircuitOpenError', 'FrameError', 'FrameTooLargeError',
+           'FrameDecodeError', 'fire_fault_points', 'DEFAULT_CALL_TIMEOUT',
            'DEFAULT_CONNECT_TIMEOUT']
 
 DEFAULT_CALL_TIMEOUT = 30.0      # per-attempt send+recv budget (seconds)
@@ -102,6 +103,23 @@ class DeadlineExceeded(RetryableError):
 class CircuitOpenError(RetryableError):
     """Fast-fail: the endpoint's breaker is open (recent failures, the
     reset window has not elapsed). Callers should back off or re-shard."""
+
+
+class FrameError(RpcError):
+    """Malformed or oversized frame. Deliberately NOT retryable:
+    resending the same bytes reproduces the same corruption, and a
+    peer speaking a different protocol should fail loud, not retry
+    until the deadline burns down."""
+
+
+class FrameTooLargeError(FrameError):
+    """Declared frame length exceeds the codec's max_frame bound —
+    refuse before allocating, so a corrupted length header cannot OOM
+    the receiver."""
+
+
+class FrameDecodeError(FrameError):
+    """Frame arrived whole but the payload failed to decode."""
 
 
 # transient socket errnos worth a reconnect (vs e.g. EACCES/EBADF bugs)
@@ -257,18 +275,30 @@ class CircuitBreaker:
         return False
 
 
-# -- framed messages over the PS wire codec ---------------------------------
-# Same frame as ps/embedding_service (8-byte big-endian length + wire
+# -- framed messages, codec-pluggable ---------------------------------------
+# Same frame as ps/embedding_service (8-byte big-endian length + payload
 # bytes); lives here so the channel owns its transport end-to-end and the
 # ps module can keep its server-side helpers without an import cycle.
+# `codec` is an (encode, decode) pair; None means the PS binary wire
+# codec (the historical default — existing PS/graph clients unchanged).
+# The serving fabric passes its length-prefixed JSON codec instead
+# (serving/fabric/protocol.py), riding the identical retry/breaker/
+# deadline/trace machinery over a different payload encoding.
 
-def _send_frame(sock, obj):
-    from .ps import wire
-    payload = wire.encode(obj)
+def _send_frame(sock, obj, codec=None, max_frame=None):
+    if codec is None:
+        from .ps import wire
+        payload = wire.encode(obj)
+    else:
+        payload = codec[0](obj)
+    if max_frame is not None and len(payload) > max_frame:
+        raise FrameTooLargeError(
+            'refusing to send %d-byte frame (max_frame=%d)'
+            % (len(payload), max_frame))
     sock.sendall(struct.pack('>Q', len(payload)) + payload)
 
 
-def _recv_frame(sock):
+def _recv_frame(sock, codec=None, max_frame=None):
     hdr = b''
     while len(hdr) < 8:
         chunk = sock.recv(8 - len(hdr))
@@ -276,14 +306,19 @@ def _recv_frame(sock):
             raise ConnectionError('peer closed')
         hdr += chunk
     n = struct.unpack('>Q', hdr)[0]
+    if max_frame is not None and n > max_frame:
+        raise FrameTooLargeError(
+            'peer declared %d-byte frame (max_frame=%d)' % (n, max_frame))
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             raise ConnectionError('peer closed')
         buf.extend(chunk)
-    from .ps import wire
-    return wire.decode(bytes(buf))
+    if codec is None:
+        from .ps import wire
+        return wire.decode(bytes(buf))
+    return codec[1](bytes(buf))
 
 
 class ResilientChannel:
@@ -299,11 +334,13 @@ class ResilientChannel:
     def __init__(self, endpoint, retry_policy=None,
                  call_timeout=DEFAULT_CALL_TIMEOUT,
                  connect_timeout=DEFAULT_CONNECT_TIMEOUT,
-                 breaker=None):
+                 breaker=None, codec=None, max_frame=None):
         host, port = endpoint.rsplit(':', 1)
         self.endpoint = endpoint
         self._addr = (host, int(port))
         self.policy = retry_policy or RetryPolicy()
+        self.codec = codec
+        self.max_frame = max_frame
         self.call_timeout = call_timeout
         self.connect_timeout = connect_timeout
         self.breaker = breaker if breaker is not None \
@@ -353,9 +390,9 @@ class ResilientChannel:
         per_try = timeout if deadline is None else deadline.clamp(timeout)
         sock.settimeout(per_try)
         _fire('send', self.endpoint)
-        _send_frame(sock, msg)
+        _send_frame(sock, msg, self.codec, self.max_frame)
         _fire('recv', self.endpoint)
-        return _recv_frame(sock)
+        return _recv_frame(sock, self.codec, self.max_frame)
 
     def call(self, msg, idempotent=True, timeout=None, deadline=None):
         """Send one request, return the decoded reply.

@@ -25,6 +25,8 @@ verify) and the thread-safe front door, metrics.py turns step
 timestamps into tok/s + latency percentiles. See docs/serving.md.
 """
 from .engine import ContinuousBatchingEngine
+from .fabric import (PrefixAffinityRouter, ReplicaWorker, SocketReplica,
+                     spawn_worker)
 from .gateway import (AutoscalePolicy, GatewayRequest, ModelAffinityRouter,
                       QosPolicy, ServingGateway, TenantClass)
 from .kv_cache import (PageAllocator, PrefixCache, SlotAllocator,
@@ -40,4 +42,6 @@ __all__ = ['ContinuousBatchingEngine', 'PagedContinuousBatchingEngine',
            'ServingMetrics', 'Request', 'Scheduler', 'PagedScheduler',
            'ServingGateway', 'GatewayRequest', 'AutoscalePolicy',
            'QosPolicy', 'TenantClass', 'ModelAffinityRouter',
-           'ModelRegistry', 'RegistryEntry', 'ModelHost']
+           'ModelRegistry', 'RegistryEntry', 'ModelHost',
+           'SocketReplica', 'ReplicaWorker', 'PrefixAffinityRouter',
+           'spawn_worker']

@@ -120,7 +120,14 @@ class ServingGateway:
     def __init__(self, engine_factory, replicas=2, router=None,
                  autoscaler=None, admission=None, registry=None,
                  clock=None):
-        if replicas < 1:
+        if engine_factory is None:
+            # fabric mode: the pool is populated by adopt_replica()
+            # (e.g. SocketReplicas proxying worker processes), so there
+            # is nothing to build locally
+            if replicas:
+                raise ValueError('engine_factory=None requires replicas=0 '
+                                 '(populate the pool via adopt_replica)')
+        elif replicas < 1:
             raise ValueError('need at least one replica')
         self._factory = engine_factory
         self._clock = clock or time.monotonic
@@ -294,7 +301,11 @@ class ServingGateway:
         so one walk both fails over the dead replica's in-flight work
         and still places gw if anyone is left."""
         model = gw.sampling.get('model')
-        if model is not None and hasattr(self.router, 'candidates_for'):
+        if hasattr(self.router, 'candidates_for_request'):
+            # request-aware routing (e.g. fabric.PrefixAffinityRouter):
+            # the router sees the PROMPT, which candidates() never does
+            candidates = self.router.candidates_for_request(self.pool, gw)
+        elif model is not None and hasattr(self.router, 'candidates_for'):
             candidates = self.router.candidates_for(self.pool, model)
         else:
             candidates = self.router.candidates(self.pool)
@@ -315,6 +326,12 @@ class ServingGateway:
                 rep.assigned[gw] = eng_req
                 gw._eng_req = eng_req
                 gw.replica_history.append(rep.index)
+                note = getattr(self.router, 'note_placement', None)
+                if note is not None:
+                    # feed the prefix directory on EVERY placement,
+                    # failover re-placements included — the hint table
+                    # tracks where the tokens actually went
+                    note(gw.prompt, rep.index)
                 self._m_route.labels(str(rep.index)).inc()
                 span.set_tag('replica', rep.index)
                 if gw.failovers and eng_req._span is not None:
@@ -722,13 +739,35 @@ class ServingGateway:
     def _fleet_register_locked(self, rep):
         if self._fleet is None:
             return
-        # idempotent: re-attach / re-add keeps the same instance name
+        # idempotent: re-attach / re-add keeps the same instance name.
+        # The transport picks HOW it is scraped: in-proc replicas hand
+        # over their private registry, SocketReplicas hand over the
+        # worker process's /metrics.json URL (stale-not-wrong on kill).
         self._fleet.add_target('gw-replica-%d' % rep.index,
-                               registry=rep.registry)
+                               **rep.scrape_kwargs())
 
     # ---- pool management ----------------------------------------------
 
+    def adopt_replica(self, rep):
+        """Add an externally built ReplicaTransport (e.g. a fabric
+        SocketReplica proxying a worker process) to the pool. The
+        gateway assigns the pool index; everything downstream —
+        routing, failover, QoS, rollout, fleet registration — treats
+        it exactly like a factory-built replica."""
+        with self._lock:
+            rep.index = len(self.pool)
+            self.pool.append(rep)
+            if self._started:
+                rep.start_driver(self._collect, self._on_lost)
+            self._fleet_register_locked(rep)
+            self._refresh_gauges_locked()
+            return rep
+
     def _add_replica_locked(self):
+        if self._factory is None:
+            raise RuntimeError('gateway has no engine_factory — scale '
+                               'fabric pools by adopting new workers, '
+                               'not by local replica construction')
         rep = InprocReplica(len(self.pool), self._factory())
         self.pool.append(rep)
         if self._started:

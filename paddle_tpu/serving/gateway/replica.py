@@ -22,20 +22,22 @@ Lifecycle: READY -> DRAINING (no new admissions, in-flight decode
 finishes) -> STOPPED, or -> DEAD on transport loss. The gateway owns
 all transitions except DRAINING -> STOPPED, which the driver thread
 takes when the drained engine runs empty.
+
+The lifecycle ladder, condvar discipline and driver loop live in the
+extracted base class (serving/fabric/transport.py) so a replica in
+another PROCESS (fabric.SocketReplica) walks the identical ladder;
+this module keeps only what is in-proc specific: the engine binding,
+the chaos hook points, and the shared-model trace lock.
 """
 import threading
 
-from ...distributed.resilience import CircuitBreaker, fire_fault_points
-from ...monitor.registry import MetricRegistry
+from ...distributed.resilience import fire_fault_points
+from ..fabric.transport import (DEAD, DRAINING, READY, STATE_CODES,
+                                STOPPED, ReplicaTransport)
 from ..metrics import ServingMetrics
 
 __all__ = ['InprocReplica', 'READY', 'DRAINING', 'DEAD', 'STOPPED',
            'STATE_CODES']
-
-READY = 'ready'
-DRAINING = 'draining'
-DEAD = 'dead'
-STOPPED = 'stopped'
 
 # Replicas commonly share ONE model object (decode_gateway clones the
 # engine, not the artifact). Compiled dispatches are re-entrant, but
@@ -46,18 +48,13 @@ STOPPED = 'stopped'
 # nothing.
 _TRACE_LOCK = threading.Lock()
 
-# gauge encoding for gateway_replica_state (docs/observability.md)
-STATE_CODES = {READY: 0, DRAINING: 1, DEAD: 2, STOPPED: 3}
 
-
-class InprocReplica:
+class InprocReplica(ReplicaTransport):
 
     def __init__(self, index, engine, breaker=None, registry=None):
-        self.index = int(index)
+        super().__init__(index, 'inproc://gw-replica-%d' % int(index),
+                         breaker=breaker, registry=registry)
         self.engine = engine
-        self.endpoint = 'inproc://gw-replica-%d' % self.index
-        self.registry = registry if registry is not None \
-            else MetricRegistry()
         # rebind the engine's metrics onto the private registry (the
         # bench-established pattern for multi-engine processes); the
         # construction-time trace gauge stays on the old registry, which
@@ -67,17 +64,6 @@ class InprocReplica:
         # rebind also re-keys the watchdog's owner filter so replica A's
         # armed watchdog ignores replica B's first-compile events
         engine.rebind_perf(self.registry)
-        if breaker is None:
-            breaker = CircuitBreaker(failure_threshold=1,
-                                     reset_timeout=3600.0)
-        breaker.bind_name(self.endpoint)
-        self.breaker = breaker
-        self.state = READY
-        # GatewayRequest -> engine Request; guarded by the GATEWAY lock
-        # (never touched by the driver thread directly)
-        self.assigned = {}
-        self._cv = threading.Condition()
-        self._thread = None
 
     # ---- transport (chaos hook points fire around every engine op) ----
 
@@ -110,6 +96,9 @@ class InprocReplica:
         fire_fault_points('recv', self.endpoint)
         return n
 
+    def has_pending(self):
+        return bool(self.engine.scheduler.pending)
+
     def _untraced(self):
         """Any program this engine will certainly trace still untraced?
         ('verify' only traces when speculation is on.)"""
@@ -131,93 +120,12 @@ class InprocReplica:
         return self._gauge('serving_occupancy')
 
     def load(self):
-        """Router ranking key: queued requests + occupied slots, both in
-        request units."""
         return (self.queue_depth()
                 + self.occupancy() * self.engine.num_slots)
 
-    def routable(self):
-        """May the router place NEW work here?"""
-        return self.state == READY and self.breaker.allow()
-
-    @property
-    def alive(self):
-        """Still worth stepping (in-flight work may exist)?"""
-        return self.state in (READY, DRAINING)
-
-    def ready(self):
-        """/readyz readiness: READY routes, anything else 503s while
-        /healthz stays 200 (drain must not get the process restarted)."""
-        return self.state == READY
-
-    def metrics_server(self, **kwargs):
-        """A MetricsServer over this replica's private registry with
-        readiness wired to its drain state (not started)."""
-        from ...monitor.server import MetricsServer
-        return MetricsServer(registry=self.registry, readiness=self.ready,
-                             **kwargs)
-
-    # ---- lifecycle (gateway lock held unless noted) -------------------
+    # ---- lifecycle ----------------------------------------------------
 
     def drain(self):
         """Stop admissions, let in-flight decode finish."""
-        self._transition(DRAINING)
+        super().drain()
         self.engine.shutdown()
-
-    def mark_dead(self):
-        self._transition(DEAD)
-
-    def mark_stopped(self):
-        self._transition(STOPPED)
-
-    def _transition(self, state):
-        """All writes of `state` go through the condvar: the driver
-        thread check-and-sets DRAINING -> STOPPED under _cv, so a bare
-        write here could race it and overwrite DEAD with STOPPED."""
-        with self._cv:
-            self.state = state
-            self._cv.notify_all()
-
-    def wake(self):
-        with self._cv:
-            self._cv.notify_all()
-
-    # ---- driver thread ------------------------------------------------
-
-    def start_driver(self, on_step, on_lost):
-        """Spawn the replica's drive loop: step whenever work exists,
-        park on the condvar otherwise. `on_step(self)` runs after every
-        successful step (the gateway collects tokens there);
-        `on_lost(self, exc)` runs once on transport failure and the
-        thread exits. Neither callback is invoked under the condvar, so
-        the gateway lock ordering (gateway -> engine) holds."""
-        def _run():
-            while True:
-                with self._cv:
-                    while self.alive and not self.engine.scheduler.pending:
-                        if self.state == DRAINING and not self.assigned:
-                            self.state = STOPPED
-                            return
-                        self._cv.wait(0.02)
-                    if not self.alive:
-                        return
-                try:
-                    self.step()
-                except Exception as exc:     # noqa: BLE001 — transport
-                    on_lost(self, exc)
-                    return
-                on_step(self)
-
-        self._thread = threading.Thread(
-            target=_run, name='gw-replica-%d' % self.index, daemon=True)
-        self._thread.start()
-        return self._thread
-
-    def join(self, timeout=None):
-        if self._thread is not None:
-            self._thread.join(timeout)
-
-    def __repr__(self):
-        return ('InprocReplica(%d, %s, load=%.1f, assigned=%d)'
-                % (self.index, self.state, self.load(),
-                   len(self.assigned)))

@@ -1,0 +1,46 @@
+"""Trigger: every idempotency rule, in the serving-fabric op shapes.
+
+The OP_SEMANTICS table mirrors the fabric worker's wire surface
+(serving/fabric/worker.py); each send below breaks the declared
+discipline the real SocketReplica upholds, and the table carries one
+stale entry the handler never dispatches — the two-way check's other
+direction.
+"""
+
+OP_SEMANTICS = {
+    'submit': 'conditional',      # idempotent iff journaled
+    'poll': 'idempotent',
+    'drain': 'idempotent',        # STALE: the handler below lost it
+    'stop': 'non_idempotent',
+}
+
+
+def handle(msg):
+    op = msg.get('op')
+    if op == 'submit':
+        return 1
+    elif op == 'poll':
+        return 2
+    elif op == 'stop':
+        return 3
+
+
+class BadFabricClient:
+    def __init__(self, channel):
+        self._channel = channel
+
+    def submit(self, prompt, seq):
+        # conditional op with the retrying default: an unjournaled
+        # retried submit admits twice
+        return self._channel.call({'op': 'submit', 'prompt': prompt,
+                                   'seq': seq})
+
+    def stop(self):
+        # non_idempotent op with retries enabled: a retried stop hits
+        # a dead server
+        return self._channel.call({'op': 'stop'})
+
+    def probe(self):
+        # 'status' is sent through a retrying channel but declared in
+        # no OP_SEMANTICS table
+        return self._channel.call({'op': 'status'})

@@ -48,6 +48,10 @@ CORPUS = [
     ('bad_idem_retry_unsafe.py', {'idem-retry-unsafe': 1,
                                   'idem-conditional-literal': 1}),
     ('bad_idem_unknown_op.py', {'idem-unknown-op': 2}),
+    ('bad_idem_fabric.py', {'idem-unknown-op': 1,
+                            'idem-conditional-literal': 1,
+                            'idem-retry-unsafe': 1,
+                            'idem-undeclared-op': 1}),
     ('bad_metric_family.py', {'metric-unknown-family': 1,
                               'metric-label-arity': 1}),
     ('bad_span_no_cm.py', {'span-no-cm': 2}),

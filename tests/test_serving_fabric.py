@@ -1,0 +1,892 @@
+"""Serving fabric tests (paddle_tpu/serving/fabric/).
+
+The fabric's load-bearing contracts:
+
+  1. wire protocol failure taxonomy — malformed frame, oversized frame,
+     mid-frame drop — every case yields a TYPED error (or a clean
+     close), never a hung handler thread;
+  2. exactly-once across the process boundary — a duplicate-delivered
+     (client, seq) submit returns the SAME req_id and admits once;
+  3. the gateway's failover / drain / rollout machinery works UNCHANGED
+     through SocketReplica: killing a worker mid-burst still completes
+     100% of requests with token parity, rollout() through socket
+     replicas loses zero requests, and each request gets exactly one
+     wide event carrying its cross-replica history;
+  4. artifact distribution verifies what it pulls: corrupted payload or
+     corrupted CRC manifest -> ArtifactVerifyError, never weights-
+     silently-wrong;
+  5. the prefix directory routes shared-prefix prompts to the replica
+     that already holds their pages.
+
+Fast tests run ReplicaWorker in-process over real localhost sockets
+with jax-free stub engines; the slow chaos test SIGKILLs a real spawned
+worker process mid-burst.
+"""
+import json
+import os
+import socket
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.distributed.resilience import (FrameDecodeError,
+                                               FrameTooLargeError)
+from paddle_tpu.framework import io_save
+from paddle_tpu.monitor import FleetCollector, MetricRegistry, to_dict
+from paddle_tpu.monitor import events as _events
+from paddle_tpu.serving import ServingGateway
+from paddle_tpu.serving.fabric import (ArtifactClient, ArtifactServer,
+                                       ArtifactVerifyError, MAX_FRAME,
+                                       PrefixAffinityRouter,
+                                       PrefixDirectory, ReplicaWorker,
+                                       SocketReplica, recv_frame,
+                                       send_frame)
+from paddle_tpu.serving.fabric.transport import (DRAINING, READY, STOPPED)
+from paddle_tpu.serving.registry import ModelHost, ModelRegistry
+
+MNT = 6
+
+
+# ---- jax-free stub engines -------------------------------------------
+
+
+class _StubReq:
+    def __init__(self, rid, prompt, max_new_tokens):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.tokens = []
+        self.done = False
+        self.outcome = None
+        self.max_new = int(max_new_tokens)
+        self._admit_t = time.monotonic()
+        self._arrival_t = self._admit_t
+        self._prefill_chunks = 1
+        self._prefix_hit = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self.kv_page_seconds = 0.0
+
+
+def _expected(prompt, n):
+    """The stub's deterministic output: a pure function of the prompt,
+    so failover to a fresh engine reproduces it exactly."""
+    return [(prompt[-1] + i + 1) % 997 for i in range(n)]
+
+
+class EchoEngine:
+    """Engine-contract stub: each step() appends one deterministic
+    token per in-flight request. Jax-free, so in-proc worker tests are
+    milliseconds."""
+
+    num_slots = 4
+
+    class _Sched:
+        def __init__(self, eng):
+            self._eng = eng
+
+        @property
+        def queue(self):
+            return [r for r in self._eng._reqs if not r.done]
+
+        @property
+        def pending(self):
+            return len(self.queue)
+
+    def __init__(self, step_delay=0.0005):
+        self.scheduler = EchoEngine._Sched(self)
+        self._reqs = []
+        self._lock = threading.Lock()
+        self._ids = 0
+        self._down = False
+        self.submits = 0
+        self._delay = step_delay
+
+    def add_request(self, prompt, max_new_tokens=MNT, emit_event=True,
+                    **kw):
+        with self._lock:
+            if self._down:
+                raise RuntimeError('engine is shut down')
+            if not prompt:
+                raise ValueError('empty prompt')
+            self._ids += 1
+            self.submits += 1
+            r = _StubReq(self._ids, prompt, max_new_tokens)
+            self._reqs.append(r)
+            return r
+
+    def step(self):
+        with self._lock:
+            for r in self._reqs:
+                if r.done:
+                    continue
+                r.tokens.append(_expected(r.prompt, r.max_new)
+                                [len(r.tokens)])
+                if len(r.tokens) >= r.max_new:
+                    r.done = True
+                    r.outcome = 'ok'
+            self._reqs = [r for r in self._reqs if not r.done]
+        if self._delay:
+            time.sleep(self._delay)
+        return 1
+
+    def shutdown(self):
+        with self._lock:
+            self._down = True
+
+
+# ---- helpers ----------------------------------------------------------
+
+
+def _hard_kill(worker):
+    """The in-proc stand-in for SIGKILL: the TCP server and every live
+    connection vanish without a goodbye; the drive thread stops."""
+    with worker._lock:
+        worker._stopping = True
+        worker._cv.notify_all()
+    worker._srv.shutdown()
+    worker._srv.server_close()
+    for conn in list(worker._srv.live_connections):
+        try:
+            conn.close()
+        except OSError:
+            pass
+    worker._metrics.stop()
+
+
+def _raw_conn(worker):
+    host, port = worker.endpoint.rsplit(':', 1)
+    return socket.create_connection((host, int(port)), timeout=5)
+
+
+@pytest.fixture
+def worker():
+    w = ReplicaWorker(EchoEngine()).start()
+    yield w
+    w.stop()
+
+
+@pytest.fixture
+def worker_pair():
+    ws = [ReplicaWorker(EchoEngine()).start() for _ in range(2)]
+    yield ws
+    for w in ws:
+        try:
+            w.stop()
+        except Exception:
+            pass
+
+
+def _fabric_gateway(workers, **kw):
+    kw.setdefault('registry', MetricRegistry())
+    gw = ServingGateway(None, replicas=0, **kw)
+    for w in workers:
+        gw.adopt_replica(
+            SocketReplica(w.endpoint, metrics_url=w.metrics_url,
+                          poll_interval=0.001).connect())
+    return gw
+
+
+def _counter(gw, name, labels=None):
+    fam = gw.registry.get(name)
+    if labels is None:
+        return fam.value()
+    return fam.labels(*labels).value()
+
+
+# ---- wire protocol edge cases ----------------------------------------
+
+
+def test_frame_roundtrip_and_clean_eof():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {'op': 'ping', 'n': [1, 2, 3]})
+        assert recv_frame(b) == {'op': 'ping', 'n': [1, 2, 3]}
+        a.close()
+        assert recv_frame(b) is None       # EOF at a frame boundary
+    finally:
+        b.close()
+
+
+def test_malformed_frame_is_typed_decode_error():
+    a, b = socket.socketpair()
+    try:
+        payload = b'\xff\xfenot json at all'
+        a.sendall(struct.pack('>Q', len(payload)) + payload)
+        with pytest.raises(FrameDecodeError):
+            recv_frame(b)
+        # non-encodable object on the SEND side is the same typed error
+        with pytest.raises(FrameDecodeError):
+            send_frame(a, {'op': object()})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_frame_refused_before_allocation():
+    a, b = socket.socketpair()
+    try:
+        # a corrupted header declaring an absurd length must be refused
+        # without trying to buffer it
+        a.sendall(struct.pack('>Q', MAX_FRAME + 1))
+        with pytest.raises(FrameTooLargeError):
+            recv_frame(b)
+        with pytest.raises(FrameTooLargeError):
+            send_frame(a, {'blob': 'x' * 64}, max_frame=16)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_mid_frame_drop_is_connection_error():
+    a, b = socket.socketpair()
+    a.sendall(struct.pack('>Q', 100) + b'only ten b')
+    a.close()
+    with pytest.raises(ConnectionError):
+        recv_frame(b)
+    b.close()
+    # ... and mid-header
+    a, b = socket.socketpair()
+    a.sendall(b'\x00\x00\x00')
+    a.close()
+    with pytest.raises(ConnectionError):
+        recv_frame(b)
+    b.close()
+
+
+# ---- worker wire behavior --------------------------------------------
+
+
+def test_worker_replies_typed_error_on_malformed_frame(worker):
+    s = _raw_conn(worker)
+    try:
+        payload = b'{broken'
+        s.sendall(struct.pack('>Q', len(payload)) + payload)
+        out = recv_frame(s)
+        assert out['error_type'] == 'FrameDecodeError'
+    finally:
+        s.close()
+    # the worker is not hung: a fresh connection still serves
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'ping'})
+        assert recv_frame(s)['ok'] is True
+    finally:
+        s.close()
+
+
+def test_worker_replies_typed_error_on_oversized_frame(worker):
+    s = _raw_conn(worker)
+    try:
+        s.sendall(struct.pack('>Q', MAX_FRAME + 1))
+        out = recv_frame(s)
+        assert out['error_type'] == 'FrameTooLargeError'
+    finally:
+        s.close()
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'status'})
+        assert recv_frame(s)['ok'] is True
+    finally:
+        s.close()
+
+
+def test_worker_survives_mid_frame_drop(worker):
+    s = _raw_conn(worker)
+    s.sendall(struct.pack('>Q', 5000) + b'partial')
+    s.close()                       # drop mid-frame
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'ping'})
+        assert recv_frame(s)['ok'] is True
+    finally:
+        s.close()
+
+
+def test_duplicate_submit_dedups_on_client_seq(worker):
+    msg = {'op': 'submit', 'client': 'c1', 'seq': 1, 'prompt': [5],
+           'sampling': {'max_new_tokens': 2}}
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, msg)
+        r1 = recv_frame(s)
+        assert not r1.get('dup')
+        # duplicate delivery (e.g. a retried send): same req_id, no
+        # second admission
+        send_frame(s, msg)
+        r2 = recv_frame(s)
+        assert r2['req_id'] == r1['req_id']
+        assert r2['dup'] is True
+        assert worker.engine.submits == 1
+        # a STALE seq is a protocol error, typed
+        send_frame(s, dict(msg, seq=0))
+        r3 = recv_frame(s)
+        assert r3['error_type'] == 'ValueError'
+    finally:
+        s.close()
+
+
+def test_poll_unknown_request_is_typed_not_hung(worker):
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'poll', 'reqs': {'999': 0}, 'ack': []})
+        out = recv_frame(s)
+        assert out['reqs']['999']['unknown'] is True
+        assert out['reqs']['999']['outcome'] == 'error'
+    finally:
+        s.close()
+
+
+def test_worker_readyz_flips_503_on_drain(worker):
+    with urllib.request.urlopen(worker.metrics_url + '/readyz',
+                                timeout=5) as resp:
+        assert resp.status == 200
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'drain'})
+        assert recv_frame(s)['state'] == DRAINING
+    finally:
+        s.close()
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(worker.metrics_url + '/readyz', timeout=5)
+    assert ei.value.code == 503
+    # drained empty -> terminal rung, while the TCP server stays up
+    deadline = time.monotonic() + 5
+    while worker.state != STOPPED and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert worker.state == STOPPED
+    # ... and a drained worker refuses new admissions, typed
+    s = _raw_conn(worker)
+    try:
+        send_frame(s, {'op': 'submit', 'prompt': [1], 'sampling': {}})
+        assert recv_frame(s)['error_type'] == 'RuntimeError'
+    finally:
+        s.close()
+
+
+# ---- gateway over sockets --------------------------------------------
+
+
+def test_socket_gateway_parity_and_one_wide_event_per_request(
+        worker_pair):
+    log = _events.RequestLog()
+    prev = _events.set_default_request_log(log)
+    try:
+        gw = _fabric_gateway(worker_pair)
+        prompts = [[3 + i, 7 + i] for i in range(8)]
+        out = gw.generate(prompts, max_new_tokens=MNT)
+        gw.shutdown()
+    finally:
+        _events.set_default_request_log(prev)
+    assert out == [_expected(p, MNT) for p in prompts]
+    routed = [_counter(gw, 'gateway_route_total', (str(i),))
+              for i in range(2)]
+    assert sum(routed) == len(prompts)
+    assert all(v > 0 for v in routed), routed
+    evs = log.events()
+    assert len(evs) == len(prompts)      # exactly one per request
+    assert all(len(e['replicas']) == 1 for e in evs)
+    assert all(e['outcome'] == 'ok' for e in evs)
+
+
+def test_socket_gateway_failover_chaos_oracle():
+    """Kill one worker mid-burst (server + live sockets vanish): every
+    request completes, the victim's in-flight work is re-placed, tokens
+    are exactly the no-fault outputs, and wide events carry the
+    two-replica history."""
+    # slower stub decode: the kill window must be wide enough that the
+    # victim reliably holds in-flight work when it dies
+    workers = [ReplicaWorker(EchoEngine(step_delay=0.01)).start()
+               for _ in range(2)]
+    log = _events.RequestLog()
+    prev = _events.set_default_request_log(log)
+    try:
+        gw = _fabric_gateway(workers)
+        gw.start()
+        prompts = [[11 + i] for i in range(10)]
+        reqs = [gw.submit(p, max_new_tokens=24) for p in prompts]
+        # wait until both replicas hold in-flight work, then kill one
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with gw._lock:
+                if all(len(r.assigned) > 0 for r in gw.pool):
+                    break
+            time.sleep(0.002)
+        victim = gw.pool[0]
+        n_victim = len(victim.assigned)
+        assert n_victim > 0
+        _hard_kill(workers[0])
+        for r in reqs:
+            assert r.wait(timeout=30), 'request %d never finished' % r.id
+        gw.shutdown()
+    finally:
+        _events.set_default_request_log(prev)
+        for w in workers:
+            try:
+                w.stop()
+            except Exception:
+                pass
+    # completed_ratio == 1.0 with exact token parity
+    assert all(r.done for r in reqs)
+    assert [r.tokens for r in reqs] == \
+        [_expected(p, 24) for p in prompts]
+    # every request in flight on the victim AT KILL TIME failed over
+    # exactly once (a poll may have collected a finisher between the
+    # in-flight snapshot and the kill, hence <=)
+    fo = _counter(gw, 'gateway_failover_total')
+    assert 1 <= fo <= n_victim
+    evs = log.events()
+    assert len(evs) == len(prompts)
+    failed_over = [e for e in evs if len(e['replicas']) == 2]
+    assert len(failed_over) == fo
+    assert all(e['replicas'] == [0, 1] for e in failed_over)
+    assert all(e['outcome'] == 'ok' for e in evs)
+
+
+def test_socket_gateway_inadmissible_raises_not_failover(worker_pair):
+    gw = _fabric_gateway(worker_pair)
+    with pytest.raises(ValueError):
+        gw.submit([], max_new_tokens=2)     # EchoEngine rejects empty
+    assert _counter(gw, 'gateway_failover_total') == 0
+    assert gw.replicas_alive == 2
+    gw.shutdown()
+
+
+# ---- fleet federation: worker processes as scrape targets -------------
+
+
+def test_fleet_scrapes_worker_url_stale_not_wrong(worker_pair):
+    meta = MetricRegistry()
+    fc = FleetCollector(registry=meta, clock=time.monotonic)
+    gw = _fabric_gateway(worker_pair)
+    gw.attach_fleet(fc)
+    assert sorted(t.instance for t in fc.targets()) == \
+        ['gw-replica-0', 'gw-replica-1']
+    assert fc.scrape() == {'ok': 2, 'down': 0}
+    up = {s['labels']['instance']: s['value']
+          for s in to_dict(meta)['fleet_target_up']['samples']}
+    assert up == {'gw-replica-0': 1.0, 'gw-replica-1': 1.0}
+
+    # SIGKILL-equivalent: the worker's HTTP endpoint vanishes. The
+    # collector degrades to stale-not-wrong: up -> 0, last snapshot
+    # retained, the survivor still scrapes clean.
+    _hard_kill(worker_pair[0])
+    assert fc.scrape() == {'ok': 1, 'down': 1}
+    st = fc.fleet_status()
+    assert st['targets']['gw-replica-0']['up'] is False
+    assert st['targets']['gw-replica-0']['stale'] is True
+    assert st['targets']['gw-replica-1']['up'] is True
+    up = {s['labels']['instance']: s['value']
+          for s in to_dict(meta)['fleet_target_up']['samples']}
+    assert up == {'gw-replica-0': 0.0, 'gw-replica-1': 1.0}
+    gw.shutdown()
+
+
+# ---- rollout through socket replicas ----------------------------------
+
+
+class _HostStubEngine:
+    """test_model_registry's stub, trimmed: emits the serving VERSION
+    digit so tests can tell which weights answered."""
+
+    max_len = 128
+    num_slots = 4
+    spec_k = 0
+    trace_counts = {'prefill': 1, 'decode': 1}
+
+    def __init__(self, entry):
+        from paddle_tpu.serving.metrics import ServingMetrics
+        self.entry = entry
+        self.metrics = ServingMetrics()
+        self._reqs = []
+
+    class _Sched:
+        def __init__(self, eng):
+            self._eng = eng
+
+        @property
+        def pending(self):
+            return sum(1 for r in self._eng._reqs if not r.done)
+
+        @property
+        def queue(self):
+            return tuple(r for r in self._eng._reqs if not r.done)
+
+    @property
+    def scheduler(self):
+        return _HostStubEngine._Sched(self)
+
+    def enqueue(self, req):
+        if req._arrival_t is None:
+            req._arrival_t = self.metrics.now()
+        self._reqs.append(req)
+        return req
+
+    def step(self):
+        from paddle_tpu.serving.scheduler import DONE
+        for r in self._reqs:
+            if not r.done:
+                r.tokens.extend([int(self.entry.version[-1])]
+                                * r.max_new_tokens)
+                r.state = DONE
+                r.outcome = 'ok'
+                r._finished.set()
+        return self.scheduler.pending
+
+    def generate(self, prompts, max_new_tokens=2, emit_event=True):
+        return [[1] * max_new_tokens for _ in prompts]
+
+    def shutdown(self):
+        pass
+
+    def rebind_perf(self, registry):
+        pass
+
+
+def _publish_zoo(root):
+    reg = ModelRegistry(root=str(root))
+    reg.publish('alpha', 'v1', {'w': [1.0] * 64})
+    reg.publish('alpha', 'v2', {'w': [2.0] * 64})
+    return reg
+
+
+@pytest.fixture
+def host_worker_pair(tmp_path):
+    ws = []
+    for i in range(2):
+        reg = _publish_zoo(tmp_path / ('w%d' % i))
+        host = ModelHost(reg, lambda entry: _HostStubEngine(entry))
+        ws.append(ReplicaWorker(host).start())
+    yield ws
+    for w in ws:
+        try:
+            w.stop()
+        except Exception:
+            pass
+
+
+def test_rollout_through_socket_replicas_zero_loss(host_worker_pair):
+    gw = _fabric_gateway(host_worker_pair)
+    before = [gw.submit([1, 2], max_new_tokens=4, model='alpha')
+              for _ in range(6)]
+    gw.run()
+    summary = gw.rollout('alpha', 'v2')
+    after = [gw.submit([3], max_new_tokens=4, model='alpha')
+             for _ in range(4)]
+    gw.run()
+    gw.shutdown()
+    assert all(r.done and r.error is None for r in before + after)
+    assert summary['model'] == 'alpha'
+    assert summary['from_version'] == 'v1'
+    assert summary['to_version'] == 'v2'
+    assert summary['replicas'] == [0, 1]
+    # pre-swap served by v1, post-swap by v2 — in BOTH worker processes
+    assert all(r.tokens == [1] * 4 for r in before)
+    assert all(r.tokens == [2] * 4 for r in after)
+    for w in host_worker_pair:
+        assert w.engine.registry.serving_version('alpha') == 'v2'
+
+
+def test_rollout_pulls_missing_artifact_over_fabric(tmp_path):
+    """A worker whose local registry lacks the target version pulls it
+    from the gateway's ArtifactServer during rollout_prepare, verified
+    end to end."""
+    src = _publish_zoo(tmp_path / 'src')
+    art = ArtifactServer(src).start()
+    local = ModelRegistry(root=str(tmp_path / 'w0'))
+    local.publish('alpha', 'v1', {'w': [1.0] * 64})   # v2 is MISSING
+    host = ModelHost(local, lambda entry: _HostStubEngine(entry))
+    client = ArtifactClient(art.endpoint, str(tmp_path / 'cache'))
+    w = ReplicaWorker(host, artifact_client=client).start()
+    try:
+        gw = _fabric_gateway([w])
+        r = gw.submit([1], max_new_tokens=2, model='alpha')
+        gw.run()
+        assert r.tokens == [1, 1]
+        assert ('alpha', 'v2') not in local
+        summary = gw.rollout('alpha', 'v2')
+        assert summary['to_version'] == 'v2'
+        # the pull registered a verified local copy
+        assert ('alpha', 'v2') in local
+        assert local.entry('alpha', 'v2').fingerprint == \
+            src.entry('alpha', 'v2').fingerprint
+        r2 = gw.submit([1], max_new_tokens=2, model='alpha')
+        gw.run()
+        assert r2.tokens == [2, 2]
+        gw.shutdown()
+    finally:
+        w.stop()
+        art.stop()
+
+
+# ---- artifact verification -------------------------------------------
+
+
+def test_artifact_pull_roundtrip_and_fingerprint(tmp_path):
+    src = _publish_zoo(tmp_path / 'src')
+    art = ArtifactServer(src).start()
+    try:
+        dst = ModelRegistry(root=str(tmp_path / 'dst'))
+        client = ArtifactClient(art.endpoint, str(tmp_path / 'cache'))
+        entry = client.ensure(dst, 'alpha', 'v1')
+        assert entry.fingerprint == src.entry('alpha', 'v1').fingerprint
+        assert ('alpha', 'v1') in dst
+        # idempotent: a second ensure is a catalog hit, not a re-pull
+        again = client.ensure(dst, 'alpha', 'v1')
+        assert again.path == entry.path
+    finally:
+        art.stop()
+
+
+def _corrupt(path, at=-3):
+    with open(path, 'rb') as f:
+        blob = bytearray(f.read())
+    blob[at] ^= 0xFF
+    with open(path, 'wb') as f:
+        f.write(bytes(blob))
+
+
+def test_corrupted_artifact_payload_typed_reject(tmp_path):
+    src = _publish_zoo(tmp_path / 'src')
+    # corrupt the PAYLOAD, leave the CRC manifest intact: the content
+    # fingerprint (a manifest hash) still matches, so the per-chunk CRC
+    # verification at register() is what must catch it
+    _corrupt(src.entry('alpha', 'v1').path)
+    art = ArtifactServer(src).start()
+    try:
+        dst = ModelRegistry(root=str(tmp_path / 'dst'))
+        client = ArtifactClient(art.endpoint, str(tmp_path / 'cache'))
+        with pytest.raises(ArtifactVerifyError):
+            client.ensure(dst, 'alpha', 'v1')
+        assert ('alpha', 'v1') not in dst    # reject, not register
+    finally:
+        art.stop()
+
+
+def test_corrupted_manifest_typed_reject(tmp_path):
+    src = _publish_zoo(tmp_path / 'src')
+    # corrupt the CRC manifest sidecar: the pulled fingerprint no
+    # longer matches the cataloged one
+    _corrupt(io_save.manifest_path(src.entry('alpha', 'v1').path))
+    art = ArtifactServer(src).start()
+    try:
+        dst = ModelRegistry(root=str(tmp_path / 'dst'))
+        client = ArtifactClient(art.endpoint, str(tmp_path / 'cache'))
+        with pytest.raises(ArtifactVerifyError):
+            client.ensure(dst, 'alpha', 'v1')
+        assert ('alpha', 'v1') not in dst
+    finally:
+        art.stop()
+
+
+def test_artifact_fetch_refuses_path_traversal(tmp_path):
+    src = _publish_zoo(tmp_path / 'src')
+    art = ArtifactServer(src).start()
+    try:
+        s = socket.create_connection(
+            ('127.0.0.1', art.port), timeout=5)
+        send_frame(s, {'op': 'fetch', 'model': 'alpha', 'version': 'v1',
+                       'file': '../../etc/passwd', 'offset': 0})
+        out = recv_frame(s)
+        assert 'error' in out
+        s.close()
+    finally:
+        art.stop()
+
+
+# ---- prefix directory + affinity routing ------------------------------
+
+
+def test_prefix_directory_depths_and_lru():
+    d = PrefixDirectory(page_size=4, capacity=8)
+    shared = list(range(16))
+    d.observe(shared + [100], replica_index=1)
+    # 16 shared tokens + tail -> 4 full blocks on replica 1
+    assert d.depths(shared + [200]) == {1: 4}
+    # a different prefix diverges at block 0: no hint
+    assert d.depths([9, 9, 9, 9, 9]) == {}
+    # shorter than a page (plus the never-covered last token): nothing
+    assert d.depths([1, 2, 3, 4]) == {}
+    # latest writer wins
+    d.observe(shared + [101], replica_index=0)
+    assert d.depths(shared + [200]) == {0: 4}
+    # LRU capacity: flooding with unrelated chains evicts the oldest
+    for i in range(8):
+        d.observe([50 + i] * 5, replica_index=1)
+    assert len(d) <= 8
+
+
+def test_prefix_affinity_router_orders_by_depth_then_load():
+    class _Rep:
+        def __init__(self, index, load):
+            self.index = index
+            self._load = load
+
+        def routable(self):
+            return True
+
+        def load(self):
+            return self._load
+
+    class _Gw:
+        def __init__(self, prompt):
+            self.prompt = prompt
+
+    pool = [_Rep(0, 0.0), _Rep(1, 5.0), _Rep(2, 1.0)]
+    r = PrefixAffinityRouter(page_size=4)
+    shared = list(range(12))
+    # cold directory: pure least-loaded order
+    assert [x.index for x in
+            r.candidates_for_request(pool, _Gw(shared + [7]))] == [0, 2, 1]
+    # replica 1 served this prefix: it ranks first DESPITE max load
+    r.note_placement(shared + [7], 1)
+    assert [x.index for x in
+            r.candidates_for_request(pool, _Gw(shared + [8]))] == [1, 0, 2]
+    # unrelated prompt still routes by load
+    assert [x.index for x in
+            r.candidates_for_request(pool, _Gw([99] * 13))] == [0, 2, 1]
+
+
+def test_prefix_affinity_gateway_keeps_shared_prefix_together(
+        worker_pair):
+    gw = _fabric_gateway(worker_pair,
+                         router=PrefixAffinityRouter(page_size=4))
+    shared = [7] * 16
+    first = gw.submit(shared + [1], max_new_tokens=2)
+    gw.run()
+    warm = first.replica_history[0]
+    rest = [gw.submit(shared + [2 + i], max_new_tokens=2)
+            for i in range(5)]
+    gw.run()
+    gw.shutdown()
+    assert all(r.done for r in [first] + rest)
+    # every shared-prefix request landed on the warm replica
+    assert all(r.replica_history == [warm] for r in rest)
+    assert _counter(gw, 'gateway_route_total', (str(warm),)) == 6.0
+
+
+# ---- predictor-zoo presets -------------------------------------------
+
+
+def test_presets_build_deterministic_models(tmp_path):
+    import numpy as np
+    from paddle_tpu.serving.fabric import (PRESETS, build_engine, preset,
+                                           publish_preset)
+    from paddle_tpu.serving.fabric.presets import build_model, host_factory
+    assert set(PRESETS) >= {'gpt-nano', 'gpt-nano-paged', 'gpt-micro'}
+    with pytest.raises(KeyError):
+        preset('gpt-colossal')
+    # the preset seed pins the weights: two builds agree exactly
+    sd1 = {k: np.asarray(v)
+           for k, v in build_model('gpt-nano').state_dict().items()}
+    sd2 = {k: np.asarray(v)
+           for k, v in build_model('gpt-nano').state_dict().items()}
+    assert sd1.keys() == sd2.keys()
+    assert all(np.array_equal(sd1[k], sd2[k]) for k in sd1)
+    # engine round trip + publish/host_factory serve the same weights
+    eng = build_engine('gpt-nano')
+    ref = eng.generate([[5, 6, 7]], max_new_tokens=4)
+    eng.shutdown()
+    reg = ModelRegistry(root=str(tmp_path))
+    entry = publish_preset(reg, 'gpt-nano')
+    assert entry.meta['preset'] == 'gpt-nano'
+    eng2 = host_factory()(reg.entry('gpt-nano', 'v0'))
+    assert eng2.generate([[5, 6, 7]], max_new_tokens=4) == ref
+    eng2.shutdown()
+
+
+# ---- the real process boundary (slow) ---------------------------------
+
+
+@pytest.mark.slow
+def test_fabric_chaos_sigkill_worker_midburst_token_parity():
+    """THE acceptance test: two real worker processes behind the
+    gateway, a Poisson burst, SIGKILL one worker mid-burst. Every
+    request completes, the delivered tokens are EXACTLY the
+    single-engine reference, and each request's single wide event
+    carries its cross-process replica history."""
+    import numpy as np
+    from paddle_tpu.serving.fabric import build_engine, spawn_worker
+    rng = np.random.RandomState(11)
+    prompts = [[int(t) for t in rng.randint(0, 211, n)]
+               for n in (3, 9, 5, 12, 4, 7, 6, 10, 8, 5)]
+    ref_eng = build_engine('gpt-nano')
+    reference = ref_eng.generate(prompts, max_new_tokens=8)
+    ref_eng.shutdown()
+
+    handles = [spawn_worker(preset='gpt-nano') for _ in range(2)]
+    log = _events.RequestLog()
+    prev = _events.set_default_request_log(log)
+    meta = MetricRegistry()
+    fc = FleetCollector(registry=meta, clock=time.monotonic)
+    try:
+        gw = ServingGateway(None, replicas=0, registry=MetricRegistry())
+        for h in handles:
+            gw.adopt_replica(
+                SocketReplica(h.endpoint, metrics_url=h.metrics_url,
+                              poll_interval=0.002).connect())
+        gw.attach_fleet(fc)
+        gw.start()
+        reqs = []
+        for i, p in enumerate(prompts):
+            reqs.append(gw.submit(p, max_new_tokens=8))
+            if i == len(prompts) // 2:
+                handles[0].kill()            # SIGKILL, no goodbye
+            time.sleep(float(rng.exponential(0.05)))
+        for r in reqs:
+            assert r.wait(timeout=300), \
+                'request %d never completed' % r.id
+        gw.shutdown()
+    finally:
+        _events.set_default_request_log(prev)
+        for h in handles:
+            h.cleanup()
+
+    # completed_ratio == 1.0, exact token parity with one engine
+    assert all(r.done for r in reqs)
+    assert [r.tokens for r in reqs] == reference
+    evs = log.events()
+    assert len(evs) == len(prompts)          # exactly one per request
+    assert all(e['outcome'] == 'ok' for e in evs)
+    crossed = [e for e in evs if len(e['replicas']) > 1]
+    victims = [r for r in reqs if len(r.replica_history) > 1]
+    assert len(crossed) == len(victims)
+    assert all(set(e['replicas']) == {0, 1} for e in crossed)
+    # stale-not-wrong federation after the SIGKILL
+    fc.scrape()
+    st = fc.fleet_status()
+    assert st['targets']['gw-replica-0']['up'] is False
+
+
+@pytest.mark.slow
+def test_spawn_worker_pulls_artifacts_by_fingerprint(tmp_path):
+    """Worker bring-up from nothing but (model, version, fingerprint):
+    the spawned process pulls the preset checkpoint from the
+    ArtifactServer, CRC-verifies it, and serves the same tokens as a
+    locally built engine."""
+    from paddle_tpu.serving.fabric import (build_engine, publish_preset,
+                                           spawn_worker)
+    reg = ModelRegistry(root=str(tmp_path / 'src'))
+    entry = publish_preset(reg, 'gpt-nano')
+    art = ArtifactServer(reg).start()
+    h = None
+    try:
+        h = spawn_worker(artifacts=art.endpoint,
+                         cache=str(tmp_path / 'wcache'),
+                         model='gpt-nano', version='v0',
+                         fingerprint=entry.fingerprint)
+        gw = ServingGateway(None, replicas=0, registry=MetricRegistry())
+        gw.adopt_replica(SocketReplica(h.endpoint,
+                                       metrics_url=h.metrics_url).connect())
+        out = gw.generate([[5, 6, 7]], max_new_tokens=4)
+        gw.shutdown()
+        eng = build_engine('gpt-nano')
+        assert out == eng.generate([[5, 6, 7]], max_new_tokens=4)
+        eng.shutdown()
+    finally:
+        if h is not None:
+            h.cleanup()
+        art.stop()

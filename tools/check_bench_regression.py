@@ -40,11 +40,14 @@ from tools import gate_common  # noqa: E402
 # rung adds page_size/spec_k/workload: a spec-on row must never land in
 # a spec-off row's regression bucket. `tenant` keys the mixed-tenant
 # gateway rung's per-tenant TTFT rows — premium and batch latencies are
-# different contracts and must gate separately.
+# different contracts and must gate separately. `transport`/`n_procs`
+# key the serving-fabric rung: in-proc and socket-transport rows are
+# different regimes (the process boundary is the measured cost).
 _AUX_CONFIG = ('replicas', 'kill_at', 'policy',
                'num_slots', 'new_tokens', 'prompt_len', 'image_size',
                'trace', 'model', 'n_models', 'swap_at', 'scan_steps',
-               'page_size', 'spec_k', 'workload', 'tenant')
+               'page_size', 'spec_k', 'workload', 'tenant',
+               'transport', 'n_procs')
 
 __all__ = ['eligible', 'config_key', 'higher_is_better', 'expand_derived',
            'check', 'main']
